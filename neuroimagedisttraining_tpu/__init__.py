@@ -1,0 +1,35 @@
+"""neuroimagedisttraining_tpu — a TPU-native federated-learning framework for neuroimaging.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+bishalth01/NeuroImageDistTraining (a FedML-derived PyTorch/CUDA framework):
+federated training of 3D CNNs over neuroimaging cohorts (ABCD sex
+classification), with nine FL algorithms (FedAvg, SalientGrads, Sub-FedAvg,
+D-PSGD, Ditto, FedFomo, DisPFL, Local-only, TurboAggregate), sparse-mask
+training, robust aggregation, non-IID partitioners, and a distributed
+control plane.
+
+Design stance (TPU-first, not a port):
+
+- **State is data.** A federation is a pytree with a leading client axis
+  (``[C, ...]``); there are no client objects, no deepcopied state dicts.
+- **A round is one jitted SPMD program.** Local training for all clients runs
+  as ``vmap`` over the client axis, sharded over a ``jax.sharding.Mesh``
+  axis ``"clients"`` — one (or more) simulated clients per TPU core.
+- **Aggregation is a collective.** Weighted FedAvg is a mean over the sharded
+  client axis, lowered by XLA to an ICI all-reduce — not a Python loop over
+  state dicts (reference: fedml_api/standalone/fedavg/fedavg_api.py:102-117).
+- **Saliency without model surgery.** SNIP scores are computed as
+  ``|w * grad_w L|`` — mathematically identical to the reference's
+  monkey-patched ``|grad_mask L|`` at mask=1
+  (reference: fedml_api/standalone/sailentgrads/snip.py:9-16).
+"""
+
+__version__ = "0.1.0"
+
+from neuroimagedisttraining_tpu.config import (  # noqa: F401
+    DataConfig,
+    FedConfig,
+    OptimConfig,
+    SparsityConfig,
+    ExperimentConfig,
+)

@@ -1,0 +1,156 @@
+"""Configuration dataclasses.
+
+Externalizes the reference's per-entry-point argparse flag sets
+(reference: fedml_experiments/standalone/sailentgrads/main_sailentgrads.py:31-127,
+main_ditto.py:79,101, main_subavg.py:105-108) into typed, serializable config
+objects shared by every algorithm engine. Defaults preserve the reference's
+canonical ABCD configuration: 3DCNN model, ABCD dataset, 21 site-clients,
+batch 16, 200 communication rounds, SGD lr 0.01 with 0.998/round decay,
+weight decay 5e-4, gradient clip 10 (main_sailentgrads.py:61-99;
+my_model_trainer.py:209,224).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Local-optimizer configuration (reference flags: lr, lr_decay, wd,
+    momentum, batch_size, epochs, client_optimizer)."""
+
+    client_optimizer: str = "sgd"  # "sgd" | "adam"
+    lr: float = 0.01
+    lr_decay: float = 0.998        # per-round exponential: lr * lr_decay**round
+    wd: float = 5e-4
+    momentum: float = 0.9
+    batch_size: int = 16
+    epochs: int = 2                # local epochs per round
+    grad_clip: float = 10.0        # torch clip_grad_norm_ parity (my_model_trainer.py:224)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Dataset + partitioning configuration."""
+
+    dataset: str = "abcd"          # abcd | cifar10 | cifar100 | tiny | synthetic
+    data_dir: str = "./data"
+    partition_method: str = "site"  # site | dir | n_cls | my_part | homo | hetero | rescale
+    partition_alpha: float = 0.3
+    # Synthetic-ABCD knobs (tests / benchmarks without the private cohort).
+    synthetic_num_subjects: int = 256
+    synthetic_shape: tuple[int, int, int] = (121, 145, 121)
+    seed_split: int = 42           # per-site 80/20 split seed (ABCD/data_loader.py:82-86)
+    val_fraction: float = 0.0      # >0 adds per-client validation split (FedFomo 9-tuple)
+
+
+@dataclass(frozen=True)
+class SparsityConfig:
+    """Sparse-training configuration shared by SalientGrads / DisPFL / SubAvg
+    (reference flags: dense_ratio, anneal_factor, erk_power_scale, uniform,
+    static, dis_gradient_check, snip_mask, itersnip_iteration,
+    stratified_sampling, each_prune_ratio, dist_thresh, acc_thresh)."""
+
+    dense_ratio: float = 0.5
+    anneal_factor: float = 0.5
+    erk_power_scale: float = 1.0
+    uniform: bool = False          # uniform layer sparsity instead of ERK
+    static: bool = False           # no mask evolution (DisPFL)
+    dis_gradient_check: bool = False
+    snip_mask: bool = True         # SalientGrads dense escape hatch when False
+    itersnip_iterations: int = 1
+    stratified_sampling: bool = False
+    # Sub-FedAvg
+    each_prune_ratio: float = 0.1
+    dist_thresh: float = 0.001
+    acc_thresh: float = 0.5
+    save_masks: bool = False
+
+
+@dataclass(frozen=True)
+class FedConfig:
+    """Federation topology + schedule (reference flags: client_num_in_total,
+    frac, comm_round, cs, active; Ditto lamda/local_epochs)."""
+
+    client_num_in_total: int = 21
+    frac: float = 1.0              # fraction of clients sampled per round
+    comm_round: int = 200
+    cs: str = "random"             # neighbor/topology selector: random | ring | full
+    active: float = 1.0            # Bernoulli client-activity (fault injection, DisPFL)
+    neighbor_num: int = 5          # gossip fan-out when cs == "random"
+    # Ditto
+    lamda: float = 0.5
+    local_epochs: int = 1
+    # FedFomo
+    fomo_m: int = 5                # number of models requested per round
+    # Evaluation cadence
+    frequency_of_the_test: int = 1
+    ci: bool = False               # CI mode: evaluate client 0 only
+
+    @property
+    def client_num_per_round(self) -> int:
+        # parity: main_sailentgrads.py:234
+        return max(1, int(self.client_num_in_total * self.frac))
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    """Top-level experiment config = the reference's full flag surface."""
+
+    model: str = "3DCNN"           # 3DCNN | 3DCNN_deeper | 3DCNN_regression | resnet3d | resnet18 | ...
+    num_classes: int = 1           # 1 => BCE-with-logits (ABCD sex), >1 => CE
+    algorithm: str = "fedavg"
+    seed: int = 1024
+    tag: str = "exp"
+    data: DataConfig = field(default_factory=DataConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    fed: FedConfig = field(default_factory=FedConfig)
+    sparsity: SparsityConfig = field(default_factory=SparsityConfig)
+    # TPU execution
+    mesh_shape: tuple[int, ...] = ()   # () => all visible devices on one "clients" axis
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0          # rounds; 0 disables
+    log_dir: str = "LOG"
+
+    def identity(self) -> str:
+        """Experiment-identity string encoding the config, mirroring the
+        reference's identity-string construction (main_sailentgrads.py:202-242)."""
+        d, o, f, s = self.data, self.optim, self.fed, self.sparsity
+        parts = [
+            self.algorithm, d.dataset, self.model,
+            f"c{f.client_num_in_total}", f"frac{f.frac}", f"r{f.comm_round}",
+            f"e{o.epochs}", f"b{o.batch_size}", f"lr{o.lr}", f"dec{o.lr_decay}",
+            f"wd{o.wd}", f"part-{d.partition_method}{d.partition_alpha}",
+            f"dr{s.dense_ratio}", f"seed{self.seed}", self.tag,
+        ]
+        return "_".join(str(p) for p in parts)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), default=str, sort_keys=True)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ExperimentConfig":
+        def sub(cls, key):
+            v = d.get(key, {})
+            if isinstance(v, cls):
+                return v
+            fields = {f.name for f in dataclasses.fields(cls)}
+            return cls(**{k: tuple(x) if isinstance(x, list) else x
+                          for k, x in v.items() if k in fields})
+
+        top = {k: v for k, v in d.items()
+               if k in {f.name for f in dataclasses.fields(ExperimentConfig)}
+               and k not in ("data", "optim", "fed", "sparsity")}
+        if "mesh_shape" in top and isinstance(top["mesh_shape"], list):
+            top["mesh_shape"] = tuple(top["mesh_shape"])
+        return ExperimentConfig(
+            data=sub(DataConfig, "data"), optim=sub(OptimConfig, "optim"),
+            fed=sub(FedConfig, "fed"), sparsity=sub(SparsityConfig, "sparsity"),
+            **top,
+        )

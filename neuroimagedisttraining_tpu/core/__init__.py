@@ -1,0 +1,9 @@
+from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer  # noqa: F401
+from neuroimagedisttraining_tpu.core.losses import (  # noqa: F401
+    bce_with_logits,
+    softmax_ce,
+    binary_auc,
+    make_loss,
+    predictions,
+)
+from neuroimagedisttraining_tpu.core.optim import make_local_optimizer, round_lr  # noqa: F401

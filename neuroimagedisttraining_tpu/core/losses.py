@@ -1,0 +1,67 @@
+"""Losses and evaluation metrics.
+
+Parity targets: BCE-with-logits for ABCD sex classification
+(my_model_trainer.py:206 ``nn.BCEWithLogitsLoss``), cross-entropy for the
+CIFAR paths (dpsgd/my_model_trainer.py:39-65); accuracy at threshold 0.5
+(my_model_trainer.py:263-268). The reference's BASELINE metric names AUC but
+computes accuracy (SURVEY.md §5.5) — we log both.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def bce_with_logits(logits: jax.Array, labels: jax.Array,
+                    weights: jax.Array | None = None) -> jax.Array:
+    """Mean binary cross-entropy over valid entries. ``logits`` [B] or [B,1]."""
+    logits = logits.reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    per = optax.sigmoid_binary_cross_entropy(logits, labels)
+    if weights is None:
+        return jnp.mean(per)
+    w = weights.reshape(-1).astype(jnp.float32)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-9)
+
+
+def softmax_ce(logits: jax.Array, labels: jax.Array,
+               weights: jax.Array | None = None) -> jax.Array:
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels.astype(jnp.int32))
+    if weights is None:
+        return jnp.mean(per)
+    w = weights.reshape(-1).astype(jnp.float32)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-9)
+
+
+def make_loss(num_classes: int):
+    """num_classes==1 -> BCE-with-logits (ABCD); else integer-label CE."""
+    return bce_with_logits if num_classes == 1 else softmax_ce
+
+
+def predictions(logits: jax.Array, num_classes: int) -> jax.Array:
+    """Hard predictions: sigmoid>0.5 for binary (my_model_trainer.py:263-268),
+    argmax otherwise."""
+    if num_classes == 1:
+        return (logits.reshape(-1) > 0.0).astype(jnp.int32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def binary_auc(scores: jax.Array, labels: jax.Array,
+               valid: jax.Array | None = None) -> jax.Array:
+    """Exact pairwise ROC-AUC (Mann-Whitney U with 0.5 tie credit).
+
+    O(N^2) pairwise form — fine at per-client cohort sizes (~10^2-10^3) and
+    fully jittable with a validity mask (padded client shards)."""
+    s = scores.reshape(-1).astype(jnp.float32)
+    y = labels.reshape(-1).astype(jnp.int32)
+    v = jnp.ones_like(s) if valid is None else valid.reshape(-1).astype(jnp.float32)
+    pos = (y == 1).astype(jnp.float32) * v
+    neg = (y == 0).astype(jnp.float32) * v
+    gt = (s[:, None] > s[None, :]).astype(jnp.float32)
+    eq = (s[:, None] == s[None, :]).astype(jnp.float32)
+    wins = jnp.einsum("i,ij,j->", pos, gt + 0.5 * eq, neg)
+    denom = jnp.sum(pos) * jnp.sum(neg)
+    return jnp.where(denom > 0, wins / jnp.maximum(denom, 1.0), 0.5)

@@ -1,0 +1,62 @@
+"""Local optimizers with torch-parity semantics.
+
+The reference builds ``torch.optim.SGD(lr=args.lr * args.lr_decay**round,
+momentum, weight_decay)`` fresh each round and clips gradients to global-norm
+10 before the step (my_model_trainer.py:209, 224-225). Torch SGD applies lr
+AFTER the momentum accumulation: ``buf = m*buf + (g + wd*p); p -= lr*buf``.
+We reproduce that exactly by running the optax chain at unit lr and scaling
+the final update by the per-round lr — so lr can be a traced scalar argument
+of the jitted round program instead of a fresh optimizer object.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from neuroimagedisttraining_tpu.config import OptimConfig
+
+
+class LocalOptimizer(NamedTuple):
+    init: object   # params -> opt_state
+    update: object  # (grads, opt_state, params, lr) -> (updates, opt_state)
+
+
+def make_local_optimizer(cfg: OptimConfig) -> LocalOptimizer:
+    if cfg.client_optimizer == "sgd":
+        tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip) if cfg.grad_clip > 0
+            else optax.identity(),
+            optax.add_decayed_weights(cfg.wd) if cfg.wd > 0 else optax.identity(),
+            optax.trace(decay=cfg.momentum) if cfg.momentum > 0
+            else optax.identity(),
+        )
+    elif cfg.client_optimizer == "adam":
+        tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip) if cfg.grad_clip > 0
+            else optax.identity(),
+            optax.scale_by_adam(),
+            optax.add_decayed_weights(cfg.wd) if cfg.wd > 0 else optax.identity(),
+        )
+    else:
+        raise ValueError(f"unknown client_optimizer {cfg.client_optimizer!r}")
+
+    def init(params):
+        return tx.init(params)
+
+    def update(grads, opt_state, params, lr):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        updates = jax.tree.map(lambda u: -lr * u, updates)
+        return updates, opt_state
+
+    return LocalOptimizer(init=init, update=update)
+
+
+def round_lr(cfg: OptimConfig, round_idx) -> jax.Array:
+    """Per-round exponential decay: lr * lr_decay**round
+    (my_model_trainer.py:209)."""
+    return jnp.asarray(cfg.lr, jnp.float32) * (
+        jnp.asarray(cfg.lr_decay, jnp.float32) ** round_idx)

@@ -1,0 +1,197 @@
+"""The local trainer: jitted client-side SGD and evaluation.
+
+Replaces the reference's per-algorithm ``MyModelTrainer`` torch classes
+(e.g. fedml_api/standalone/sailentgrads/my_model_trainer.py:201-236 train,
+239-274 test) with pure functions designed to be ``vmap``-ed over a leading
+client axis and sharded over a TPU mesh:
+
+- ``local_train``: E local epochs of minibatch SGD via ``lax.scan`` —
+  BCE/CE loss, global-norm grad clip 10, torch-parity SGD momentum + weight
+  decay, per-round lr, optional post-step sparse-mask reapply
+  (``param *= mask``, my_model_trainer.py:228-231).
+- Per-client *step counts* are preserved under vmap: every client scans the
+  same static number of steps, but steps beyond ``ceil(n_i/B)`` per epoch are
+  masked no-ops, so small clients do exactly as many updates as the
+  reference's DataLoader would give them.
+- ``evaluate``: full-cohort chunked eval returning correct/loss/total plus
+  raw scores for AUC (metrics dict parity: my_model_trainer.py:245-274).
+
+Data lives on device as padded per-client arrays (uint8 voxels cast raw to
+float32, matching my_model_trainer.py:197-198's ``torch.tensor(X_batch,
+dtype=float32)`` with no rescale).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from neuroimagedisttraining_tpu.config import OptimConfig
+from neuroimagedisttraining_tpu.core.losses import make_loss, predictions
+from neuroimagedisttraining_tpu.core.optim import make_local_optimizer
+from neuroimagedisttraining_tpu.models import primary_logits
+
+PyTree = Any
+
+
+@flax.struct.dataclass
+class ClientState:
+    """All trainable state of one client; with a leading client axis this is
+    the whole federation."""
+    params: PyTree
+    batch_stats: PyTree
+    opt_state: PyTree
+    rng: jax.Array
+
+
+class LocalTrainer:
+    """Functional trainer bound to one model + optimizer config."""
+
+    def __init__(self, model, optim: OptimConfig, num_classes: int,
+                 channel_last_input: bool = True):
+        self.model = model
+        self.optim_cfg = optim
+        self.num_classes = num_classes
+        self.loss = make_loss(num_classes)
+        self.opt = make_local_optimizer(optim)
+        self._channel = channel_last_input
+
+    # ---------- init ----------
+
+    def init_client_state(self, rng: jax.Array, sample_x: jax.Array) -> ClientState:
+        prng, drng, srng = jax.random.split(rng, 3)
+        variables = self.model.init({"params": prng, "dropout": drng},
+                                    self._prep(sample_x), train=False)
+        params = variables["params"]
+        bstats = variables.get("batch_stats", {})
+        return ClientState(params=params, batch_stats=bstats,
+                           opt_state=self.opt.init(params), rng=srng)
+
+    def _prep(self, x: jax.Array) -> jax.Array:
+        """uint8 -> float32 raw cast; add trailing channel dim for volumetric
+        inputs lacking one (reference ``unsqueeze(1)``,
+        my_model_trainer.py:216 — ours is channels-last)."""
+        x = x.astype(jnp.float32)
+        if self._channel and x.ndim in (4,):  # [B,D,H,W] -> [B,D,H,W,1]
+            x = x[..., None]
+        return x
+
+    def _apply(self, params, batch_stats, x, train: bool, dropout_rng=None):
+        variables = {"params": params}
+        has_bn = bool(jax.tree.leaves(batch_stats))
+        if has_bn:
+            variables["batch_stats"] = batch_stats
+        rngs = {"dropout": dropout_rng} if (train and dropout_rng is not None) else None
+        if train and has_bn:
+            out, mut = self.model.apply(variables, x, train=True, rngs=rngs,
+                                        mutable=["batch_stats"])
+            return out, mut["batch_stats"]
+        out = self.model.apply(variables, x, train=train, rngs=rngs)
+        return out, batch_stats
+
+    # ---------- training ----------
+
+    def loss_and_grad(self, cs: ClientState, x, y):
+        """One batch's (loss, grads, new batch_stats); used directly by SNIP
+        scoring and gradient probes as well as by ``local_train``."""
+        rng, drng = jax.random.split(cs.rng)
+
+        def f(params):
+            out, bstats = self._apply(params, cs.batch_stats, self._prep(x),
+                                      train=True, dropout_rng=drng)
+            return self.loss(primary_logits(out), y), bstats
+
+        (loss, bstats), grads = jax.value_and_grad(f, has_aux=True)(cs.params)
+        return loss, grads, bstats, rng
+
+    def local_train(self, cs: ClientState, X, y, n_valid, lr, epochs: int,
+                    batch_size: int, max_samples: int,
+                    mask: PyTree | None = None):
+        """E epochs of local SGD on device-resident (padded) client data.
+
+        Returns ``(new_state, mean_loss)``. ``n_valid`` is the client's true
+        sample count; steps beyond its per-epoch quota are masked no-ops so
+        vmapped clients keep reference-parity update counts.
+        """
+        steps_per_epoch = max(1, math.ceil(max_samples / batch_size))
+        my_steps = jnp.ceil(n_valid / batch_size).astype(jnp.int32)
+        total = epochs * steps_per_epoch
+
+        def step(carry, t):
+            state = carry
+            rng, brng, drng = jax.random.split(state.rng, 3)
+            idx = jax.random.randint(brng, (batch_size,), 0,
+                                     jnp.maximum(n_valid, 1))
+            xb = jnp.take(X, idx, axis=0)
+            yb = jnp.take(y, idx, axis=0)
+
+            def f(params):
+                out, bstats = self._apply(params, state.batch_stats,
+                                          self._prep(xb), train=True,
+                                          dropout_rng=drng)
+                return self.loss(primary_logits(out), yb), bstats
+
+            (loss, bstats), grads = jax.value_and_grad(f, has_aux=True)(
+                state.params)
+            updates, opt_state = self.opt.update(grads, state.opt_state,
+                                                 state.params, lr)
+            params = jax.tree.map(jnp.add, state.params, updates)
+            if mask is not None:
+                params = jax.tree.map(jnp.multiply, params, mask)
+
+            active = (t % steps_per_epoch) < my_steps
+
+            def keep(new, old):
+                return jax.tree.map(
+                    lambda a, b: jnp.where(active, a, b), new, old)
+
+            new_state = ClientState(
+                params=keep(params, state.params),
+                batch_stats=keep(bstats, state.batch_stats),
+                opt_state=keep(opt_state, state.opt_state),
+                rng=rng)
+            return new_state, jnp.where(active, loss, 0.0)
+
+        cs, losses = jax.lax.scan(step, cs, jnp.arange(total))
+        denom = jnp.maximum(epochs * my_steps, 1)
+        return cs, jnp.sum(losses) / denom
+
+    # ---------- evaluation ----------
+
+    def evaluate(self, params, batch_stats, X, y, valid, batch_size: int = 32):
+        """Chunked full-set eval. Returns dict with ``test_correct``,
+        ``test_loss`` (sum), ``test_total`` and raw ``scores`` for AUC."""
+        n = X.shape[0]
+        nb = max(1, math.ceil(n / batch_size))
+        pad = nb * batch_size - n
+        Xp = jnp.pad(X, [(0, pad)] + [(0, 0)] * (X.ndim - 1))
+        yp = jnp.pad(y, (0, pad))
+        vp = jnp.pad(valid.astype(jnp.float32), (0, pad))
+
+        def chunk(_, i):
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * batch_size,
+                                                        batch_size, 0)
+            xb, yb, vb = sl(Xp), sl(yp), sl(vp)
+            out, _ = self._apply(params, batch_stats, self._prep(xb),
+                                 train=False)
+            logits = primary_logits(out)
+            preds = predictions(logits, self.num_classes)
+            correct = jnp.sum((preds == yb.astype(jnp.int32)) * vb)
+            loss = self.loss(logits, yb, weights=vb) * jnp.sum(vb)
+            score = (logits.reshape(batch_size, -1)[:, 0]
+                     if self.num_classes == 1
+                     else jax.nn.log_softmax(logits)[:, -1])
+            return None, (correct, loss, jnp.sum(vb), score)
+
+        _, (corrects, losses, totals, scores) = jax.lax.scan(
+            chunk, None, jnp.arange(nb))
+        return {
+            "test_correct": jnp.sum(corrects),
+            "test_loss": jnp.sum(losses),
+            "test_total": jnp.sum(totals),
+            "scores": scores.reshape(-1)[:n],
+        }

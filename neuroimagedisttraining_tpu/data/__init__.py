@@ -1,0 +1,4 @@
+from neuroimagedisttraining_tpu.data.synthetic import (  # noqa: F401
+    generate_synthetic_abcd,
+    write_synthetic_hdf5,
+)

@@ -1,0 +1,64 @@
+"""Synthetic ABCD-like cohort generator.
+
+The real ABCD dataset (11,573 T1 gray-matter volumes, 121x145x121 voxels,
+8-bit quantized HDF5 with keys ``X``/``y``/``site`` — reference
+Preprocess_ABCD.ipynb cells 7/30/37, ABCD/data_loader.py:112-119) is private.
+This generator produces a cohort with the same schema and statistical shape:
+uint8 volumes, binary ``y`` (sex), integer ``site`` labels, with a
+class-conditional signal so that models actually learn — used by tests,
+benchmarks, and parity validation (SURVEY.md §7 "hard parts" #5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def generate_synthetic_abcd(
+    num_subjects: int = 256,
+    shape: tuple[int, int, int] = (16, 16, 16),
+    num_sites: int = 4,
+    seed: int = 0,
+    signal: float = 12.0,
+) -> dict[str, np.ndarray]:
+    """Returns ``{"X": uint8 [N,D,H,W], "y": int8 [N], "site": int16 [N]}``.
+
+    The class signal is a smooth blob whose amplitude differs by class and
+    whose position drifts slightly by site (site-level covariate shift, the
+    phenomenon the federated setup exists to handle).
+    """
+    rng = np.random.default_rng(seed)
+    d, h, w = shape
+    y = rng.integers(0, 2, size=num_subjects).astype(np.int8)
+    # Site sizes are imbalanced like real acquisition sites.
+    site_probs = rng.dirichlet(np.full(num_sites, 2.0))
+    site = rng.choice(num_sites, size=num_subjects, p=site_probs).astype(np.int16)
+
+    zz, yy, xx = np.meshgrid(
+        np.linspace(-1, 1, d), np.linspace(-1, 1, h), np.linspace(-1, 1, w),
+        indexing="ij",
+    )
+    X = np.empty((num_subjects, d, h, w), dtype=np.uint8)
+    site_shift = rng.normal(0, 0.15, size=(num_sites, 3))
+    for i in range(num_subjects):
+        cz, cy, cx = site_shift[site[i]]
+        blob = np.exp(-(((zz - cz) ** 2 + (yy - cy) ** 2 + (xx - cx) ** 2)
+                        / 0.18))
+        base = 60.0 + 20.0 * blob
+        base += signal * blob * (1.0 if y[i] == 1 else -1.0)
+        base += rng.normal(0, 8.0, size=shape)
+        X[i] = np.clip(base, 0, 255).astype(np.uint8)
+    return {"X": X, "y": y, "site": site}
+
+
+def write_synthetic_hdf5(path: str, **kwargs) -> dict[str, np.ndarray]:
+    """Write the synthetic cohort in the reference HDF5 schema
+    (keys ``X``, ``y``, ``site`` — ABCD/data_loader.py:112-119)."""
+    import h5py
+
+    data = generate_synthetic_abcd(**kwargs)
+    with h5py.File(path, "w") as f:
+        f.create_dataset("X", data=data["X"], chunks=(1,) + data["X"].shape[1:])
+        f.create_dataset("y", data=data["y"])
+        f.create_dataset("site", data=data["site"])
+    return data

@@ -1,0 +1,78 @@
+"""Model zoo + registry.
+
+``create_model`` mirrors the reference harness dispatch
+(fedml_experiments/standalone/sailentgrads/main_sailentgrads.py:164-178:
+``--model 3DCNN`` -> ``AlexNet3D_Dropout(num_classes=1)``), extended with
+every model family the reference zoo contains.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from neuroimagedisttraining_tpu.models.neuro3d import (  # noqa: F401
+    AlexNet3D_Dropout,
+    AlexNet3D_Deeper_Dropout,
+    AlexNet3D_Dropout_Regression,
+    BasicBlock3D,
+    Bottleneck3D,
+    ResNet3D_l3,
+)
+from neuroimagedisttraining_tpu.models.resnet2d import (  # noqa: F401
+    ResNet18,
+    customized_resnet18,
+    original_resnet18,
+    tiny_resnet18,
+)
+from neuroimagedisttraining_tpu.models.vision2d import (  # noqa: F401
+    VGG,
+    vgg11,
+    vgg16,
+    CNNCifar,
+    CNN_OriginalFedAvg,
+    CNN_DropOut,
+    LeNet5,
+    LeNet5_cifar,
+)
+
+
+def create_model(name: str, num_classes: int = 1, dtype=jnp.float32):
+    """Build a model by its reference CLI name."""
+    name = name.lower()
+    if name in ("3dcnn", "alexnet3d", "alexnet3d_dropout"):
+        return AlexNet3D_Dropout(num_classes=num_classes, dtype=dtype)
+    if name in ("3dcnn_deeper", "alexnet3d_deeper_dropout"):
+        return AlexNet3D_Deeper_Dropout(num_classes=num_classes, dtype=dtype)
+    if name in ("3dcnn_regression", "alexnet3d_dropout_regression"):
+        return AlexNet3D_Dropout_Regression(num_classes=num_classes, dtype=dtype)
+    if name in ("resnet3d", "resnet_l3", "resnet3d_l3"):
+        return ResNet3D_l3(num_classes=num_classes, dtype=dtype)
+    if name in ("resnet18", "customized_resnet18"):
+        return customized_resnet18(num_classes=num_classes, dtype=dtype)
+    if name == "original_resnet18":
+        return original_resnet18(num_classes=num_classes, dtype=dtype)
+    if name == "tiny_resnet18":
+        return tiny_resnet18(num_classes=num_classes, dtype=dtype)
+    if name == "vgg11":
+        return vgg11(num_classes=num_classes, dtype=dtype)
+    if name == "vgg16":
+        return vgg16(num_classes=num_classes, dtype=dtype)
+    if name in ("cnn_cifar10", "cnn_cifar100", "simple-cnn"):
+        return CNNCifar(num_classes=num_classes, dtype=dtype)
+    if name in ("cnn", "cnn_originalfedavg"):
+        return CNN_OriginalFedAvg(only_digits=num_classes <= 10, dtype=dtype)
+    if name in ("cnn_dropout", "femnist-cnn"):
+        return CNN_DropOut(only_digits=num_classes <= 10, dtype=dtype)
+    if name == "lenet5":
+        return LeNet5(num_classes=num_classes, dtype=dtype)
+    if name == "lenet5_cifar":
+        return LeNet5_cifar(num_classes=num_classes, dtype=dtype)
+    raise ValueError(f"unknown model: {name!r}")
+
+
+def primary_logits(out):
+    """Some reference models return ``[logits, aux]`` (salient_models.py:139,
+    246, 297); normalize to the logits tensor."""
+    if isinstance(out, (tuple, list)):
+        return out[0]
+    return out

@@ -1,0 +1,227 @@
+"""3D neuroimaging CNNs (the models that matter for ABCD).
+
+Layer-for-layer parity with the reference's torch definitions
+(fedml_api/model/cv/salient_models.py:142-191 AlexNet3D_Dropout,
+194-246 AlexNet3D_Deeper_Dropout, 248-297 AlexNet3D_Dropout_Regression,
+84-139 ResNet_l3, 13-81 BasicBlock/Bottleneck), re-designed for TPU:
+
+- **NDHWC layout** (channels-last) so XLA tiles Conv3D onto the MXU.
+- ``dtype`` controls compute precision (bfloat16 on TPU); params stay f32.
+- The flatten→Linear boundary is shape-inferred rather than hard-coded
+  (the reference hard-codes 256 / 512 / 9216 input features, which silently
+  assumes the 121x145x121 ABCD volume; salient_models.py:99,171,227).
+
+Pooling uses VALID windows with floor semantics, matching torch's default
+floor_mode MaxPool3d/AvgPool3d.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+def _pool(x, kind: str, k: int, s: int, pad: int = 0):
+    dims = (1, k, k, k, 1)
+    strides = (1, s, s, s, 1)
+    padding = [(0, 0)] + [(pad, pad)] * 3 + [(0, 0)]
+    if kind == "max":
+        return nn.max_pool(x, dims[1:4], strides=strides[1:4], padding=padding[1:4])
+    return nn.avg_pool(x, dims[1:4], strides=strides[1:4], padding=padding[1:4])
+
+
+class ConvBNReLU3D(nn.Module):
+    """Conv3d + BatchNorm3d + ReLU block (salient_models.py:147-149 pattern)."""
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    pad: int = 0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (self.kernel,) * 3, strides=(self.stride,) * 3,
+                    padding=[(self.pad, self.pad)] * 3, dtype=self.dtype,
+                    name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32, name="bn")(x)
+        return nn.relu(x)
+
+
+class AlexNet3D_Dropout(nn.Module):
+    """5-conv 3D AlexNet with dropout head; the ABCD flagship (``--model 3DCNN``,
+    num_classes=1 + BCE). Parity: salient_models.py:142-191."""
+    num_classes: int = 2
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBNReLU3D(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = _pool(x, "max", 3, 3)
+        x = ConvBNReLU3D(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = _pool(x, "max", 3, 3)
+        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
+        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
+        x = ConvBNReLU3D(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        x = _pool(x, "max", 3, 3)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(64, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        return x.astype(jnp.float32)
+
+
+class AlexNet3D_Deeper_Dropout(nn.Module):
+    """6-conv, 512-dim-flatten variant; returns ``[x, x]`` like the reference
+    (salient_models.py:194-246)."""
+    num_classes: int = 2
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBNReLU3D(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = _pool(x, "max", 3, 3)
+        x = ConvBNReLU3D(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = _pool(x, "max", 3, 3)
+        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
+        x = ConvBNReLU3D(384, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
+        x = ConvBNReLU3D(256, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        x = ConvBNReLU3D(256, kernel=3, pad=1, dtype=self.dtype, name="f5")(x, train)
+        x = _pool(x, "max", 3, 3)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(64, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        x = x.astype(jnp.float32)
+        return x, x
+
+
+class AlexNet3D_Dropout_Regression(nn.Module):
+    """Regression head; returns ``(pred.squeeze(), feature_map)``
+    (salient_models.py:248-297)."""
+    num_classes: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBNReLU3D(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = _pool(x, "max", 3, 3)
+        x = ConvBNReLU3D(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = _pool(x, "max", 3, 3)
+        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
+        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
+        x = ConvBNReLU3D(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        xp = _pool(x, "max", 3, 3)
+        x = xp.reshape((xp.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(64, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        return jnp.squeeze(x.astype(jnp.float32)), xp.astype(jnp.float32)
+
+
+class BasicBlock3D(nn.Module):
+    """3D residual basic block (salient_models.py:13-42)."""
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    dtype: Dtype = jnp.float32
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        out = nn.Conv(self.planes, (3,) * 3, strides=(self.stride,) * 3,
+                      padding=[(1, 1)] * 3, use_bias=False, dtype=self.dtype,
+                      name="conv1")(x)
+        out = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                           dtype=jnp.float32, name="bn1")(out)
+        out = nn.relu(out)
+        out = nn.Conv(self.planes, (3,) * 3, padding=[(1, 1)] * 3,
+                      use_bias=False, dtype=self.dtype, name="conv2")(out)
+        out = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                           dtype=jnp.float32, name="bn2")(out)
+        if self.downsample:
+            residual = nn.Conv(self.planes * self.expansion, (1,) * 3,
+                               strides=(self.stride,) * 3, use_bias=False,
+                               dtype=self.dtype, name="ds_conv")(x)
+            residual = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=jnp.float32, name="ds_bn")(residual)
+        return nn.relu(out + residual)
+
+
+class Bottleneck3D(nn.Module):
+    """3D bottleneck block, expansion 4 (salient_models.py:45-81)."""
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    dtype: Dtype = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+
+        def bn(name):
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                dtype=jnp.float32, name=name)
+
+        out = nn.relu(bn("bn1")(nn.Conv(self.planes, (1,) * 3, use_bias=False,
+                                        dtype=self.dtype, name="conv1")(x)))
+        out = nn.relu(bn("bn2")(nn.Conv(self.planes, (3,) * 3,
+                                        strides=(self.stride,) * 3,
+                                        padding=[(1, 1)] * 3, use_bias=False,
+                                        dtype=self.dtype, name="conv2")(out)))
+        out = bn("bn3")(nn.Conv(self.planes * 4, (1,) * 3, use_bias=False,
+                                dtype=self.dtype, name="conv3")(out))
+        if self.downsample:
+            residual = nn.Conv(self.planes * self.expansion, (1,) * 3,
+                               strides=(self.stride,) * 3, use_bias=False,
+                               dtype=self.dtype, name="ds_conv")(x)
+            residual = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=jnp.float32, name="ds_bn")(residual)
+        return nn.relu(out + residual)
+
+
+class ResNet3D_l3(nn.Module):
+    """3-stage 3D ResNet; returns ``(logits, penultimate)``
+    (salient_models.py:84-139). ``block`` is "basic" or "bottleneck"."""
+    layers: Sequence[int] = (1, 1, 1)
+    num_classes: int = 2
+    block: str = "basic"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        blk = BasicBlock3D if self.block == "basic" else Bottleneck3D
+        expansion = 1 if self.block == "basic" else 4
+        x = nn.Conv(64, (3,) * 3, strides=(2,) * 3, padding=[(3, 3)] * 3,
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=jnp.float32, name="bn1")(x)
+        x = nn.relu(x)
+        x = _pool(x, "max", 3, 2, pad=1)
+        inplanes = 64
+        for stage, (planes, blocks) in enumerate(zip((64, 128, 256), self.layers)):
+            stride = 1 if stage == 0 else 2
+            for i in range(blocks):
+                s = stride if i == 0 else 1
+                ds = i == 0 and (s != 1 or inplanes != planes * expansion)
+                x = blk(planes, stride=s, downsample=ds, dtype=self.dtype,
+                        name=f"layer{stage + 1}_{i}")(x, train)
+                inplanes = planes * expansion
+        x = _pool(x, "avg", 3, 3)
+        x = x.reshape((x.shape[0], -1))
+        x1 = nn.Dense(512, dtype=self.dtype, name="fc")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x1)
+        return x.astype(jnp.float32), x1.astype(jnp.float32)

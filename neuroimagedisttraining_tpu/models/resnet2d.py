@@ -1,0 +1,101 @@
+"""2D ResNet-18 family for CIFAR / TinyImageNet.
+
+Parity with fedml_api/model/cv/resnet.py: ``ResNet(BasicBlock, [2,2,2,2])``
+with a 3x3 stem and no stem max-pool (CIFAR style, resnet.py:50-63);
+``customized_resnet18`` swaps every BatchNorm for GroupNorm(32)
+(resnet.py:96-125); ``original_resnet18`` keeps BatchNorm (resnet.py:127-131);
+``tiny_resnet18`` adds adaptive average pooling for 64x64 TinyImageNet inputs
+(resnet.py:134-213). Layout NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class _Norm(nn.Module):
+    """BatchNorm or GroupNorm(32), selected by ``kind``."""
+    kind: str  # "bn" | "gn"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.kind == "gn":
+            return nn.GroupNorm(num_groups=32, dtype=jnp.float32, name="norm")(x)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                            epsilon=1e-5, dtype=jnp.float32, name="norm")(x)
+
+
+class BasicBlock2D(nn.Module):
+    planes: int
+    stride: int = 1
+    norm: str = "bn"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out = nn.Conv(self.planes, (3, 3), strides=(self.stride,) * 2,
+                      padding=[(1, 1)] * 2, use_bias=False, dtype=self.dtype,
+                      name="conv1")(x)
+        out = nn.relu(_Norm(self.norm, name="bn1")(out, train))
+        out = nn.Conv(self.planes, (3, 3), padding=[(1, 1)] * 2, use_bias=False,
+                      dtype=self.dtype, name="conv2")(out)
+        out = _Norm(self.norm, name="bn2")(out, train)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            x = nn.Conv(self.planes, (1, 1), strides=(self.stride,) * 2,
+                        use_bias=False, dtype=self.dtype, name="sc_conv")(x)
+            x = _Norm(self.norm, name="sc_bn")(x, train)
+        return nn.relu(out + x)
+
+
+class ResNet18(nn.Module):
+    """CIFAR-style ResNet-18: 3x3 stem, 4 stages of 2 basic blocks,
+    4x4 avg-pool head (resnet.py:42-91). ``adaptive_pool=True`` gives the
+    TinyImageNet global-pool variant (resnet.py:134-186)."""
+    num_classes: int = 10
+    norm: str = "bn"
+    num_blocks: Sequence[int] = (2, 2, 2, 2)
+    adaptive_pool: bool = False
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (3, 3), padding=[(1, 1)] * 2, use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(_Norm(self.norm, name="bn1")(x, train))
+        for stage, (planes, blocks) in enumerate(
+                zip((64, 128, 256, 512), self.num_blocks)):
+            for i in range(blocks):
+                s = (1 if stage == 0 else 2) if i == 0 else 1
+                x = BasicBlock2D(planes, stride=s, norm=self.norm,
+                                 dtype=self.dtype,
+                                 name=f"layer{stage + 1}_{i}")(x, train)
+        if self.adaptive_pool:
+            x = jnp.mean(x, axis=(1, 2))
+        else:
+            x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+            x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="linear")(x)
+        return x.astype(jnp.float32)
+
+
+def customized_resnet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet18:
+    """GroupNorm ResNet-18 (resnet.py:96-125)."""
+    return ResNet18(num_classes=num_classes, norm="gn", dtype=dtype)
+
+
+def original_resnet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet18:
+    """BatchNorm ResNet-18 (resnet.py:127-131)."""
+    return ResNet18(num_classes=num_classes, norm="bn", dtype=dtype)
+
+
+def tiny_resnet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet18:
+    """GroupNorm ResNet-18 with global average pooling (resnet.py:188-213)."""
+    return ResNet18(num_classes=num_classes, norm="gn", adaptive_pool=True,
+                    dtype=dtype)

@@ -1,0 +1,173 @@
+"""Small 2D vision models: VGG, CIFAR CNNs, FedAvg-paper CNNs, LeNet-5.
+
+Parity targets in the reference:
+- VGG11/16 with optional GroupNorm(32) (fedml_api/model/cv/vgg.py:14-88).
+- ``cnn_cifar10``/``cnn_cifar100`` 2-conv + 3-fc nets
+  (cnn_cifar10.py:12-52).
+- ``CNN_OriginalFedAvg`` (McMahan et al. MNIST CNN) and ``CNN_DropOut``
+  (Adaptive Federated Optimization EMNIST CNN) (cnn.py:6-160).
+- ``LeNet5`` (Caffe variant, no padding in conv1) and ``LeNet5_cifar``
+  (lenet5.py:4-47).
+
+All NHWC; MNIST-family models accept [B, 28, 28] or [B, 28, 28, 1].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+VGG_CFG = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    """VGG feature stack + single linear classifier (vgg.py:14-60)."""
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 10
+    group_norm: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        i = 0
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), padding=[(1, 1)] * 2,
+                            dtype=self.dtype, name=f"conv{i}")(x)
+                if self.group_norm:
+                    x = nn.GroupNorm(num_groups=32, dtype=jnp.float32,
+                                     name=f"gn{i}")(x)
+                x = nn.relu(x)
+                i += 1
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+def vgg11(num_classes: int = 10, dtype=jnp.float32) -> VGG:
+    return VGG(VGG_CFG["A"], num_classes=num_classes, dtype=dtype)
+
+
+def vgg16(num_classes: int = 10, dtype=jnp.float32) -> VGG:
+    return VGG(VGG_CFG["D"], num_classes=num_classes, dtype=dtype)
+
+
+class CNNCifar(nn.Module):
+    """2x(conv5 + maxpool2) + fc 384/192/n (cnn_cifar10.py:12-52; the
+    cifar100 variant differs only in ``num_classes``)."""
+    num_classes: int = 10
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(64, (5, 5), padding="VALID", dtype=self.dtype,
+                            name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="VALID", dtype=self.dtype,
+                            name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(384, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(192, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc3")(x)
+        return x.astype(jnp.float32)
+
+
+def _ensure_channel(x):
+    return x[..., None] if x.ndim == 3 else x
+
+
+class CNN_OriginalFedAvg(nn.Module):
+    """FedAvg-paper MNIST CNN, 1,663,370 params with only_digits (cnn.py:6-74)."""
+    only_digits: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _ensure_channel(x).astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (5, 5), padding=[(2, 2)] * 2, dtype=self.dtype,
+                            name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding=[(2, 2)] * 2, dtype=self.dtype,
+                            name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dense(10 if self.only_digits else 62, dtype=self.dtype,
+                     name="fc2")(x)
+        return x.astype(jnp.float32)
+
+
+class CNN_DropOut(nn.Module):
+    """Adaptive-Federated-Optimization EMNIST CNN (cnn.py:77-160)."""
+    only_digits: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _ensure_channel(x).astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype,
+                            name="conv1")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype,
+                            name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(10 if self.only_digits else 62, dtype=self.dtype,
+                     name="fc2")(x)
+        return x.astype(jnp.float32)
+
+
+class LeNet5(nn.Module):
+    """Caffe-style LeNet-5, no padding in conv1 (lenet5.py:4-27)."""
+    num_classes: int = 10
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _ensure_channel(x).astype(self.dtype)
+        x = nn.relu(nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype,
+                            name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(50, (5, 5), padding="VALID", dtype=self.dtype,
+                            name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(500, dtype=self.dtype, name="fc3")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc4")(x)
+        return x.astype(jnp.float32)
+
+
+class LeNet5_cifar(nn.Module):
+    """CIFAR LeNet (lenet5.py:29-47)."""
+    num_classes: int = 10
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype,
+                            name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype,
+                            name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc3")(x)
+        return x.astype(jnp.float32)

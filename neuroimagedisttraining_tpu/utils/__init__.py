@@ -1,0 +1,2 @@
+from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger, get_logger  # noqa: F401
+from neuroimagedisttraining_tpu.utils import pytree  # noqa: F401

@@ -1,0 +1,84 @@
+"""Experiment logging.
+
+Replaces the reference's per-experiment ``logging.FileHandler`` under
+``LOG/<dataset>/<identity>.log`` (main_sailentgrads.py:184-192) with the same
+file layout plus a structured round-indexed JSONL metrics stream, which the
+reference lacked (its metrics lived only in free-text log lines).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Mapping
+
+
+def get_logger(name: str = "nidt", process_id: int = 0) -> logging.Logger:
+    """Console logger with process id in the format, mirroring
+    fedml_api/utils/logger.py:7-33."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(logging.Formatter(
+            f"[p{process_id}] %(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+class ExperimentLogger:
+    """File log + JSONL metrics for one experiment identity."""
+
+    def __init__(self, log_dir: str, dataset: str, identity: str,
+                 console: bool = True):
+        self.dir = os.path.join(log_dir, dataset)
+        os.makedirs(self.dir, exist_ok=True)
+        self.identity = identity
+        self.log_path = os.path.join(self.dir, identity + ".log")
+        self.jsonl_path = os.path.join(self.dir, identity + ".metrics.jsonl")
+        self._log = logging.getLogger(f"nidt.exp.{identity}")
+        self._log.setLevel(logging.INFO)
+        self._log.propagate = False
+        fh = logging.FileHandler(self.log_path)
+        fh.setFormatter(logging.Formatter("%(message)s"))  # message-only parity
+        self._log.addHandler(fh)
+        if console:
+            ch = logging.StreamHandler(sys.stdout)
+            ch.setFormatter(logging.Formatter("%(message)s"))
+            self._log.addHandler(ch)
+        self._t0 = time.monotonic()
+
+    def info(self, msg: str, *args: Any) -> None:
+        self._log.info(msg, *args)
+
+    def metrics(self, round_idx: int, **values: Any) -> None:
+        """Append one structured metrics record for a round."""
+        rec: dict[str, Any] = {"round": int(round_idx),
+                               "t": round(time.monotonic() - self._t0, 3)}
+        for k, v in values.items():
+            rec[k] = _jsonable(v)
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        self._log.info("round %d metrics: %s", round_idx,
+                       {k: rec[k] for k in values})
+
+    def close(self) -> None:
+        for h in list(self._log.handlers):
+            h.close()
+            self._log.removeHandler(h)
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, Mapping):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if hasattr(v, "item") and getattr(v, "ndim", None) == 0:
+        return v.item()
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    return v

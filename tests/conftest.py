@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax
+import, so multi-client mesh sharding is exercised without TPU hardware
+(SURVEY.md §4 implication: mesh-simulated backend stands in for multi-node)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def synthetic_cohort():
+    from neuroimagedisttraining_tpu.data.synthetic import generate_synthetic_abcd
+
+    return generate_synthetic_abcd(num_subjects=96, shape=(12, 14, 12),
+                                   num_sites=4, seed=0)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
