@@ -1,0 +1,114 @@
+"""Client partitioners.
+
+- ``site_partition``: ABCD acquisition-site clients with per-site 80/20
+  train/test split, np.random.seed(42)-shuffle parity
+  (reference ABCD/data_loader.py:67-99: per site, seed reset to 42, shuffle,
+  first n - int(0.2*n) train, rest test).
+- ``rescale_partition``: merge-all-then-contiguous-shard cross-silo scale-out
+  path (data_loader.py:216-315 ``load_partition_data_abcd_rescale``).
+- ``dirichlet_partition``: LDA non-IID partitioner ported semantically from
+  fedml_core/non_iid_partition/noniid_partition.py:6-73, including the
+  min-10-samples retry loop and the capacity correction
+  ``p * (len(idx_j) < N/num_clients)``.
+- ``homo_partition``: IID equal random split (cifar10/data_loader.py homo mode).
+- ``record_data_stats``: per-client class histogram (noniid_partition.py:76-103).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def site_partition(site: np.ndarray, seed: int = 42, test_frac: float = 0.2
+                   ) -> tuple[dict[int, np.ndarray], dict[int, np.ndarray], np.ndarray]:
+    """Returns (train_idx_by_client, test_idx_by_client, site_values)."""
+    unique_sites = np.unique(site)
+    train_map, test_map = {}, {}
+    for client, s in enumerate(unique_sites):
+        idx = np.where(site == s)[0]
+        n_test = int(len(idx) * test_frac)
+        n_train = len(idx) - n_test
+        rs = np.random.RandomState(seed)
+        rs.shuffle(idx)
+        train_map[client] = idx[:n_train]
+        test_map[client] = idx[n_train:]
+    return train_map, test_map, unique_sites
+
+
+def rescale_partition(n: int, client_number: int, seed: int = 42,
+                      test_frac: float = 0.2
+                      ) -> tuple[dict[int, np.ndarray], dict[int, np.ndarray]]:
+    """Global shuffle + 80/20 split + contiguous equal shards per client
+    (data_loader.py:216-315)."""
+    idx = np.arange(n)
+    rs = np.random.RandomState(seed)
+    rs.shuffle(idx)
+    n_test = int(n * test_frac)
+    train_idx, test_idx = idx[: n - n_test], idx[n - n_test:]
+    train_map = {c: np.sort(a) for c, a in
+                 enumerate(np.array_split(train_idx, client_number))}
+    test_map = {c: np.sort(a) for c, a in
+                enumerate(np.array_split(test_idx, client_number))}
+    return train_map, test_map
+
+
+def dirichlet_partition(labels: np.ndarray, client_number: int, alpha: float,
+                        seed: int = 0, min_size_floor: int = 10
+                        ) -> dict[int, np.ndarray]:
+    """LDA partition of sample indices over clients
+    (noniid_partition.py:6-73 semantics)."""
+    rs = np.random.RandomState(seed)
+    n = len(labels)
+    classes = np.unique(labels)
+    min_size = 0
+    idx_batch: list[list[int]] = [[] for _ in range(client_number)]
+    while min_size < min_size_floor:
+        idx_batch = [[] for _ in range(client_number)]
+        for k in classes:
+            idx_k = np.where(labels == k)[0]
+            rs.shuffle(idx_k)
+            p = rs.dirichlet(np.repeat(alpha, client_number))
+            # capacity correction: zero out clients already at quota
+            # (noniid_partition.py:31-35)
+            p = np.array([pi * (len(ib) < n / client_number)
+                          for pi, ib in zip(p, idx_batch)])
+            p = p / p.sum()
+            cuts = (np.cumsum(p) * len(idx_k)).astype(int)[:-1]
+            idx_batch = [ib + part.tolist()
+                         for ib, part in zip(idx_batch, np.split(idx_k, cuts))]
+        min_size = min(len(ib) for ib in idx_batch)
+    return {c: np.array(sorted(ib), dtype=np.int64)
+            for c, ib in enumerate(idx_batch)}
+
+
+def homo_partition(n: int, client_number: int, seed: int = 0
+                   ) -> dict[int, np.ndarray]:
+    rs = np.random.RandomState(seed)
+    idx = rs.permutation(n)
+    return {c: np.sort(a) for c, a in
+            enumerate(np.array_split(idx, client_number))}
+
+
+def train_test_split_per_client(idx_map: dict[int, np.ndarray], seed: int = 42,
+                                test_frac: float = 0.2
+                                ) -> tuple[dict[int, np.ndarray], dict[int, np.ndarray]]:
+    """80/20 split inside each client's shard (for non-site partitions)."""
+    train_map, test_map = {}, {}
+    for c, idx in idx_map.items():
+        idx = np.array(idx, copy=True)
+        rs = np.random.RandomState(seed)
+        rs.shuffle(idx)
+        n_test = int(len(idx) * test_frac)
+        train_map[c] = idx[: len(idx) - n_test]
+        test_map[c] = idx[len(idx) - n_test:]
+    return train_map, test_map
+
+
+def record_data_stats(labels: np.ndarray, idx_map: dict[int, np.ndarray]
+                      ) -> dict[int, dict[int, int]]:
+    """Per-client {class: count} census (noniid_partition.py:76-103)."""
+    stats = {}
+    for c, idx in idx_map.items():
+        uniq, counts = np.unique(labels[idx], return_counts=True)
+        stats[c] = {int(u): int(cnt) for u, cnt in zip(uniq, counts)}
+    return stats

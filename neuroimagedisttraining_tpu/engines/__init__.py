@@ -1,0 +1,16 @@
+"""Algorithm engines (the reference's fedml_api/standalone family)."""
+
+from neuroimagedisttraining_tpu.engines.base import FederatedEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.fedavg import FedAvgEngine  # noqa: F401
+
+ENGINES = {
+    "fedavg": FedAvgEngine,
+}
+
+
+def create_engine(name: str, *args, **kwargs) -> FederatedEngine:
+    try:
+        cls = ENGINES[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown algorithm {name!r}; have {sorted(ENGINES)}")
+    return cls(*args, **kwargs)

@@ -1,0 +1,169 @@
+"""Shared federated-simulation substrate for all algorithm engines.
+
+Every reference engine has the same shape (SURVEY.md §2.4): constructor takes
+the dataset + trainer, ``.train()`` runs ``comm_round`` rounds of
+{sample clients -> local train -> aggregate -> evaluate}. Here that shape is
+factored once: subclasses provide jitted round programs; this base provides
+model/state initialization, reference-parity client sampling
+(np.random.seed(round_idx), fedavg_api.py:92-100), full-cohort evaluation
+(global + personalized, sailentgrads_api.py:231-285), metrics logging, and
+the ``stat_info`` accumulators (sailentgrads_api.py:334-346).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.config import ExperimentConfig
+from neuroimagedisttraining_tpu.core.losses import binary_auc
+from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer
+from neuroimagedisttraining_tpu.core.optim import round_lr
+from neuroimagedisttraining_tpu.data.federate import FederatedData
+from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger, get_logger
+from neuroimagedisttraining_tpu.utils import pytree as pt
+
+PyTree = Any
+
+
+class FederatedEngine:
+    """Base class: owns config, trainer, data, mesh, logging, eval."""
+
+    name = "base"
+
+    def __init__(self, cfg: ExperimentConfig, fed_data: FederatedData,
+                 trainer: LocalTrainer, mesh=None,
+                 logger: ExperimentLogger | None = None):
+        self.cfg = cfg
+        self.data = fed_data
+        self.trainer = trainer
+        self.mesh = mesh
+        self.log = logger or ExperimentLogger(cfg.log_dir, cfg.data.dataset,
+                                              cfg.identity())
+        self._console = get_logger()
+        self.num_clients = int(fed_data.num_clients)  # includes mesh padding
+        self.real_clients = int(np.sum(np.asarray(fed_data.n_train) > 0))
+        self.stat_info: dict[str, Any] = {
+            "sum_comm_params": 0.0, "sum_training_flops": 0.0,
+            "global_test_acc": [], "person_test_acc": [],
+            "final_masks": [],
+        }
+
+    # ---------- state init ----------
+
+    def sample_input(self) -> jax.Array:
+        x = self.data.X_train[0, :1]
+        return jnp.zeros(x.shape, jnp.float32)
+
+    def init_global_state(self) -> ClientState:
+        rng = jax.random.key(self.cfg.seed)
+        return self.trainer.init_client_state(rng, self.sample_input())
+
+    def broadcast_states(self, cs: ClientState, n: int) -> ClientState:
+        """Replicate one state across a leading client axis of size n."""
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n,) + x.shape).copy()
+            if hasattr(x, "shape") else x, cs)
+
+    def per_client_rngs(self, round_idx: int, idx: np.ndarray) -> jax.Array:
+        base = jax.random.fold_in(jax.random.key(self.cfg.seed + 17),
+                                  round_idx)
+        return jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.asarray(idx, jnp.uint32))
+
+    # ---------- sampling (reference parity) ----------
+
+    def client_sampling(self, round_idx: int) -> np.ndarray:
+        """np.random.seed(round_idx); choice without replacement
+        (fedavg_api.py:92-100). Sampling is over REAL clients only; mesh
+        padding clients never train."""
+        total = self.real_clients
+        per_round = min(self.cfg.fed.client_num_per_round, total)
+        if total == per_round:
+            return np.arange(total)
+        np.random.seed(round_idx)
+        return np.sort(np.random.choice(range(total), per_round,
+                                        replace=False))
+
+    # ---------- evaluation ----------
+
+    @functools.cached_property
+    def _eval_global_jit(self):
+        trainer = self.trainer
+
+        def eval_all(params, bstats, X, y, n):
+            def per_client(Xc, yc, nc):
+                valid = jnp.arange(Xc.shape[0]) < nc
+                m = trainer.evaluate(params, bstats, Xc, yc, valid)
+                auc = binary_auc(m["scores"], yc, valid)
+                return m["test_correct"], m["test_loss"], m["test_total"], auc
+
+            return jax.vmap(per_client)(X, y, n)
+
+        return jax.jit(eval_all)
+
+    @functools.cached_property
+    def _eval_personal_jit(self):
+        trainer = self.trainer
+
+        def eval_all(params, bstats, X, y, n):
+            def per_client(p, b, Xc, yc, nc):
+                valid = jnp.arange(Xc.shape[0]) < nc
+                m = trainer.evaluate(p, b, Xc, yc, valid)
+                auc = binary_auc(m["scores"], yc, valid)
+                return m["test_correct"], m["test_loss"], m["test_total"], auc
+
+            return jax.vmap(per_client)(params, bstats, X, y, n)
+
+        return jax.jit(eval_all)
+
+    def _summarize(self, correct, loss, total, auc, n) -> dict[str, float]:
+        """Average of per-client ratios over clients with data — parity with
+        the reference's mean-over-clients metric (sailentgrads_api.py:266-285)."""
+        correct, loss, total, auc, n = map(np.asarray,
+                                           (correct, loss, total, auc, n))
+        mask = n > 0
+        accs = correct[mask] / np.maximum(total[mask], 1)
+        losses = loss[mask] / np.maximum(total[mask], 1)
+        return {
+            "acc": float(np.mean(accs)),
+            "loss": float(np.mean(losses)),
+            "auc": float(np.mean(auc[mask])),
+            "acc_pooled": float(correct[mask].sum() / max(total[mask].sum(), 1)),
+        }
+
+    def eval_global(self, params, bstats, split: str = "test") -> dict[str, float]:
+        X = getattr(self.data, f"X_{split}")
+        y = getattr(self.data, f"y_{split}")
+        n = getattr(self.data, f"n_{split}")
+        if self.cfg.fed.ci:  # CI escape hatch: client 0 only
+            X, y, n = X[:1], y[:1], n[:1]
+        out = self._eval_global_jit(params, bstats, X, y, n)
+        return self._summarize(*out, n=n if not self.cfg.fed.ci else n[:1])
+
+    def eval_personalized(self, states: ClientState, split: str = "test"
+                          ) -> dict[str, float]:
+        X = getattr(self.data, f"X_{split}")
+        y = getattr(self.data, f"y_{split}")
+        n = getattr(self.data, f"n_{split}")
+        out = self._eval_personal_jit(states.params, states.batch_stats,
+                                      X, y, n)
+        return self._summarize(*out, n=n)
+
+    # ---------- helpers ----------
+
+    def round_lr(self, round_idx: int):
+        return round_lr(self.cfg.optim, round_idx)
+
+    def weights_for(self, sampled: np.ndarray) -> jax.Array:
+        """FedAvg weights = per-client sample counts of the sampled set
+        (fedavg_api.py:102-117)."""
+        n = jnp.asarray(self.data.n_train)[jnp.asarray(sampled)]
+        return n.astype(jnp.float32)
+
+    def train(self) -> dict[str, Any]:
+        raise NotImplementedError

@@ -17,6 +17,7 @@ from neuroimagedisttraining_tpu.models.neuro3d import (  # noqa: F401
     BasicBlock3D,
     Bottleneck3D,
     ResNet3D_l3,
+    Tiny3DCNN,
 )
 from neuroimagedisttraining_tpu.models.resnet2d import (  # noqa: F401
     ResNet18,
@@ -45,6 +46,8 @@ def create_model(name: str, num_classes: int = 1, dtype=jnp.float32):
         return AlexNet3D_Deeper_Dropout(num_classes=num_classes, dtype=dtype)
     if name in ("3dcnn_regression", "alexnet3d_dropout_regression"):
         return AlexNet3D_Dropout_Regression(num_classes=num_classes, dtype=dtype)
+    if name in ("3dcnn_tiny", "tiny3dcnn"):
+        return Tiny3DCNN(num_classes=num_classes, dtype=dtype)
     if name in ("resnet3d", "resnet_l3", "resnet3d_l3"):
         return ResNet3D_l3(num_classes=num_classes, dtype=dtype)
     if name in ("resnet18", "customized_resnet18"):

@@ -129,6 +129,29 @@ class AlexNet3D_Dropout_Regression(nn.Module):
         return jnp.squeeze(x.astype(jnp.float32)), xp.astype(jnp.float32)
 
 
+class Tiny3DCNN(nn.Module):
+    """Small 2-conv 3D CNN for CI/tests on small synthetic volumes — the
+    structural miniature of AlexNet3D_Dropout (conv-BN-relu-pool x2 + MLP
+    head). Not in the reference zoo; serves its ``--ci`` fast-path role
+    (sailentgrads_api.py:260-265) with real Conv3D+BN+Dropout semantics."""
+    num_classes: int = 1
+    width: int = 8
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBNReLU3D(self.width, kernel=3, dtype=self.dtype, name="f0")(x, train)
+        x = _pool(x, "max", 2, 2)
+        x = ConvBNReLU3D(self.width * 2, kernel=3, dtype=self.dtype, name="f1")(x, train)
+        x = _pool(x, "max", 2, 2)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(32, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc2")(x)
+        return x.astype(jnp.float32)
+
+
 class BasicBlock3D(nn.Module):
     """3D residual basic block (salient_models.py:13-42)."""
     planes: int

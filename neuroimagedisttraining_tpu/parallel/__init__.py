@@ -1,0 +1,7 @@
+from neuroimagedisttraining_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    client_sharding,
+    replicated_sharding,
+    shard_federation,
+)
+from neuroimagedisttraining_tpu.parallel import topology  # noqa: F401

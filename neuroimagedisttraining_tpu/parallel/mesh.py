@@ -1,0 +1,55 @@
+"""Device mesh + sharding layout for federated simulation.
+
+The reference simulates clients with a sequential Python loop on one GPU
+(sailentgrads_api.py:126-138). Here the client axis IS a mesh axis: stacked
+client pytrees (``[C, ...]``) are sharded over ``Mesh(axis="clients")`` so
+each TPU core trains ``C/ndev`` clients in parallel inside one jitted round
+program, and cross-client reductions (FedAvg, score means, gossip) lower to
+XLA collectives over ICI (SURVEY.md §2.10, BASELINE.json north star).
+
+On multi-host slices the same mesh spans all devices; host-local data feeding
+uses ``jax.make_array_from_process_local_data`` (data layer) and collectives
+ride ICI/DCN as laid out by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+CLIENT_AXIS = "clients"
+
+
+def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over all (or the first N) visible devices, axis "clients"."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (CLIENT_AXIS,))
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading axis sharded over clients, rest replicated."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, d: int) -> int:
+    return ((n + d - 1) // d) * d
+
+
+def shard_federation(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Device-put a stacked client pytree with its leading axis sharded over
+    the mesh's client axis. Leading dim must be a multiple of the mesh size
+    (pad clients with zero-weight shards first if needed)."""
+    sh = client_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
