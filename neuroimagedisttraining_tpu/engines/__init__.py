@@ -2,9 +2,12 @@
 
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.fedavg import FedAvgEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.salientgrads import SalientGradsEngine  # noqa: F401
 
 ENGINES = {
     "fedavg": FedAvgEngine,
+    "salientgrads": SalientGradsEngine,
+    "sailentgrads": SalientGradsEngine,  # reference spelling
 }
 
 

@@ -70,8 +70,10 @@ class FederatedEngine:
             if hasattr(x, "shape") else x, cs)
 
     def per_client_rngs(self, round_idx: int, idx: np.ndarray) -> jax.Array:
+        # +1 so the pre-training phase (round_idx=-1, SNIP scoring) folds a
+        # valid uint32
         base = jax.random.fold_in(jax.random.key(self.cfg.seed + 17),
-                                  round_idx)
+                                  round_idx + 1)
         return jax.vmap(lambda i: jax.random.fold_in(base, i))(
             jnp.asarray(idx, jnp.uint32))
 

@@ -1,0 +1,195 @@
+"""SalientGrads: one-shot federated SNIP mask + masked-sparse FedAvg.
+
+The flagship algorithm (fedml_api/standalone/sailentgrads/sailentgrads_api.py).
+Behavior parity:
+
+- PHASE 1 (once, before training): every client computes SNIP saliency
+  scores on its own data (IterSNIP over ``itersnip_iteration`` batches,
+  client.py:30-53); the server averages score dicts (snip.py:120-140) and
+  builds ONE global cross-layer top-(dense_ratio) binary mask
+  (snip.py:80-116). Dense escape hatch: ``snip_mask=False`` -> all-ones
+  masks (sailentgrads_api.py:94-100).
+- PHASE 2 (rounds): sampled clients train from the global model with
+  post-step re-masking ``param *= mask`` (my_model_trainer.py:228-231);
+  sample-weighted FedAvg over the sampled set (sailentgrads_api.py:212-227);
+  each client's personal model is its most recent local-train result
+  (sailentgrads_api.py:128-136); global + personal eval every round.
+
+TPU-native: phase 1 is one jitted program — per-client scores vmapped over
+the client-sharded mesh, the score mean is an ICI all-reduce, and the global
+top-k threshold runs the Pallas histogram-select kernel. Phase 2 rounds are
+the same single-program SPMD shape as FedAvg.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.ops import flops as flops_ops
+from neuroimagedisttraining_tpu.ops import snip as snip_ops
+from neuroimagedisttraining_tpu.ops.masks import mask_density, ones_mask
+from neuroimagedisttraining_tpu.utils import pytree as pt
+
+
+class SalientGradsEngine(FederatedEngine):
+    name = "salientgrads"
+
+    # ---------- phase 1: the global mask ----------
+
+    @functools.cached_property
+    def _scores_jit(self):
+        trainer = self.trainer
+        s = self.cfg.sparsity
+        o = self.cfg.optim
+        C = self.num_clients
+
+        def scores_fn(params, bstats, data, rngs):
+            cs = ClientState(
+                params=jax.tree.map(
+                    lambda x: jnp.broadcast_to(x, (C,) + x.shape), params),
+                batch_stats=jax.tree.map(
+                    lambda x: jnp.broadcast_to(x, (C,) + x.shape), bstats),
+                opt_state=jax.tree.map(
+                    lambda x: jnp.broadcast_to(x, (C,) + x.shape),
+                    trainer.opt.init(params)),
+                rng=rngs,
+            )
+
+            def per_client(cs_c, Xc, yc, nc):
+                sc = snip_ops.iter_snip_scores(
+                    trainer, cs_c, Xc, yc, nc,
+                    iterations=s.itersnip_iterations, batch_size=o.batch_size)
+                # zero-weight padding clients contribute nothing
+                w = (nc > 0).astype(jnp.float32)
+                return jax.tree.map(lambda t: t * w, sc), w
+
+            per, w = jax.vmap(per_client)(cs, data.X_train, data.y_train,
+                                          data.n_train)
+            # mean over REAL clients (snip.py get_mean_snip_scores)
+            denom = jnp.maximum(jnp.sum(w), 1.0)
+            return jax.tree.map(lambda t: jnp.sum(t, axis=0) / denom, per)
+
+        return jax.jit(scores_fn)
+
+    def generate_global_mask(self, params, bstats):
+        """Phase-1 pipeline (sailentgrads_api.py:47-66)."""
+        rngs = self.per_client_rngs(-1, np.arange(self.num_clients))
+        scores = self._scores_jit(params, bstats, self.data, rngs)
+        masks, thr = snip_ops.mask_from_scores(
+            scores, keep_ratio=self.cfg.sparsity.dense_ratio)
+        if not self.cfg.sparsity.snip_mask:
+            masks = ones_mask(params)  # dense escape hatch
+        return masks, thr
+
+    # ---------- phase 2: masked rounds ----------
+
+    @functools.cached_property
+    def _round_jit(self):
+        trainer = self.trainer
+        o = self.cfg.optim
+        S = min(self.cfg.fed.client_num_per_round, self.real_clients)
+        max_samples = int(self.data.X_train.shape[1])
+
+        def round_fn(params, bstats, per_params, per_bstats, data, masks,
+                     sampled_idx, rngs, lr):
+            Xs = jnp.take(data.X_train, sampled_idx, axis=0)
+            ys = jnp.take(data.y_train, sampled_idx, axis=0)
+            ns = jnp.take(data.n_train, sampled_idx, axis=0)
+            cs = ClientState(
+                params=jax.tree.map(
+                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
+                batch_stats=jax.tree.map(
+                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), bstats),
+                opt_state=jax.tree.map(
+                    lambda x: jnp.broadcast_to(x, (S,) + x.shape),
+                    trainer.opt.init(params)),
+                rng=rngs,
+            )
+
+            def local(cs_c, Xc, yc, nc):
+                return trainer.local_train(
+                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                    batch_size=o.batch_size, max_samples=max_samples,
+                    mask=masks)
+
+            cs, losses = jax.vmap(local, in_axes=(0, 0, 0, 0))(cs, Xs, ys, ns)
+            w = ns.astype(jnp.float32)
+            new_params = pt.tree_weighted_mean(cs.params, w)
+            new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+            # personal models <- this round's local results (scatter rows)
+            per_params = jax.tree.map(
+                lambda allp, newp: allp.at[sampled_idx].set(newp),
+                per_params, cs.params)
+            per_bstats = jax.tree.map(
+                lambda allp, newp: allp.at[sampled_idx].set(newp),
+                per_bstats, cs.batch_stats)
+            mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+            return new_params, new_bstats, per_params, per_bstats, mean_loss
+
+        return jax.jit(round_fn)
+
+    def train(self):
+        cfg = self.cfg
+        gs = self.init_global_state()
+        params, bstats = gs.params, gs.batch_stats
+
+        masks, thr = self.generate_global_mask(params, bstats)
+        density = float(mask_density(masks))
+        self.log.info("global SNIP mask density = %.4f (target %.4f)",
+                      density, cfg.sparsity.dense_ratio)
+        self.stat_info["mask_density"] = density
+        if cfg.sparsity.save_masks:
+            self.stat_info["final_masks"] = jax.tree.map(np.asarray, masks)
+
+        # flops/comm accounting (reference stat_info parity)
+        dens_map = flops_ops.densities_from_masks(masks)
+        flops_per_sample = flops_ops.count_training_flops_per_sample(
+            self.trainer.model, params, self.trainer._prep(self.sample_input()),
+            mask_density=dens_map, batch_stats=bstats)
+
+        per = self.broadcast_states(
+            ClientState(params=params, batch_stats=bstats,
+                        opt_state=self.trainer.opt.init(params),
+                        rng=gs.rng), self.num_clients)
+        per_params, per_bstats = per.params, per.batch_stats
+
+        history = []
+        for round_idx in range(cfg.fed.comm_round):
+            sampled = self.client_sampling(round_idx)
+            self.log.info("################ round %d: clients %s",
+                          round_idx, sampled.tolist())
+            rngs = self.per_client_rngs(round_idx, sampled)
+            params, bstats, per_params, per_bstats, loss = self._round_jit(
+                params, bstats, per_params, per_bstats, self.data, masks,
+                jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+            n_samples = float(np.sum(np.asarray(self.data.n_train)[sampled]))
+            self.stat_info["sum_training_flops"] += (
+                flops_per_sample * cfg.optim.epochs * n_samples)
+            self.stat_info["sum_comm_params"] += density * len(sampled)
+            if round_idx % cfg.fed.frequency_of_the_test == 0 \
+                    or round_idx == cfg.fed.comm_round - 1:
+                m = self.eval_global(params, bstats)
+                mp = self.eval_personalized(ClientState(
+                    params=per_params, batch_stats=per_bstats,
+                    opt_state=None, rng=None))
+                self.stat_info["global_test_acc"].append(m["acc"])
+                self.stat_info["person_test_acc"].append(mp["acc"])
+                self.log.metrics(round_idx, train_loss=loss, **m,
+                                 personal_acc=mp["acc"])
+                history.append({"round": round_idx,
+                                "train_loss": float(loss), **m,
+                                "personal_acc": mp["acc"]})
+        m_global = self.eval_global(params, bstats)
+        m_person = self.eval_personalized(ClientState(
+            params=per_params, batch_stats=per_bstats, opt_state=None,
+            rng=None))
+        self.log.metrics(-1, global_=m_global, personal=m_person)
+        return {"params": params, "batch_stats": bstats, "masks": masks,
+                "mask_density": density, "history": history,
+                "final_global": m_global, "final_personal": m_person}

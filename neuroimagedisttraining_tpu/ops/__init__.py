@@ -1,0 +1,1 @@
+from neuroimagedisttraining_tpu.ops import masks, snip, topk, flops  # noqa: F401

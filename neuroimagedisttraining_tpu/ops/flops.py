@@ -1,0 +1,124 @@
+"""Analytic FLOPs / communication-volume accounting.
+
+Replaces the reference's module-hook FLOPs census
+(fedml_api/utils/main_flops_counter.py:30-80) with a shape-based analytic
+pass over the model's captured intermediates: for fixed shapes this is exact
+and free (one ``jax.eval_shape``). Supports the reference's two modes —
+dense, and sparsity-aware where each conv/dense layer's MACs are scaled by
+its mask density (main_flops_counter counts nonzero weights). Training FLOPs
+= 3x inference (forward + ~2x backward), the reference's convention
+(model_trainer.py:39-47 via count_training_flops_per_sample).
+
+Communication volume = nonzero parameter count of the update pytree
+(model_trainer.py:49-53).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.ops.masks import is_weight_kernel
+from neuroimagedisttraining_tpu.utils.pytree import tree_map_with_path_names
+
+PyTree = Any
+
+
+def _collect_kernels(params: PyTree) -> dict[str, tuple[int, ...]]:
+    shapes: dict[str, tuple[int, ...]] = {}
+
+    def collect(name, leaf):
+        if is_weight_kernel(name, leaf):
+            shapes[name] = tuple(leaf.shape)
+        return leaf
+
+    tree_map_with_path_names(collect, params)
+    return shapes
+
+
+def count_inference_flops(model, params: PyTree, sample_x: jax.Array,
+                          mask_density: dict[str, float] | None = None,
+                          batch_stats: PyTree | None = None) -> float:
+    """FLOPs (MAC*2) of one forward pass at ``sample_x``'s shape.
+
+    Conv: 2 * prod(out_spatial) * prod(kernel_shape); Dense: 2 * in * out —
+    computed from captured intermediate output shapes. ``mask_density`` maps
+    kernel path -> kept fraction for sparsity-aware counting."""
+    out_shapes: dict[str, tuple[int, ...]] = {}
+    variables = {"params": params}
+    if batch_stats is not None and jax.tree.leaves(batch_stats):
+        variables["batch_stats"] = batch_stats
+
+    def run():
+        # train=True so BatchNorm needs no pre-existing running stats when
+        # ``batch_stats`` is not supplied; shapes are identical either way.
+        train = "batch_stats" not in variables
+        _, inter = model.apply(
+            variables, sample_x, train=train, capture_intermediates=True,
+            mutable=["intermediates", "batch_stats"],
+            rngs={"dropout": jax.random.key(0)} if train else None)
+        return inter
+
+    inter = jax.eval_shape(run)
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, prefix + (k,))
+        elif isinstance(node, (tuple, list)):
+            for v in node:
+                if hasattr(v, "shape"):
+                    out_shapes["/".join(prefix[:-1])] = tuple(v.shape)
+        elif hasattr(node, "shape"):
+            out_shapes["/".join(prefix[:-1])] = tuple(node.shape)
+
+    walk(inter.get("intermediates", inter), ())
+
+    total = 0.0
+    for name, kshape in _collect_kernels(params).items():
+        density = 1.0 if mask_density is None else float(
+            mask_density.get(name, 1.0))
+        macs_per_pos = float(np.prod(kshape))
+        mod_path = name.rsplit("/", 1)[0]  # e.g. "f0/conv"
+        if len(kshape) > 2:  # conv kernel [*k, Cin, Cout]
+            out = out_shapes.get(mod_path + "/__call__") or \
+                out_shapes.get(mod_path)
+            if out is None:
+                # fall back: cannot see the output map; assume 1 position
+                spatial = 1.0
+            else:
+                spatial = float(np.prod(out[1:-1]))  # NDHWC spatial dims
+            total += 2.0 * macs_per_pos * spatial * density
+        else:  # dense [in, out]
+            total += 2.0 * macs_per_pos * density
+    return total
+
+
+def count_training_flops_per_sample(model, params: PyTree,
+                                    sample_x: jax.Array,
+                                    mask_density: dict[str, float] | None = None,
+                                    batch_stats: PyTree | None = None
+                                    ) -> float:
+    """3x inference, reference convention (model_trainer.py:39-47)."""
+    return 3.0 * count_inference_flops(model, params, sample_x, mask_density,
+                                       batch_stats=batch_stats)
+
+
+def count_communication_params(update: PyTree) -> float:
+    """Nonzero entries of an update pytree (model_trainer.py:49-53)."""
+    return float(sum(int(jnp.sum(x != 0)) for x in jax.tree.leaves(update)))
+
+
+def densities_from_masks(masks: PyTree) -> dict[str, float]:
+    out: dict[str, float] = {}
+
+    def collect(name, m):
+        if is_weight_kernel(name, m):
+            out[name] = float(jnp.mean(m))
+        return m
+
+    tree_map_with_path_names(collect, masks)
+    return out

@@ -1,0 +1,206 @@
+"""Sparse-mask machinery shared by SalientGrads / DisPFL / Sub-FedAvg.
+
+Masks are pytrees congruent with ``params``: float 0/1 arrays for maskable
+leaves (conv/linear kernels — the reference masks ``Conv3d``/``Linear``
+``.weight`` only, snip.py:42-55) and ones elsewhere (snip.py:108-113).
+
+Ported semantics:
+- ``calculate_sparsities``: ERK (Erdos-Renyi-Kernel) layer sparsity with the
+  dense-layer escape loop, and uniform mode
+  (DisPFL/my_model_trainer.py:56-130, identical copy in sailentgrads).
+- ``init_masks``: per-layer random masks with exactly
+  ``(1-sparsity)*numel`` ones (my_model_trainer.py:32-43).
+- ``fire_mask``: cosine-annealed drop of the smallest-magnitude surviving
+  weights (DisPFL/client.py:71-82) — exact drop counts via rank-vs-dynamic-k
+  comparison instead of torch's dynamic index slicing.
+- ``regrow_mask``: regrow by largest gradient magnitude on currently-zero
+  positions, or random regrow under ``dis_gradient_check``
+  (DisPFL/client.py:85-99).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.utils.pytree import tree_map_with_path_names
+
+PyTree = Any
+
+
+def is_weight_kernel(name: str, leaf) -> bool:
+    """Maskable leaf: a conv/dense kernel (reference: Conv3d/Linear .weight)."""
+    return name.endswith("kernel") and getattr(leaf, "ndim", 0) >= 2
+
+
+def ones_mask(params: PyTree) -> PyTree:
+    return jax.tree.map(jnp.ones_like, params)
+
+
+def mask_density(masks: PyTree, params: PyTree | None = None) -> jax.Array:
+    """Fraction of kept weights over maskable leaves."""
+    num, den = 0.0, 0.0
+    flat = jax.tree_util.tree_leaves_with_path(masks)
+    for path, leaf in flat:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if is_weight_kernel(name, leaf):
+            num = num + jnp.sum(leaf)
+            den = den + leaf.size
+    return num / max(den, 1.0)
+
+
+def calculate_sparsities(params: PyTree, distribution: str = "ERK",
+                         dense_ratio: float = 0.5,
+                         erk_power_scale: float = 1.0,
+                         tabu: tuple[str, ...] = ()) -> dict[str, float]:
+    """Per-maskable-leaf target sparsity, keyed by '/'-joined param path.
+
+    ERK: sparsity_l = 1 - eps * ((sum shape_l / prod shape_l) ** power);
+    layers whose probability would exceed 1 are made dense and epsilon is
+    re-solved (my_model_trainer.py:56-130).
+    """
+    shapes: dict[str, tuple[int, ...]] = {}
+
+    def collect(name, leaf):
+        if is_weight_kernel(name, leaf):
+            shapes[name] = tuple(leaf.shape)
+        return leaf
+
+    tree_map_with_path_names(collect, params)
+
+    sparsities: dict[str, float] = {}
+    if distribution == "uniform":
+        for name in shapes:
+            sparsities[name] = 0.0 if name in tabu else 1.0 - dense_ratio
+        return sparsities
+
+    if distribution != "ERK":
+        raise ValueError(f"unknown distribution {distribution!r}")
+
+    density = dense_ratio
+    dense_layers = set(t for t in tabu if t in shapes)
+    while True:
+        divisor, rhs = 0.0, 0.0
+        raw_probabilities: dict[str, float] = {}
+        for name, shape in shapes.items():
+            n_param = float(np.prod(shape))
+            if name in dense_layers:
+                rhs -= n_param * (1.0 - density)
+            else:
+                rhs += n_param * density
+                raw_probabilities[name] = (
+                    float(np.sum(shape)) / float(np.prod(shape))
+                ) ** erk_power_scale
+                divisor += raw_probabilities[name] * n_param
+        epsilon = rhs / divisor
+        max_prob = max(raw_probabilities.values())
+        if max_prob * epsilon > 1:
+            for name, p in raw_probabilities.items():
+                if p == max_prob:
+                    dense_layers.add(name)
+        else:
+            break
+    for name in shapes:
+        if name in dense_layers:
+            sparsities[name] = 0.0
+        else:
+            sparsities[name] = 1.0 - epsilon * raw_probabilities[name]
+    return sparsities
+
+
+def init_masks(rng: jax.Array, params: PyTree,
+               sparsities: dict[str, float]) -> PyTree:
+    """Random binary masks with exactly floor((1-s)*numel) ones per maskable
+    leaf; ones elsewhere (my_model_trainer.py:32-43)."""
+    leaves_rng = {name: r for name, r in zip(
+        sorted(sparsities), jax.random.split(rng, max(len(sparsities), 1)))}
+
+    def build(name, leaf):
+        if name not in sparsities:
+            return jnp.ones_like(leaf)
+        dense_numel = int((1.0 - sparsities[name]) * leaf.size)
+        flat = jnp.zeros((leaf.size,), leaf.dtype)
+        perm = jax.random.permutation(leaves_rng[name], leaf.size)
+        flat = flat.at[perm[:dense_numel]].set(1)
+        return flat.reshape(leaf.shape)
+
+    return tree_map_with_path_names(build, params)
+
+
+def _rank_of(values: jax.Array, descending: bool = False) -> jax.Array:
+    """rank[i] = position of element i in the sorted order (stable)."""
+    order = jnp.argsort(-values if descending else values)
+    return jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+
+
+def fire_mask(masks: PyTree, weights: PyTree, round_idx, comm_round: int,
+              anneal_factor: float = 0.5) -> tuple[PyTree, dict]:
+    """Drop ceil(drop_ratio * nnz) smallest-|w| surviving weights per layer;
+    drop_ratio = anneal/2 * (1 + cos(round*pi/comm_round))
+    (DisPFL/client.py:71-82). Exact counts under jit via rank < k."""
+    drop_ratio = anneal_factor / 2.0 * (
+        1.0 + jnp.cos(round_idx * jnp.pi / comm_round))
+    num_remove = {}
+
+    def fire(name, m):
+        w = _by_name(weights, name)
+        if not is_weight_kernel(name, m):
+            return m
+        nnz = jnp.sum(m)
+        k = jnp.ceil(drop_ratio * nnz).astype(jnp.int32)
+        num_remove[name] = k
+        temp = jnp.where(m.reshape(-1) > 0, jnp.abs(w.reshape(-1)),
+                         jnp.float32(1e5))
+        rank = _rank_of(temp)
+        keep = (rank >= k).astype(m.dtype) * m.reshape(-1)
+        return keep.reshape(m.shape)
+
+    new_masks = tree_map_with_path_names(fire, masks)
+    return new_masks, num_remove
+
+
+def regrow_mask(masks: PyTree, num_remove: dict, gradient: PyTree | None,
+                rng: jax.Array | None = None,
+                dis_gradient_check: bool = False) -> PyTree:
+    """Regrow ``num_remove[name]`` positions per layer on zeros: by largest
+    |grad| (default) or uniformly at random (DisPFL/client.py:85-99)."""
+    names = sorted(num_remove)
+    rngs = {}
+    if dis_gradient_check:
+        assert rng is not None
+        rngs = {n: r for n, r in zip(names, jax.random.split(rng, max(len(names), 1)))}
+
+    def regrow(name, m):
+        if name not in num_remove:
+            return m
+        k = num_remove[name]
+        flat = m.reshape(-1)
+        if dis_gradient_check:
+            score = jnp.where(flat == 0,
+                              jax.random.uniform(rngs[name], flat.shape),
+                              -jnp.float32(1e5))
+        else:
+            g = _by_name(gradient, name).reshape(-1)
+            score = jnp.where(flat == 0, jnp.abs(g), -jnp.float32(1e5))
+        rank = _rank_of(score, descending=True)
+        return jnp.where(rank < k, jnp.ones_like(flat), flat).reshape(m.shape)
+
+    return tree_map_with_path_names(regrow, masks)
+
+
+def mask_hamming_distance(a: PyTree, b: PyTree) -> jax.Array:
+    """Total count of differing mask entries (slim_util.py:14-19 dist_masks)."""
+    parts = jax.tree.leaves(jax.tree.map(
+        lambda x, y: jnp.sum(jnp.abs(x - y)), a, b))
+    return jnp.sum(jnp.stack(parts))
+
+
+def _by_name(tree: PyTree, name: str):
+    node = tree
+    for part in name.split("/"):
+        node = node[part] if isinstance(node, dict) else node[int(part)]
+    return node

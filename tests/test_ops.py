@@ -1,0 +1,157 @@
+"""Sparsity ops tests: top-k selection vs numpy, ERK sparsities, mask init
+exact counts, fire/regrow semantics, SNIP identity, FLOPs counter."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.config import OptimConfig
+from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+from neuroimagedisttraining_tpu.models import Tiny3DCNN
+from neuroimagedisttraining_tpu.ops import flops as F
+from neuroimagedisttraining_tpu.ops import masks as M
+from neuroimagedisttraining_tpu.ops import snip as S
+from neuroimagedisttraining_tpu.ops.topk import kth_largest
+
+
+def test_kth_largest_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=100_003).astype(np.float32))
+    for k in (1, 7, 1000, 50_000, 100_003):
+        got = float(kth_largest(x, k))
+        want = float(np.sort(np.asarray(x))[::-1][k - 1])
+        assert got == pytest.approx(want, rel=1e-6), k
+        # mask semantics: >= threshold keeps at least k
+        assert int(np.sum(np.asarray(x) >= got)) >= k
+
+
+def test_kth_largest_with_duplicates():
+    x = jnp.asarray(np.array([1.0, 2.0, 2.0, 2.0, 3.0], np.float32))
+    assert float(kth_largest(x, 2)) == 2.0
+    assert float(kth_largest(x, 4)) == 2.0
+    assert float(kth_largest(x, 5)) == 1.0
+
+
+def _toy_trainer():
+    model = Tiny3DCNN(num_classes=1)
+    trainer = LocalTrainer(model, OptimConfig(batch_size=4), num_classes=1)
+    cs = trainer.init_client_state(jax.random.key(0),
+                                   jnp.zeros((1, 12, 12, 12, 1)))
+    return model, trainer, cs
+
+
+def test_erk_sparsities_hit_target_density():
+    _, _, cs = _toy_trainer()
+    for dr in (0.5, 0.2):
+        sp = M.calculate_sparsities(cs.params, "ERK", dense_ratio=dr)
+        shapes = {k: v for k, v in sp.items()}
+        assert shapes  # found maskable kernels
+        total = kept = 0
+        flat = jax.tree_util.tree_leaves_with_path(cs.params)
+        for path, leaf in flat:
+            name = "/".join(str(getattr(p, "key", p)) for p in path)
+            if name in sp:
+                total += leaf.size
+                kept += leaf.size * (1 - sp[name])
+        assert kept / total == pytest.approx(dr, rel=0.05)
+        assert all(0.0 <= s < 1.0 for s in sp.values())
+
+
+def test_uniform_sparsities():
+    _, _, cs = _toy_trainer()
+    sp = M.calculate_sparsities(cs.params, "uniform", dense_ratio=0.3)
+    assert all(s == pytest.approx(0.7) for s in sp.values())
+
+
+def test_init_masks_exact_counts_and_ones_elsewhere():
+    _, _, cs = _toy_trainer()
+    sp = M.calculate_sparsities(cs.params, "uniform", dense_ratio=0.5)
+    masks = M.init_masks(jax.random.key(1), cs.params, sp)
+    flat = jax.tree_util.tree_leaves_with_path(masks)
+    for path, m in flat:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if name in sp:
+            assert int(jnp.sum(m)) == int((1 - sp[name]) * m.size)
+        else:
+            assert bool(jnp.all(m == 1))
+
+
+def test_fire_and_regrow_roundtrip_preserves_nnz():
+    _, _, cs = _toy_trainer()
+    sp = M.calculate_sparsities(cs.params, "uniform", dense_ratio=0.5)
+    masks = M.init_masks(jax.random.key(1), cs.params, sp)
+    grads = jax.tree.map(
+        lambda p: jnp.asarray(
+            np.random.default_rng(3).normal(size=p.shape), jnp.float32),
+        cs.params)
+    fired, num_remove = M.fire_mask(masks, cs.params, round_idx=0,
+                                    comm_round=10, anneal_factor=0.5)
+    # fire drops exactly num_remove per layer
+    flat_m = jax.tree_util.tree_leaves_with_path(masks)
+    for path, m in flat_m:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if name in num_remove:
+            before = int(jnp.sum(m))
+            after = int(jnp.sum(M._by_name(fired, name)))
+            assert before - after == int(num_remove[name])
+    regrown = M.regrow_mask(fired, num_remove, grads)
+    for path, m in flat_m:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if name in num_remove:
+            assert int(jnp.sum(M._by_name(regrown, name))) == int(jnp.sum(m))
+
+
+def test_snip_score_equals_w_times_grad():
+    _, trainer, cs = _toy_trainer()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 12, 12, 12)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=4), jnp.int32)
+    scores = S.snip_scores(trainer, cs, x, y)
+    _, grads, _, _ = trainer.loss_and_grad(cs, x, y)
+    w = cs.params["f0"]["conv"]["kernel"]
+    g = grads["f0"]["conv"]["kernel"]
+    np.testing.assert_allclose(np.asarray(scores["f0"]["conv"]["kernel"]),
+                               np.abs(np.asarray(w) * np.asarray(g)),
+                               rtol=1e-5)
+    # bias leaves get zero scores
+    assert bool(jnp.all(scores["f0"]["conv"]["bias"] == 0))
+
+
+def test_mask_from_scores_keep_ratio():
+    _, trainer, cs = _toy_trainer()
+    rng = np.random.default_rng(0)
+    scores = jax.tree.map(
+        lambda p: jnp.asarray(np.abs(rng.normal(size=p.shape)), jnp.float32),
+        cs.params)
+    masks, thr = S.mask_from_scores(scores, keep_ratio=0.3)
+    total = kept = 0
+    flat = jax.tree_util.tree_leaves_with_path(masks)
+    for path, m in flat:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if M.is_weight_kernel(name, m):
+            total += m.size
+            kept += int(jnp.sum(m))
+        else:
+            assert bool(jnp.all(m == 1))
+    assert kept == pytest.approx(0.3 * total, rel=0.01)
+
+
+def test_flops_counter_conv_and_dense():
+    model, trainer, cs = _toy_trainer()
+    x = jnp.zeros((1, 12, 12, 12, 1))
+    dense_flops = F.count_inference_flops(model, cs.params, x)
+    # hand count (12^3 input): conv f0 VALID -> 10^3 spatial, kernel
+    # 3^3*1*8=216 MACs/pos -> 2*216*1000; pool2 -> 5^3; conv f1 -> 3^3,
+    # kernel 3^3*8*16=3456 -> 2*3456*27; pool2 -> 1^3, flatten 16;
+    # fc1: 2*16*32; fc2: 2*32*1
+    want = (2 * 216 * 1000) + (2 * 3456 * 27) + (2 * 16 * 32) + (2 * 32 * 1)
+    assert dense_flops == pytest.approx(want, rel=1e-6)
+    # sparsity-aware: half density halves kernel MACs
+    dens = {k: 0.5 for k in F.densities_from_masks(
+        jax.tree.map(jnp.ones_like, cs.params))}
+    sparse_flops = F.count_inference_flops(model, cs.params, x,
+                                           mask_density=dens)
+    assert sparse_flops == pytest.approx(dense_flops / 2, rel=1e-6)
+    assert F.count_training_flops_per_sample(model, cs.params, x) == \
+        pytest.approx(3 * dense_flops)
