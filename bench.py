@@ -1,0 +1,116 @@
+"""Benchmark: federated training throughput of the flagship workload.
+
+Measures the ABCD-sex-classification federated simulation — AlexNet3D_Dropout
+(bf16 compute, rematerialized conv blocks) over full-size 121x145x121
+volumes, 4 simulated site-clients, batch 16, torch-parity SGD with
+post-round weighted FedAvg aggregation — with MULTIPLE federated rounds
+compiled into one XLA program (``lax.scan`` over rounds), the TPU-native
+shape of the whole framework. Reports samples/second of federated local SGD
+(forward + backward + optimizer + aggregation).
+
+``vs_baseline`` compares against the reference's single-V100 sequential
+simulation. The reference publishes NO numbers (BASELINE.md), so the
+baseline constant below is an engineering estimate of AlexNet3D_Dropout
+training throughput on one V100 (torch 1.12, batch 16, 121^3 volumes,
+~0.25 s/step incl. HDF5 reads => ~64 samples/s). The north-star target in
+BASELINE.json is >= 8x on multi-chip; this bench runs on however many chips
+are visible (1 in the current harness).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+V100_BASELINE_SAMPLES_PER_SEC = 64.0  # documented estimate, see module docstring
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.config import OptimConfig
+    from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer
+    from neuroimagedisttraining_tpu.models import AlexNet3D_Dropout
+    from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
+
+    n_clients = 4          # simulated clients per chip
+    batch = 16             # reference canonical batch (BASELINE.md)
+    steps = 4              # local steps per client per round
+    rounds_per_call = 4    # federated rounds fused into one XLA program
+    shape = (121, 145, 121)
+    n_local = 64           # device-resident samples per client (uint8)
+
+    model = AlexNet3D_Dropout(num_classes=1, dtype=jnp.bfloat16)
+    trainer = LocalTrainer(model, OptimConfig(batch_size=batch, epochs=1),
+                           num_classes=1)
+
+    cs0 = trainer.init_client_state(jax.random.key(0),
+                                    jnp.zeros((1,) + shape, jnp.float32))
+    X = jax.random.randint(jax.random.key(2),
+                           (n_clients, n_local) + shape, 0, 255,
+                           dtype=jnp.int32).astype(jnp.uint8)
+    y = jax.random.randint(jax.random.key(3), (n_clients, n_local), 0, 2,
+                           dtype=jnp.int32)
+    n_valid = jnp.full((n_clients,), n_local, jnp.int32)
+    max_samples = steps * batch
+
+    def bcast(t):
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_clients,) + x.shape), t)
+
+    @jax.jit
+    def simulate(params, bstats, X, y, n_valid, rng):
+        w = n_valid.astype(jnp.float32)
+        def round_body(carry, r):
+            params, bstats, rng = carry
+            rng, sub = jax.random.split(rng)
+            cs = ClientState(params=bcast(params), batch_stats=bcast(bstats),
+                             opt_state=bcast(trainer.opt.init(params)),
+                             rng=jax.random.split(sub, n_clients))
+
+            def local(cs_c, Xc, yc, nc):
+                return trainer.local_train(cs_c, Xc, yc, nc,
+                                           jnp.float32(1e-3), epochs=1,
+                                           batch_size=batch,
+                                           max_samples=max_samples)
+
+            cs, losses = jax.vmap(local)(cs, X, y, n_valid)
+            params = tree_weighted_mean(cs.params, w)
+            bstats = tree_weighted_mean(cs.batch_stats, w)
+            return (params, bstats, rng), jnp.mean(losses)
+
+        (params, bstats, _), losses = jax.lax.scan(
+            round_body, (params, bstats, rng), jnp.arange(rounds_per_call))
+        return params, bstats, jnp.mean(losses)
+
+    params, bstats = cs0.params, cs0.batch_stats
+    # compile + warmup (first call includes compilation)
+    params, bstats, loss = simulate(params, bstats, X, y, n_valid,
+                                    jax.random.key(7))
+    float(loss)  # hard sync through the host
+
+    n_calls = 3
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        params, bstats, loss = simulate(params, bstats, X, y, n_valid,
+                                        jax.random.key(i))
+    float(loss)  # hard sync
+    dt = time.perf_counter() - t0
+
+    samples = n_calls * rounds_per_call * n_clients * steps * batch
+    sps = samples / dt
+    print(json.dumps({
+        "metric": "abcd_fedavg_train_samples_per_sec",
+        "value": round(sps, 2),
+        "unit": "samples/s (AlexNet3D 121x145x121, b16, 4 clients, "
+                "4 rounds/program)",
+        "vs_baseline": round(sps / V100_BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -110,12 +110,18 @@ class LocalTrainer:
 
     def local_train(self, cs: ClientState, X, y, n_valid, lr, epochs: int,
                     batch_size: int, max_samples: int,
-                    mask: PyTree | None = None):
+                    mask: PyTree | None = None,
+                    prox_lamda: float | None = None,
+                    prox_ref: PyTree | None = None):
         """E epochs of local SGD on device-resident (padded) client data.
 
         Returns ``(new_state, mean_loss)``. ``n_valid`` is the client's true
         sample count; steps beyond its per-epoch quota are masked no-ops so
         vmapped clients keep reference-parity update counts.
+
+        ``prox_lamda``/``prox_ref``: Ditto's personalized proximal pull,
+        applied after each optimizer step: ``w -= lr * lamda * (w - ref)``
+        (ditto/my_model_trainer.py:63-64).
         """
         steps_per_epoch = max(1, math.ceil(max_samples / batch_size))
         my_steps = jnp.ceil(n_valid / batch_size).astype(jnp.int32)
@@ -142,6 +148,10 @@ class LocalTrainer:
             params = jax.tree.map(jnp.add, state.params, updates)
             if mask is not None:
                 params = jax.tree.map(jnp.multiply, params, mask)
+            if prox_lamda is not None:
+                params = jax.tree.map(
+                    lambda w, ref: w - lr * prox_lamda * (w - ref),
+                    params, prox_ref)
 
             active = (t % steps_per_epoch) < my_steps
 

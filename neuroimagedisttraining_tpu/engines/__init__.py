@@ -3,11 +3,17 @@
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.fedavg import FedAvgEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.salientgrads import SalientGradsEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.local import LocalEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.ditto import DittoEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.dpsgd import DPSGDEngine  # noqa: F401
 
 ENGINES = {
     "fedavg": FedAvgEngine,
     "salientgrads": SalientGradsEngine,
     "sailentgrads": SalientGradsEngine,  # reference spelling
+    "local": LocalEngine,
+    "ditto": DittoEngine,
+    "dpsgd": DPSGDEngine,
 }
 
 

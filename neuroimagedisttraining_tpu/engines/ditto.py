@@ -1,0 +1,127 @@
+"""Ditto: personalized FL with a proximal personal track.
+
+Behavior parity with fedml_api/standalone/ditto/ditto_api.py:40-78 +
+ditto/my_model_trainer.py:38-68:
+
+- Global track: sampled clients train the global model normally for
+  ``epochs`` epochs; sample-weighted FedAvg.
+- Personal track: each sampled client also trains its PERSISTENT personal
+  model for ``local_epochs`` epochs, pulling toward the round's incoming
+  global model after every step: ``w -= lr * lamda * (w - w_global)``
+  (my_model_trainer.py:63-64).
+- Evaluation reports the personal models (ditto_api.py:74-78).
+
+Both tracks run inside one jitted SPMD round program over the sampled set.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.utils import pytree as pt
+
+
+class DittoEngine(FederatedEngine):
+    name = "ditto"
+
+    @functools.cached_property
+    def _round_jit(self):
+        trainer = self.trainer
+        o = self.cfg.optim
+        f = self.cfg.fed
+        S = min(f.client_num_per_round, self.real_clients)
+        max_samples = int(self.data.X_train.shape[1])
+        lamda = float(f.lamda)
+
+        def round_fn(params, bstats, per_params, per_bstats, data,
+                     sampled_idx, rngs, lr):
+            Xs = jnp.take(data.X_train, sampled_idx, axis=0)
+            ys = jnp.take(data.y_train, sampled_idx, axis=0)
+            ns = jnp.take(data.n_train, sampled_idx, axis=0)
+
+            def bcast(t):
+                return jax.tree.map(
+                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), t)
+
+            # -- global track --
+            cs = ClientState(params=bcast(params), batch_stats=bcast(bstats),
+                             opt_state=bcast(trainer.opt.init(params)),
+                             rng=rngs)
+
+            def global_local(cs_c, Xc, yc, nc):
+                return trainer.local_train(
+                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                    batch_size=o.batch_size, max_samples=max_samples)
+
+            cs, losses = jax.vmap(global_local)(cs, Xs, ys, ns)
+            w = ns.astype(jnp.float32)
+            new_params = pt.tree_weighted_mean(cs.params, w)
+            new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+
+            # -- personal track (persistent, proximal to incoming global) --
+            pp = jax.tree.map(lambda t: jnp.take(t, sampled_idx, axis=0),
+                              per_params)
+            pb = jax.tree.map(lambda t: jnp.take(t, sampled_idx, axis=0),
+                              per_bstats)
+            rngs2 = jax.vmap(lambda r: jax.random.fold_in(r, 1))(rngs)
+
+            def personal_local(p, b, rng, Xc, yc, nc):
+                cs_p = ClientState(params=p, batch_stats=b,
+                                   opt_state=trainer.opt.init(p), rng=rng)
+                cs_p, _ = trainer.local_train(
+                    cs_p, Xc, yc, nc, lr, epochs=f.local_epochs,
+                    batch_size=o.batch_size, max_samples=max_samples,
+                    prox_lamda=lamda, prox_ref=params)
+                return cs_p.params, cs_p.batch_stats
+
+            new_pp, new_pb = jax.vmap(personal_local)(pp, pb, rngs2, Xs, ys, ns)
+            per_params = jax.tree.map(
+                lambda allp, newp: allp.at[sampled_idx].set(newp),
+                per_params, new_pp)
+            per_bstats = jax.tree.map(
+                lambda allp, newp: allp.at[sampled_idx].set(newp),
+                per_bstats, new_pb)
+            mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+            return new_params, new_bstats, per_params, per_bstats, mean_loss
+
+        return jax.jit(round_fn)
+
+    def train(self):
+        cfg = self.cfg
+        gs = self.init_global_state()
+        params, bstats = gs.params, gs.batch_stats
+        per = self.broadcast_states(
+            ClientState(params=params, batch_stats=bstats, opt_state=None,
+                        rng=None), self.num_clients)
+        per_params, per_bstats = per.params, per.batch_stats
+        history = []
+        for round_idx in range(cfg.fed.comm_round):
+            sampled = self.client_sampling(round_idx)
+            rngs = self.per_client_rngs(round_idx, sampled)
+            params, bstats, per_params, per_bstats, loss = self._round_jit(
+                params, bstats, per_params, per_bstats, self.data,
+                jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+            if round_idx % cfg.fed.frequency_of_the_test == 0 \
+                    or round_idx == cfg.fed.comm_round - 1:
+                m = self.eval_personalized(ClientState(
+                    params=per_params, batch_stats=per_bstats,
+                    opt_state=None, rng=None))
+                mg = self.eval_global(params, bstats)
+                self.stat_info["person_test_acc"].append(m["acc"])
+                self.log.metrics(round_idx, train_loss=loss,
+                                 personal=m, global_=mg)
+                history.append({"round": round_idx,
+                                "train_loss": float(loss),
+                                "personal_acc": m["acc"],
+                                "global_acc": mg["acc"]})
+        m = self.eval_personalized(ClientState(
+            params=per_params, batch_stats=per_bstats, opt_state=None,
+            rng=None))
+        return {"params": params, "personal_params": per_params,
+                "history": history, "final_personal": m}

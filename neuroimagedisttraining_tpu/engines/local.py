@@ -1,0 +1,76 @@
+"""Local-only baseline: every client trains its own model forever, no
+communication (fedml_api/standalone/local/local_api.py:51-80).
+
+The whole federation's persistent states live as one stacked pytree; every
+round is one vmapped/sharded jitted program over ALL clients. The optimizer
+is re-created each round (reference builds a fresh torch SGD per call)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+
+
+class LocalEngine(FederatedEngine):
+    name = "local"
+
+    @functools.cached_property
+    def _round_jit(self):
+        trainer = self.trainer
+        o = self.cfg.optim
+        max_samples = int(self.data.X_train.shape[1])
+
+        def round_fn(per_params, per_bstats, data, rngs, lr):
+            def local(p, b, rng, Xc, yc, nc):
+                cs = ClientState(params=p, batch_stats=b,
+                                 opt_state=trainer.opt.init(p), rng=rng)
+                cs, loss = trainer.local_train(
+                    cs, Xc, yc, nc, lr, epochs=o.epochs,
+                    batch_size=o.batch_size, max_samples=max_samples)
+                return cs.params, cs.batch_stats, loss
+
+            new_p, new_b, losses = jax.vmap(local)(
+                per_params, per_bstats, rngs, data.X_train, data.y_train,
+                data.n_train)
+            w = data.n_train.astype(jnp.float32)
+            mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+            return new_p, new_b, mean_loss
+
+        return jax.jit(round_fn)
+
+    def train(self):
+        cfg = self.cfg
+        gs = self.init_global_state()
+        per = self.broadcast_states(
+            ClientState(params=gs.params, batch_stats=gs.batch_stats,
+                        opt_state=None, rng=None), self.num_clients)
+        per_params, per_bstats = per.params, per.batch_stats
+        history = []
+        for round_idx in range(cfg.fed.comm_round):
+            rngs = self.per_client_rngs(round_idx,
+                                        np.arange(self.num_clients))
+            per_params, per_bstats, loss = self._round_jit(
+                per_params, per_bstats, self.data, rngs,
+                self.round_lr(round_idx))
+            if round_idx % cfg.fed.frequency_of_the_test == 0 \
+                    or round_idx == cfg.fed.comm_round - 1:
+                m = self.eval_personalized(ClientState(
+                    params=per_params, batch_stats=per_bstats,
+                    opt_state=None, rng=None))
+                self.stat_info["person_test_acc"].append(m["acc"])
+                self.log.metrics(round_idx, train_loss=loss, **m)
+                history.append({"round": round_idx,
+                                "train_loss": float(loss), **m})
+        m = self.eval_personalized(ClientState(
+            params=per_params, batch_stats=per_bstats, opt_state=None,
+            rng=None))
+        self.log.metrics(-1, personal=m)
+        return {"personal_params": per_params,
+                "personal_batch_stats": per_bstats, "history": history,
+                "final_personal": m}

@@ -35,7 +35,11 @@ def _pool(x, kind: str, k: int, s: int, pad: int = 0):
 
 
 class ConvBNReLU3D(nn.Module):
-    """Conv3d + BatchNorm3d + ReLU block (salient_models.py:147-149 pattern)."""
+    """Conv3d + BatchNorm3d + ReLU block (salient_models.py:147-149 pattern).
+
+    BatchNorm runs in the block's compute dtype (bf16 on TPU) with f32
+    params/stats — keeping the huge early-stage activations half-width so
+    the pool backward (select-and-scatter) doesn't blow HBM."""
     features: int
     kernel: int = 3
     stride: int = 1
@@ -48,8 +52,14 @@ class ConvBNReLU3D(nn.Module):
                     padding=[(self.pad, self.pad)] * 3, dtype=self.dtype,
                     name="conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=jnp.float32, name="bn")(x)
+                         epsilon=1e-5, dtype=self.dtype, name="bn")(x)
         return nn.relu(x)
+
+
+# Rematerialized block: the backward pass recomputes conv/bn activations
+# instead of keeping all five feature stages live (HBM is the bottleneck for
+# 121^3 volumes; trades ~1.3x FLOPs for ~4x activation memory).
+RematConvBNReLU3D = nn.remat(ConvBNReLU3D, static_argnums=(2,))
 
 
 class AlexNet3D_Dropout(nn.Module):
@@ -57,17 +67,19 @@ class AlexNet3D_Dropout(nn.Module):
     num_classes=1 + BCE). Parity: salient_models.py:142-191."""
     num_classes: int = 2
     dtype: Dtype = jnp.float32
+    remat: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        Blk = RematConvBNReLU3D if self.remat else ConvBNReLU3D
         x = x.astype(self.dtype)
-        x = ConvBNReLU3D(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = Blk(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = ConvBNReLU3D(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = Blk(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
-        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
-        x = ConvBNReLU3D(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
+        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
+        x = Blk(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
         x = _pool(x, "max", 3, 3)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dropout(0.5, deterministic=not train)(x)
@@ -82,18 +94,20 @@ class AlexNet3D_Deeper_Dropout(nn.Module):
     (salient_models.py:194-246)."""
     num_classes: int = 2
     dtype: Dtype = jnp.float32
+    remat: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        Blk = RematConvBNReLU3D if self.remat else ConvBNReLU3D
         x = x.astype(self.dtype)
-        x = ConvBNReLU3D(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = Blk(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = ConvBNReLU3D(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = Blk(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
-        x = ConvBNReLU3D(384, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
-        x = ConvBNReLU3D(256, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
-        x = ConvBNReLU3D(256, kernel=3, pad=1, dtype=self.dtype, name="f5")(x, train)
+        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
+        x = Blk(384, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
+        x = Blk(256, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        x = Blk(256, kernel=3, pad=1, dtype=self.dtype, name="f5")(x, train)
         x = _pool(x, "max", 3, 3)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dropout(0.5, deterministic=not train)(x)
@@ -109,17 +123,19 @@ class AlexNet3D_Dropout_Regression(nn.Module):
     (salient_models.py:248-297)."""
     num_classes: int = 1
     dtype: Dtype = jnp.float32
+    remat: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        Blk = RematConvBNReLU3D if self.remat else ConvBNReLU3D
         x = x.astype(self.dtype)
-        x = ConvBNReLU3D(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = Blk(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = ConvBNReLU3D(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = Blk(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
-        x = ConvBNReLU3D(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
-        x = ConvBNReLU3D(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
+        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
+        x = Blk(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
         xp = _pool(x, "max", 3, 3)
         x = xp.reshape((xp.shape[0], -1))
         x = nn.Dropout(0.5, deterministic=not train)(x)
