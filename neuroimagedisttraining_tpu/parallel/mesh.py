@@ -25,6 +25,31 @@ PyTree = Any
 CLIENT_AXIS = "clients"
 
 
+def provision_virtual_devices(n: int) -> bool:
+    """Provision ``n`` virtual CPU devices for mesh simulation (SURVEY.md §4:
+    fake-device meshes stand in for multi-node without a cluster).
+
+    Must run before first backend touch; the axon/TPU plugin ignores the
+    ``XLA_FLAGS=--xla_force_host_platform_device_count`` env route, so the
+    config API is the only reliable path. Returns True if the config was
+    applied, False if the backend was already initialized (in which case the
+    caller must live with whatever devices exist)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        return False
+    try:
+        from jax._src import xla_bridge
+        if xla_bridge.backends_are_initialized():
+            return False
+    except Exception:
+        pass  # private API moved: trust the config.update calls above
+    return True
+
+
 def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
     """1-D mesh over all (or the first N) visible devices, axis "clients"."""
     if devices is None:

@@ -11,11 +11,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 # Under the axon TPU plugin the env vars above are ignored; the config API
-# wins as long as it runs before any backend initialization.
-import jax  # noqa: E402
+# (wrapped in provision_virtual_devices) wins as long as it runs before any
+# backend initialization.
+from neuroimagedisttraining_tpu.parallel.mesh import provision_virtual_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+provision_virtual_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
