@@ -51,14 +51,17 @@ class ClientState:
 class LocalTrainer:
     """Functional trainer bound to one model + optimizer config."""
 
-    def __init__(self, model, optim: OptimConfig, num_classes: int,
-                 channel_last_input: bool = True):
+    def __init__(self, model, optim: OptimConfig, num_classes: int):
         self.model = model
         self.optim_cfg = optim
         self.num_classes = num_classes
         self.loss = make_loss(num_classes)
         self.opt = make_local_optimizer(optim)
-        self._channel = channel_last_input
+        # Full input ndim (batch + spatial + channel) the model expects;
+        # drives channel-dim completion in _prep. Declared per model family
+        # so a 4-D [B,H,W,C] CIFAR batch is never mistaken for an
+        # unchanneled volumetric one.
+        self._input_rank = getattr(model, "input_rank", None)
 
     # ---------- init ----------
 
@@ -72,12 +75,13 @@ class LocalTrainer:
                            opt_state=self.opt.init(params), rng=srng)
 
     def _prep(self, x: jax.Array) -> jax.Array:
-        """uint8 -> float32 raw cast; add trailing channel dim for volumetric
-        inputs lacking one (reference ``unsqueeze(1)``,
-        my_model_trainer.py:216 — ours is channels-last)."""
+        """uint8 -> float32 raw cast; add trailing channel dim when the input
+        is exactly one rank short of the model's declared ``input_rank``
+        (reference ``unsqueeze(1)``, my_model_trainer.py:216 — ours is
+        channels-last)."""
         x = x.astype(jnp.float32)
-        if self._channel and x.ndim in (4,):  # [B,D,H,W] -> [B,D,H,W,1]
-            x = x[..., None]
+        if self._input_rank is not None and x.ndim == self._input_rank - 1:
+            x = x[..., None]  # e.g. [B,D,H,W] -> [B,D,H,W,1]
         return x
 
     def _apply(self, params, batch_stats, x, train: bool, dropout_rng=None):

@@ -129,6 +129,8 @@ class FederatedEngine:
         correct, loss, total, auc, n = map(np.asarray,
                                            (correct, loss, total, auc, n))
         mask = n > 0
+        if not np.any(mask):  # e.g. CI mode and client 0 has no test data
+            return {"acc": 0.0, "loss": 0.0, "auc": 0.0, "acc_pooled": 0.0}
         accs = correct[mask] / np.maximum(total[mask], 1)
         losses = loss[mask] / np.maximum(total[mask], 1)
         return {
@@ -152,8 +154,13 @@ class FederatedEngine:
         X = getattr(self.data, f"X_{split}")
         y = getattr(self.data, f"y_{split}")
         n = getattr(self.data, f"n_{split}")
-        out = self._eval_personal_jit(states.params, states.batch_stats,
-                                      X, y, n)
+        params, bstats = states.params, states.batch_stats
+        if self.cfg.fed.ci:  # CI escape hatch gates BOTH eval paths
+            # (ref sailentgrads_api.py:260-265)
+            X, y, n = X[:1], y[:1], n[:1]
+            params = pt.tree_stack_index(params, slice(0, 1))
+            bstats = pt.tree_stack_index(bstats, slice(0, 1))
+        out = self._eval_personal_jit(params, bstats, X, y, n)
         return self._summarize(*out, n=n)
 
     # ---------- helpers ----------

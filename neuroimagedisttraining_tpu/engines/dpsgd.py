@@ -107,6 +107,32 @@ class DPSGDEngine(FederatedEngine):
 
         return jax.jit(round_fn)
 
+    @functools.cached_property
+    def _finetune_jit(self):
+        """Every-100-rounds fine-tune-from-global evaluation pass
+        (dpsgd_api.py:89-101): each client trains one round from w_global;
+        the fine-tuned models are evaluated then DISCARDED (w_per_tmp)."""
+        trainer = self.trainer
+        o = self.cfg.optim
+        C = self.num_clients
+        max_samples = int(self.data.X_train.shape[1])
+
+        def ft(params, bstats, data, rngs, lr):
+            def local(rng, Xc, yc, nc):
+                cs = ClientState(
+                    params=params, batch_stats=bstats,
+                    opt_state=trainer.opt.init(params), rng=rng)
+                cs, _ = trainer.local_train(
+                    cs, Xc, yc, nc, lr, epochs=o.epochs,
+                    batch_size=o.batch_size, max_samples=max_samples)
+                return cs.params, cs.batch_stats
+
+            p, b = jax.vmap(local)(rngs, data.X_train, data.y_train,
+                                   data.n_train)
+            return p, b
+
+        return jax.jit(ft)
+
     def train(self):
         cfg = self.cfg
         gs = self.init_global_state()
@@ -136,6 +162,17 @@ class DPSGDEngine(FederatedEngine):
                                 "train_loss": float(loss),
                                 "global_acc": mg["acc"],
                                 "personal_acc": mp["acc"]})
+            if round_idx % 100 == 99:
+                # fine-tune pass: lr uses round=-1 (client.train(..., -1),
+                # dpsgd_api.py:97 -> lr * decay^-1)
+                ft_rngs = self.per_client_rngs(-1,
+                                               np.arange(self.num_clients))
+                ft_p, ft_b = self._finetune_jit(g_params, g_bstats, self.data,
+                                                ft_rngs, self.round_lr(-1))
+                mft = self.eval_personalized(ClientState(
+                    params=ft_p, batch_stats=ft_b, opt_state=None, rng=None))
+                self.log.metrics(-1, finetune_after_round=round_idx,
+                                 finetune_personal=mft)
         return {"personal_params": per_params, "global_params": g_params,
                 "history": history,
                 "final_global": self.eval_global(g_params, g_bstats)}

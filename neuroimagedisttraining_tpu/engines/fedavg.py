@@ -120,8 +120,10 @@ class FedAvgEngine(FederatedEngine):
         # final fine-tune pass -> personalized models + final eval at "-1"
         rngs = self.per_client_rngs(cfg.fed.comm_round,
                                     np.arange(self.num_clients))
+        # reference passes round=-1 for this pass (fedavg_api.py:85), so the
+        # fine-tune lr is lr * decay^-1, not the decayed end-of-training lr
         per_states = self._finetune_jit(params, bstats, self.data, rngs,
-                                        self.round_lr(cfg.fed.comm_round))
+                                        self.round_lr(-1))
         m_global = self.eval_global(params, bstats)
         m_person = self.eval_personalized(per_states)
         self.stat_info["person_test_acc"].append(m_person["acc"])

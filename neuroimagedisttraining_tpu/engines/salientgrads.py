@@ -64,7 +64,8 @@ class SalientGradsEngine(FederatedEngine):
             def per_client(cs_c, Xc, yc, nc):
                 sc = snip_ops.iter_snip_scores(
                     trainer, cs_c, Xc, yc, nc,
-                    iterations=s.itersnip_iterations, batch_size=o.batch_size)
+                    iterations=s.itersnip_iterations, batch_size=o.batch_size,
+                    stratified=s.stratified_sampling)
                 # zero-weight padding clients contribute nothing
                 w = (nc > 0).astype(jnp.float32)
                 return jax.tree.map(lambda t: t * w, sc), w
@@ -152,6 +153,11 @@ class SalientGradsEngine(FederatedEngine):
         flops_per_sample = flops_ops.count_training_flops_per_sample(
             self.trainer.model, params, self.trainer._prep(self.sample_input()),
             mask_density=dens_map, batch_stats=bstats)
+        # communicated parameters per client per round = nonzero mask entries
+        # (masks are ones on non-maskable leaves), matching the reference's
+        # nonzero-parameter comm metric (model_trainer.py:49-53)
+        comm_params_per_client = float(sum(
+            float(jnp.sum(m)) for m in jax.tree.leaves(masks)))
 
         per = self.broadcast_states(
             ClientState(params=params, batch_stats=bstats,
@@ -171,7 +177,8 @@ class SalientGradsEngine(FederatedEngine):
             n_samples = float(np.sum(np.asarray(self.data.n_train)[sampled]))
             self.stat_info["sum_training_flops"] += (
                 flops_per_sample * cfg.optim.epochs * n_samples)
-            self.stat_info["sum_comm_params"] += density * len(sampled)
+            self.stat_info["sum_comm_params"] += (comm_params_per_client
+                                                  * len(sampled))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 m = self.eval_global(params, bstats)

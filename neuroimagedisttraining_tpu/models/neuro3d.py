@@ -65,6 +65,7 @@ RematConvBNReLU3D = nn.remat(ConvBNReLU3D, static_argnums=(2,))
 class AlexNet3D_Dropout(nn.Module):
     """5-conv 3D AlexNet with dropout head; the ABCD flagship (``--model 3DCNN``,
     num_classes=1 + BCE). Parity: salient_models.py:142-191."""
+    input_rank = 5  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 2
     dtype: Dtype = jnp.float32
     remat: bool = True
@@ -92,6 +93,7 @@ class AlexNet3D_Dropout(nn.Module):
 class AlexNet3D_Deeper_Dropout(nn.Module):
     """6-conv, 512-dim-flatten variant; returns ``[x, x]`` like the reference
     (salient_models.py:194-246)."""
+    input_rank = 5  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 2
     dtype: Dtype = jnp.float32
     remat: bool = True
@@ -121,6 +123,7 @@ class AlexNet3D_Deeper_Dropout(nn.Module):
 class AlexNet3D_Dropout_Regression(nn.Module):
     """Regression head; returns ``(pred.squeeze(), feature_map)``
     (salient_models.py:248-297)."""
+    input_rank = 5  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 1
     dtype: Dtype = jnp.float32
     remat: bool = True
@@ -150,6 +153,7 @@ class Tiny3DCNN(nn.Module):
     structural miniature of AlexNet3D_Dropout (conv-BN-relu-pool x2 + MLP
     head). Not in the reference zoo; serves its ``--ci`` fast-path role
     (sailentgrads_api.py:260-265) with real Conv3D+BN+Dropout semantics."""
+    input_rank = 5  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 1
     width: int = 8
     dtype: Dtype = jnp.float32
@@ -234,6 +238,7 @@ class Bottleneck3D(nn.Module):
 class ResNet3D_l3(nn.Module):
     """3-stage 3D ResNet; returns ``(logits, penultimate)``
     (salient_models.py:84-139). ``block`` is "basic" or "bottleneck"."""
+    input_rank = 5  # input ndim incl. batch+channel (unannotated: not a flax field)
     layers: Sequence[int] = (1, 1, 1)
     num_classes: int = 2
     block: str = "basic"

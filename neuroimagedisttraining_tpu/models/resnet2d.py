@@ -57,6 +57,7 @@ class ResNet18(nn.Module):
     """CIFAR-style ResNet-18: 3x3 stem, 4 stages of 2 basic blocks,
     4x4 avg-pool head (resnet.py:42-91). ``adaptive_pool=True`` gives the
     TinyImageNet global-pool variant (resnet.py:134-186)."""
+    input_rank = 4  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 10
     norm: str = "bn"
     num_blocks: Sequence[int] = (2, 2, 2, 2)

@@ -30,6 +30,7 @@ VGG_CFG = {
 
 class VGG(nn.Module):
     """VGG feature stack + single linear classifier (vgg.py:14-60)."""
+    input_rank = 4  # input ndim incl. batch+channel (unannotated: not a flax field)
     cfg: Sequence[Union[int, str]]
     num_classes: int = 10
     group_norm: bool = True
@@ -66,6 +67,7 @@ def vgg16(num_classes: int = 10, dtype=jnp.float32) -> VGG:
 class CNNCifar(nn.Module):
     """2x(conv5 + maxpool2) + fc 384/192/n (cnn_cifar10.py:12-52; the
     cifar100 variant differs only in ``num_classes``)."""
+    input_rank = 4  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 10
     dtype: Dtype = jnp.float32
 
@@ -91,6 +93,7 @@ def _ensure_channel(x):
 
 class CNN_OriginalFedAvg(nn.Module):
     """FedAvg-paper MNIST CNN, 1,663,370 params with only_digits (cnn.py:6-74)."""
+    input_rank = 4  # input ndim incl. batch+channel (unannotated: not a flax field)
     only_digits: bool = True
     dtype: Dtype = jnp.float32
 
@@ -112,6 +115,7 @@ class CNN_OriginalFedAvg(nn.Module):
 
 class CNN_DropOut(nn.Module):
     """Adaptive-Federated-Optimization EMNIST CNN (cnn.py:77-160)."""
+    input_rank = 4  # input ndim incl. batch+channel (unannotated: not a flax field)
     only_digits: bool = True
     dtype: Dtype = jnp.float32
 
@@ -134,6 +138,7 @@ class CNN_DropOut(nn.Module):
 
 class LeNet5(nn.Module):
     """Caffe-style LeNet-5, no padding in conv1 (lenet5.py:4-27)."""
+    input_rank = 4  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 10
     dtype: Dtype = jnp.float32
 
@@ -154,6 +159,7 @@ class LeNet5(nn.Module):
 
 class LeNet5_cifar(nn.Module):
     """CIFAR LeNet (lenet5.py:29-47)."""
+    input_rank = 4  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 10
     dtype: Dtype = jnp.float32
 

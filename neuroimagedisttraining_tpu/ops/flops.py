@@ -87,10 +87,14 @@ def count_inference_flops(model, params: PyTree, sample_x: jax.Array,
             out = out_shapes.get(mod_path + "/__call__") or \
                 out_shapes.get(mod_path)
             if out is None:
-                # fall back: cannot see the output map; assume 1 position
-                spatial = 1.0
-            else:
-                spatial = float(np.prod(out[1:-1]))  # NDHWC spatial dims
+                # A conv kernel whose module output we can't see would be
+                # undercounted by the full spatial extent (~1e6x for ABCD
+                # volumes) — refuse to count silently.
+                raise ValueError(
+                    f"FLOPs counter: no captured intermediate output for "
+                    f"conv module {mod_path!r} (kernel {name!r}); available "
+                    f"paths: {sorted(out_shapes)[:8]}...")
+            spatial = float(np.prod(out[1:-1]))  # NDHWC spatial dims
             total += 2.0 * macs_per_pos * spatial * density
         else:  # dense [in, out]
             total += 2.0 * macs_per_pos * density

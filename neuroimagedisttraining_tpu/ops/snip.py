@@ -43,18 +43,43 @@ def snip_scores(trainer: LocalTrainer, cs: ClientState, x: jax.Array,
         grads)
 
 
+def _stratified_indices(rng: jax.Array, y: jax.Array, n_valid,
+                        batch_size: int) -> jax.Array:
+    """Label-balanced batch draw: each class contributes with equal expected
+    frequency — the intent of the reference's StratifiedKFold batch sampler
+    for IterSNIP (client.py:36-46), expressed as weighted sampling so it jits
+    with static shapes."""
+    valid = jnp.arange(y.shape[0]) < n_valid
+    # per-sample weight = 1 / (count of its own label among valid samples),
+    # computed via an equality matrix so it works for any label set without
+    # a static class count (clients hold <= a few thousand samples, so the
+    # O(n^2) compare is negligible)
+    eq = (y[None, :] == y[:, None]) & valid[None, :]
+    cnt = jnp.sum(eq, axis=1)
+    w = jnp.where(valid, 1.0 / jnp.maximum(cnt, 1), 0.0)
+    p = w / jnp.maximum(jnp.sum(w), 1e-12)
+    return jax.random.choice(rng, y.shape[0], (batch_size,), replace=True,
+                             p=p)
+
+
 def iter_snip_scores(trainer: LocalTrainer, cs: ClientState, X: jax.Array,
                      y: jax.Array, n_valid, iterations: int,
-                     batch_size: int) -> PyTree:
+                     batch_size: int, stratified: bool = False) -> PyTree:
     """IterSNIP: mean saliency over ``iterations`` minibatches
     (client.py:30-53 + snip.py:143-164). Batches are drawn uniformly from
-    the client's valid range (the reference's optional stratified sampler is
-    approximated by uniform draws from an already label-mixed shard)."""
+    the client's valid range, or label-balanced when ``stratified``
+    (reference ``stratified_sampling`` flag)."""
     def one_iter(carry, rng):
-        idx = jax.random.randint(rng, (batch_size,), 0,
-                                 jnp.maximum(n_valid, 1))
-        s = snip_scores(trainer, cs, jnp.take(X, idx, axis=0),
-                        jnp.take(y, idx, axis=0))
+        brng, srng = jax.random.split(rng)
+        if stratified:
+            idx = _stratified_indices(brng, y, n_valid, batch_size)
+        else:
+            idx = jax.random.randint(brng, (batch_size,), 0,
+                                     jnp.maximum(n_valid, 1))
+        # fresh dropout rng per iteration so IterSNIP iterations don't share
+        # one dropout mask
+        s = snip_scores(trainer, cs.replace(rng=srng),
+                        jnp.take(X, idx, axis=0), jnp.take(y, idx, axis=0))
         return jax.tree.map(jnp.add, carry, s), None
 
     zero = jax.tree.map(jnp.zeros_like, cs.params)

@@ -102,6 +102,10 @@ def kth_largest(x: jax.Array, k: int, rounds: int = 4, nbins: int = 512,
     (snip.py:96-98).
     """
     assert x.ndim == 1
+    assert nbins % _BIN_CHUNK == 0, (
+        f"nbins ({nbins}) must be a multiple of {_BIN_CHUNK}: the Pallas "
+        "kernel floor-divides the bin ladder into chunks and would silently "
+        "drop remainder bins")
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     count_ge = _count_ge_pallas if use_pallas else _count_ge_xla

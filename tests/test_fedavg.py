@@ -38,8 +38,7 @@ def _make_engine(tmp_path, cohort, algorithm="fedavg", **fed_kw):
     mesh = make_mesh()
     fed, info = federate_cohort(cohort, partition_method="site", mesh=mesh)
     model = create_model(cfg.model, num_classes=1)
-    trainer = LocalTrainer(model, cfg.optim, num_classes=1,
-                           channel_last_input=True)
+    trainer = LocalTrainer(model, cfg.optim, num_classes=1)
     log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
                            console=False)
     return create_engine(algorithm, cfg, fed, trainer, mesh=mesh, logger=log)

@@ -155,3 +155,30 @@ def test_flops_counter_conv_and_dense():
     assert sparse_flops == pytest.approx(dense_flops / 2, rel=1e-6)
     assert F.count_training_flops_per_sample(model, cs.params, x) == \
         pytest.approx(3 * dense_flops)
+
+
+def test_prep_channel_dim_gated_on_input_rank():
+    """ADVICE r1: a 4-D [B,H,W,C] batch into a 2D model must NOT grow a
+    5th dim; a 4-D [B,D,H,W] batch into a 3D model must."""
+    from neuroimagedisttraining_tpu.models import CNNCifar
+
+    t3 = LocalTrainer(Tiny3DCNN(num_classes=1), OptimConfig(), num_classes=1)
+    assert t3._prep(jnp.zeros((2, 12, 12, 12))).shape == (2, 12, 12, 12, 1)
+    assert t3._prep(jnp.zeros((2, 12, 12, 12, 1))).shape == (2, 12, 12, 12, 1)
+    t2 = LocalTrainer(CNNCifar(num_classes=10), OptimConfig(), num_classes=10)
+    assert t2._prep(jnp.zeros((2, 32, 32, 3))).shape == (2, 32, 32, 3)
+
+
+def test_stratified_indices_balance_classes():
+    y = jnp.asarray([0] * 90 + [1] * 10 + [0] * 28, jnp.int32)  # 28 padding
+    idx = S._stratified_indices(jax.random.key(0), y, n_valid=100,
+                                batch_size=2000)
+    labels = np.asarray(y)[np.asarray(idx)]
+    assert np.all(np.asarray(idx) < 100)          # never samples padding
+    assert 0.4 < labels.mean() < 0.6              # ~50/50 despite 90/10 data
+
+
+def test_kth_largest_rejects_bad_nbins():
+    x = jnp.arange(512, dtype=jnp.float32)
+    with pytest.raises(AssertionError):
+        kth_largest(x, 5, nbins=100)
