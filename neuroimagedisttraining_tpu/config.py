@@ -61,6 +61,8 @@ class SparsityConfig:
     uniform: bool = False          # uniform layer sparsity instead of ERK
     static: bool = False           # no mask evolution (DisPFL)
     dis_gradient_check: bool = False
+    different_initial: bool = False  # per-client distinct initial masks (DisPFL)
+    diff_spa: bool = False         # per-client density cycle 0.2..1.0 (DisPFL)
     snip_mask: bool = True         # SalientGrads dense escape hatch when False
     itersnip_iterations: int = 1
     stratified_sampling: bool = False

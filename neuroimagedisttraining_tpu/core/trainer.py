@@ -174,6 +174,16 @@ class LocalTrainer:
         denom = jnp.maximum(epochs * my_steps, 1)
         return cs, jnp.sum(losses) / denom
 
+    def eval_grad(self, params: PyTree, batch_stats: PyTree, x, y) -> PyTree:
+        """One-batch DENSE gradient probe in eval mode (no dropout, BN in
+        inference mode) — DisPFL's ``screen_gradients``
+        (DisPFL/my_model_trainer.py:165-188, model.eval() + one batch)."""
+        def f(p):
+            out, _ = self._apply(p, batch_stats, self._prep(x), train=False)
+            return self.loss(primary_logits(out), y)
+
+        return jax.grad(f)(params)
+
     # ---------- evaluation ----------
 
     def evaluate(self, params, batch_stats, X, y, valid, batch_size: int = 32):

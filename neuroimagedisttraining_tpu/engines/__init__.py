@@ -6,6 +6,9 @@ from neuroimagedisttraining_tpu.engines.salientgrads import SalientGradsEngine  
 from neuroimagedisttraining_tpu.engines.local import LocalEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.ditto import DittoEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.dpsgd import DPSGDEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.dispfl import DisPFLEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.subavg import SubFedAvgEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.fedfomo import FedFomoEngine  # noqa: F401
 
 ENGINES = {
     "fedavg": FedAvgEngine,
@@ -14,6 +17,10 @@ ENGINES = {
     "local": LocalEngine,
     "ditto": DittoEngine,
     "dpsgd": DPSGDEngine,
+    "dispfl": DisPFLEngine,
+    "subavg": SubFedAvgEngine,
+    "sub-fedavg": SubFedAvgEngine,
+    "fedfomo": FedFomoEngine,
 }
 
 

@@ -1,0 +1,243 @@
+"""FedFomo: personalized client-to-client weighted aggregation
+(fedml_api/standalone/fedfomo/fedfomo_api.py:53-218).
+
+Behavior parity:
+
+- Every client trains from its own previous personal model each round
+  (fedfomo_api.py:68-76); aggregation then mixes NEIGHBORS' pre-round
+  (last-round) models with the client's own freshly-trained one.
+- Neighbor choice (``_benefit_choose``, fedfomo_api.py:130-144): at full
+  participation, everyone; otherwise a coin flip between (a) the top-M
+  clients by accumulated ``p_choose`` score and (b) uniform random
+  (resample-while-self quirk), with the client's own ``p_choose`` entry
+  permanently zeroed. ``M = fomo_m`` (the reference reuses
+  client_num_per_round; we honor ``fomo_m`` and default it the same way).
+- Fomo weights (``_updates_weight_local``, fedfomo_api.py:147-171):
+  ``w[c,n] = (valloss_c(own lstrd) - valloss_c(model_n)) / ||theta_n -
+  theta_c^lstrd||`` on client c's VALIDATION split; the self entry compares
+  the freshly-trained model. Zero parameter distance -> weight 0. Non-
+  neighbor entries keep their previous value (array persists across
+  rounds, initialized to 1/C).
+- ``p_choose[c] += weights[c]`` every round (fedfomo_api.py:93).
+- Aggregation (``_aggregate_func``, fedfomo_api.py:200-218): ReLU the
+  weights, normalize over the neighbor set, and apply as a delta from the
+  client's last-round model; all-nonpositive weights -> keep last model.
+- Dtype discipline (SURVEY §3.5: the reference crashed on Long/Float casts
+  in aggregation): all aggregation math here runs in float32 pytrees; there
+  are no integer leaves in params by construction.
+
+TPU-native: one jitted round program; the val-loss matrix L[c, n] (loss of
+model n on client c's val shard) is computed by a lax.scan over model
+owners n with a vmapped evaluation over val shards c — O(C^2) evals with
+only O(C) model replication; aggregation is two einsums against the
+row-normalized ReLU weight matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.ops import flops as flops_ops
+from neuroimagedisttraining_tpu.utils import pytree as pt
+
+
+class FedFomoEngine(FederatedEngine):
+    name = "fedfomo"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.data.X_val is None:
+            raise ValueError(
+                "FedFomo requires a validation split: build the federation "
+                "with val_fraction > 0 (reference 9-tuple val loaders, "
+                "main_fedfomo.py:114-134)")
+
+    # ---------- host-side neighbor choice ----------
+
+    def benefit_choose(self, round_idx: int, c: int,
+                       p_choose_row: np.ndarray) -> np.ndarray:
+        """fedfomo_api.py:130-144. Coin flip between top-M by p_choose and
+        uniform random (resample-while-self). Deviation: the reference's
+        coin is unseeded ``random.random()``; we seed per (round, client)
+        for reproducibility."""
+        total = self.real_clients
+        # clamp like base.client_sampling: per_round can exceed the real
+        # client count (e.g. default 21-client config on a 4-site cohort)
+        per_round = min(self.cfg.fed.client_num_per_round, total)
+        if per_round == total:
+            return np.arange(total)
+        m = min(self.cfg.fed.fomo_m, per_round)  # m < total, so the
+        # resample-while-self loop below always terminates
+        rs = np.random.RandomState(self.cfg.seed * 131 + round_idx * 17 + c)
+        if rs.random() >= 0.5:
+            row = p_choose_row[:total].copy()
+            row[c] = 0.0  # reference zeroes own entry before top-M pick
+            nei = np.argsort(row)[-m:]
+        else:
+            nei = rs.choice(range(total), m, replace=False)
+            while c in nei:
+                nei = rs.choice(range(total), m, replace=False)
+        return np.append(nei, c)
+
+    # ---------- the round program ----------
+
+    @functools.cached_property
+    def _round_jit(self):
+        trainer = self.trainer
+        o = self.cfg.optim
+        C = self.num_clients
+        max_samples = int(self.data.X_train.shape[1])
+
+        def val_losses_of(params_n, bstats_n, data):
+            """Loss of ONE model on every client's val shard -> [C]."""
+            def per_val(Xv, yv, nv):
+                valid = jnp.arange(Xv.shape[0]) < nv
+                m = trainer.evaluate(params_n, bstats_n, Xv, yv, valid)
+                return m["test_loss"] / jnp.maximum(m["test_total"], 1.0)
+
+            return jax.vmap(per_val)(data.X_val, data.y_val, data.n_val)
+
+        def round_fn(per_params, per_bstats, weights, p_choose, A, data,
+                     rngs, lr):
+            lstrd_p, lstrd_b = per_params, per_bstats
+
+            # --- 1. local training from own previous model ---
+            def local(p, b, rng, Xc, yc, nc):
+                cs_c = ClientState(params=p, batch_stats=b,
+                                   opt_state=trainer.opt.init(p), rng=rng)
+                cs_c, loss = trainer.local_train(
+                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                    batch_size=o.batch_size, max_samples=max_samples)
+                return cs_c.params, cs_c.batch_stats, loss
+
+            new_p, new_b, losses = jax.vmap(local)(
+                lstrd_p, lstrd_b, rngs, data.X_train, data.y_train,
+                data.n_train)
+
+            # --- 2. val-loss matrix L[c, n] = loss of model n on val_c ---
+            def scan_owner(_, pn_bn):
+                pn, bn = pn_bn
+                return None, val_losses_of(pn, bn, data)
+
+            _, L_cols = jax.lax.scan(scan_owner, None, (lstrd_p, lstrd_b))
+            L = L_cols.T                       # [c, n]
+
+            def self_loss(p, b, Xv, yv, nv):
+                valid = jnp.arange(Xv.shape[0]) < nv
+                m = trainer.evaluate(p, b, Xv, yv, valid)
+                return m["test_loss"] / jnp.maximum(m["test_total"], 1.0)
+
+            L_self = jax.vmap(self_loss)(new_p, new_b, data.X_val,
+                                         data.y_val, data.n_val)
+            loss_cur = jnp.diagonal(L)             # own lstrd model
+
+            # --- 3. parameter-distance matrix D[c, n] ---
+            def sq_dists_of(pn):
+                return jax.vmap(lambda pc: pt.tree_dot(
+                    pt.tree_sub(pn, pc), pt.tree_sub(pn, pc)))(lstrd_p)
+
+            _, D2_cols = jax.lax.scan(lambda _, pn: (None, sq_dists_of(pn)),
+                                      None, lstrd_p)
+            D = jnp.sqrt(jnp.maximum(D2_cols.T, 0.0))      # [c, n]
+            d_self = jax.vmap(lambda a, b: pt.tree_norm(pt.tree_sub(a, b)))(
+                new_p, lstrd_p)
+            D = D.at[jnp.arange(C), jnp.arange(C)].set(d_self)
+            Lmat = L.at[jnp.arange(C), jnp.arange(C)].set(L_self)
+
+            # --- 4. fomo weight update on neighbor entries only ---
+            w_new = jnp.where(D > 0, (loss_cur[:, None] - Lmat)
+                              / jnp.maximum(D, 1e-20), 0.0)
+            weights = jnp.where(A > 0, w_new, weights)
+            p_choose = p_choose + weights          # fedfomo_api.py:93
+
+            # --- 5. ReLU-normalized delta aggregation ---
+            wpos = jnp.maximum(weights, 0.0) * A
+            denom = jnp.sum(wpos, axis=1)          # [c]
+            B = jnp.where(denom[:, None] > 0, wpos
+                          / jnp.maximum(denom[:, None], 1e-20), 0.0)
+            B_off = B * (1.0 - jnp.eye(C))
+            b_diag = jnp.diagonal(B)
+            rowsum = jnp.sum(B, axis=1)            # 1 where denom>0 else 0
+
+            def agg_leaf(lst, new):
+                lst32 = lst.astype(jnp.float32)
+                t1 = jnp.einsum("cn,n...->c...", B_off, lst32)
+                bd = b_diag.reshape((-1,) + (1,) * (lst.ndim - 1))
+                rs_ = rowsum.reshape((-1,) + (1,) * (lst.ndim - 1))
+                out = lst32 + t1 + bd * new.astype(jnp.float32) - rs_ * lst32
+                return out.astype(lst.dtype)
+
+            agg_p = jax.tree.map(agg_leaf, lstrd_p, new_p)
+            agg_b = jax.tree.map(agg_leaf, lstrd_b, new_b)
+
+            real = (data.n_train > 0).astype(jnp.float32)
+            mean_loss = jnp.sum(losses * real) / jnp.maximum(jnp.sum(real),
+                                                             1.0)
+            return agg_p, agg_b, weights, p_choose, mean_loss
+
+        return jax.jit(round_fn)
+
+    # ---------- training loop ----------
+
+    def train(self):
+        cfg = self.cfg
+        C = self.num_clients
+        gs = self.init_global_state()
+        per = self.broadcast_states(
+            ClientState(params=gs.params, batch_stats=gs.batch_stats,
+                        opt_state=None, rng=None), C)
+        per_params, per_bstats = per.params, per.batch_stats
+        # persistent fomo state (fedfomo_api.py:60-61)
+        weights = jnp.full((C, C), 1.0 / max(self.real_clients, 1),
+                           jnp.float32)
+        p_choose = jnp.ones((C, C), jnp.float32)
+        flops_per_sample = flops_ops.count_training_flops_per_sample(
+            self.trainer.model, gs.params,
+            self.trainer._prep(self.sample_input()),
+            batch_stats=gs.batch_stats)
+        n_params = pt.tree_size(gs.params)
+
+        history = []
+        for round_idx in range(cfg.fed.comm_round):
+            pch = np.asarray(jax.device_get(p_choose))
+            A = np.zeros((C, C), np.float32)
+            n_model_transfers = 0
+            for c in range(self.real_clients):
+                nei = np.unique(self.benefit_choose(round_idx, c, pch[c]))
+                A[c, nei] = 1.0
+                n_model_transfers += len(nei) - (1 if c in nei else 0)
+            self.log.info("################ round %d", round_idx)
+            rngs = self.per_client_rngs(round_idx, np.arange(C))
+            per_params, per_bstats, weights, p_choose, loss = \
+                self._round_jit(per_params, per_bstats, weights, p_choose,
+                                jnp.asarray(A), self.data, rngs,
+                                self.round_lr(round_idx))
+            n_samples = float(np.sum(np.asarray(self.data.n_train)
+                                     [: self.real_clients]))
+            self.stat_info["sum_training_flops"] += (
+                flops_per_sample * cfg.optim.epochs * n_samples)
+            self.stat_info["sum_comm_params"] += float(
+                n_model_transfers * n_params)
+            if round_idx % cfg.fed.frequency_of_the_test == 0 \
+                    or round_idx == cfg.fed.comm_round - 1:
+                mp = self.eval_personalized(ClientState(
+                    params=per_params, batch_stats=per_bstats,
+                    opt_state=None, rng=None))
+                self.stat_info["person_test_acc"].append(mp["acc"])
+                self.log.metrics(round_idx, train_loss=loss, personal=mp)
+                history.append({"round": round_idx,
+                                "train_loss": float(loss),
+                                "personal_acc": mp["acc"]})
+        m_person = self.eval_personalized(ClientState(
+            params=per_params, batch_stats=per_bstats, opt_state=None,
+            rng=None))
+        self.log.metrics(-1, personal=m_person)
+        return {"personal_params": per_params, "weights": weights,
+                "p_choose": p_choose, "history": history,
+                "final_personal": m_person}

@@ -1,0 +1,206 @@
+"""Sub-FedAvg: per-client iterative magnitude pruning with an accept-test,
+mask-overlap-count averaging (fedml_api/standalone/subavg/).
+
+Behavior parity (subavg_api.py:43-92, subavg/client.py:36-64,
+subavg/my_model_trainer.py:48-82):
+
+- Initial masks are all-ones (my_model_trainer.py:28-40); every client
+  maintains a personal mask that only ever loses entries.
+- Per round, sampled clients receive ``w_global * mask_c`` and train with
+  masked gradients (``param.grad *= mask``, my_model_trainer.py:66-68; with
+  pruned weights starting at zero this equals our post-step re-mask).
+- Prune candidates: ``fake_prune`` percentile masks computed after the FIRST
+  epoch (m1) and after the LAST epoch (m2) (my_model_trainer.py:76-79);
+  with epochs==1, m1 == m2 and pruning never triggers — reference parity.
+- Accept-test (client.py:50-58): prune only if
+  (a) hamming-fraction(m1, m2) > ``dist_thresh``,
+  (b) pre-train density of the client model > ``dense_ratio`` (floor), and
+  (c) accuracy of the m2-pruned trained model on the client's TRAINING data
+      (local_test(..., False)) > ``acc_thresh``.
+  On accept: weights *= m2 and the personal mask becomes m2.
+- Aggregation (subavg_api.py:123-140): per weight, ``count`` = number of
+  sampled clients whose OLD mask keeps it; server value becomes
+  ``sum_i w_i / count`` where count > 0, and keeps its previous value where
+  no sampled client keeps the weight (the reference's non-finite guard).
+- Personalized model of client c = ``w_global * mask_c``
+  (_local_test_on_all_clients, subavg_api.py:150-170).
+
+TPU-native: one jitted round program — sampled clients' masks/models are
+stacked and vmapped, the percentile prune is a sort-based quantile per
+layer, the accept-test is a vmapped masked evaluation, and the overlap-count
+average is a masked sum over the client axis (ICI all-reduce under the
+mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core.losses import binary_auc
+from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.ops import flops as flops_ops
+from neuroimagedisttraining_tpu.ops import prune as P
+from neuroimagedisttraining_tpu.ops.masks import ones_mask
+from neuroimagedisttraining_tpu.utils import pytree as pt
+
+
+class SubFedAvgEngine(FederatedEngine):
+    name = "subavg"
+
+    @functools.cached_property
+    def _round_jit(self):
+        trainer = self.trainer
+        o = self.cfg.optim
+        s = self.cfg.sparsity
+        max_samples = int(self.data.X_train.shape[1])
+        epochs_tail = max(o.epochs - 1, 0)
+
+        def round_fn(params, bstats, mask_pers, data, sampled_idx, rngs, lr):
+            Xs = jnp.take(data.X_train, sampled_idx, axis=0)
+            ys = jnp.take(data.y_train, sampled_idx, axis=0)
+            ns = jnp.take(data.n_train, sampled_idx, axis=0)
+            Ms = pt.tree_stack_index(mask_pers, sampled_idx)
+
+            def per_client(m, rng, Xc, yc, nc):
+                w_per = jax.tree.map(jnp.multiply, params, m)
+                dense = P.density_all_leaves(w_per)
+                cs_c = ClientState(params=w_per, batch_stats=bstats,
+                                   opt_state=trainer.opt.init(w_per),
+                                   rng=rng)
+                # epoch 1, then fake_prune -> m1
+                cs_c, loss1 = trainer.local_train(
+                    cs_c, Xc, yc, nc, lr, epochs=1, batch_size=o.batch_size,
+                    max_samples=max_samples, mask=m)
+                m1 = P.fake_prune(s.each_prune_ratio, cs_c.params, m)
+                # remaining epochs, then fake_prune -> m2
+                if epochs_tail:
+                    cs_c, loss2 = trainer.local_train(
+                        cs_c, Xc, yc, nc, lr, epochs=epochs_tail,
+                        batch_size=o.batch_size, max_samples=max_samples,
+                        mask=m)
+                    loss = (loss1 + epochs_tail * loss2) / o.epochs
+                else:
+                    loss = loss1
+                m2 = P.fake_prune(s.each_prune_ratio, cs_c.params, m)
+                dist = P.mask_distance_mean(m1, m2)
+
+                # accept-test: acc of the m2-pruned model on TRAIN data
+                pruned = jax.tree.map(jnp.multiply, cs_c.params, m2)
+                valid = jnp.arange(Xc.shape[0]) < nc
+                metrics = trainer.evaluate(pruned, cs_c.batch_stats, Xc, yc,
+                                           valid)
+                acc = metrics["test_correct"] / jnp.maximum(
+                    metrics["test_total"], 1.0)
+                accept = ((dist > s.dist_thresh)
+                          & (dense > s.dense_ratio)
+                          & (acc > s.acc_thresh))
+                sel = lambda a, b: jax.tree.map(
+                    lambda x, y: jnp.where(accept, x, y), a, b)
+                new_params = sel(pruned, cs_c.params)
+                new_mask = sel(m2, m)
+                return (new_params, cs_c.batch_stats, new_mask, loss, dist,
+                        accept)
+
+            (new_p, new_b, new_m, losses, dists, accepts) = jax.vmap(
+                per_client)(Ms, rngs, Xs, ys, ns)
+
+            # ---- overlap-count aggregation against the OLD masks ----
+            count = jax.tree.map(lambda m: jnp.sum(m, axis=0), Ms)
+            summed = jax.tree.map(lambda w: jnp.sum(w.astype(jnp.float32),
+                                                    axis=0), new_p)
+            agg = jax.tree.map(
+                lambda sm, ct, old: jnp.where(ct > 0, sm
+                                              / jnp.maximum(ct, 1.0), old),
+                summed, count, params)
+            new_bstats = jax.tree.map(
+                lambda b: jnp.mean(b.astype(jnp.float32), axis=0), new_b)
+            # scatter updated personal masks back
+            mask_pers = jax.tree.map(
+                lambda allm, nm: allm.at[sampled_idx].set(nm), mask_pers,
+                new_m)
+            mean_loss = jnp.mean(losses)
+            return (agg, new_bstats, mask_pers, mean_loss,
+                    jnp.mean(dists), jnp.sum(accepts))
+
+        return jax.jit(round_fn)
+
+    @functools.cached_property
+    def _eval_masked_global_jit(self):
+        """Personalized eval: client c evaluates w_global * mask_c
+        (subavg_api.py:150-170)."""
+        trainer = self.trainer
+
+        def eval_all(params, bstats, mask_pers, X, y, n):
+            def per_client(m, Xc, yc, nc):
+                p = jax.tree.map(jnp.multiply, params, m)
+                valid = jnp.arange(Xc.shape[0]) < nc
+                mt = trainer.evaluate(p, bstats, Xc, yc, valid)
+                auc = binary_auc(mt["scores"], yc, valid)
+                return mt["test_correct"], mt["test_loss"], mt["test_total"], auc
+
+            return jax.vmap(per_client)(mask_pers, X, y, n)
+
+        return jax.jit(eval_all)
+
+    def eval_masked_global(self, params, bstats, mask_pers) -> dict:
+        X, y, n = self.data.X_test, self.data.y_test, self.data.n_test
+        if self.cfg.fed.ci:
+            X, y, n = X[:1], y[:1], n[:1]
+            mask_pers = pt.tree_stack_index(mask_pers, slice(0, 1))
+        out = self._eval_masked_global_jit(params, bstats, mask_pers, X, y, n)
+        return self._summarize(*out, n=n)
+
+    def train(self):
+        cfg = self.cfg
+        gs = self.init_global_state()
+        params, bstats = gs.params, gs.batch_stats
+        mask_pers = self.broadcast_states(ones_mask(params),
+                                          self.num_clients)
+        flops_per_sample = flops_ops.count_training_flops_per_sample(
+            self.trainer.model, params,
+            self.trainer._prep(self.sample_input()), batch_stats=bstats)
+        n_params = pt.tree_size(params)
+
+        history = []
+        for round_idx in range(cfg.fed.comm_round):
+            sampled = self.client_sampling(round_idx)
+            self.log.info("################ round %d: clients %s",
+                          round_idx, sampled.tolist())
+            rngs = self.per_client_rngs(round_idx, sampled)
+            (params, bstats, mask_pers, loss, mean_dist, n_accept) = \
+                self._round_jit(params, bstats, mask_pers, self.data,
+                                jnp.asarray(sampled), rngs,
+                                self.round_lr(round_idx))
+            n_samples = float(np.sum(np.asarray(self.data.n_train)[sampled]))
+            self.stat_info["sum_training_flops"] += (
+                flops_per_sample * cfg.optim.epochs * n_samples)
+            # down: dense w_global; up: pruned client models (bounded by
+            # dense count; we log the bound — exact nnz needs a device pull)
+            self.stat_info["sum_comm_params"] += 2.0 * n_params * len(sampled)
+            if round_idx % cfg.fed.frequency_of_the_test == 0 \
+                    or round_idx == cfg.fed.comm_round - 1:
+                mp = self.eval_masked_global(params, bstats, mask_pers)
+                self.stat_info["person_test_acc"].append(mp["acc"])
+                self.log.metrics(round_idx, train_loss=loss,
+                                 personal=mp,
+                                 mean_mask_dist=float(mean_dist),
+                                 prunes_accepted=int(n_accept))
+                history.append({"round": round_idx,
+                                "train_loss": float(loss),
+                                "personal_acc": mp["acc"],
+                                "mean_mask_dist": float(mean_dist),
+                                "prunes_accepted": int(n_accept)})
+        m_person = self.eval_masked_global(params, bstats, mask_pers)
+        self.log.metrics(-1, personal=m_person)
+        densities = np.asarray(jax.device_get(jax.vmap(
+            P.density_all_leaves)(jax.vmap(
+                lambda m: jax.tree.map(jnp.multiply, params, m))(mask_pers))))
+        return {"params": params, "batch_stats": bstats,
+                "mask_pers": mask_pers, "history": history,
+                "final_personal": m_person,
+                "client_densities": densities[: self.real_clients]}

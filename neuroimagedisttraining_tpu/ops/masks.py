@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from neuroimagedisttraining_tpu.utils.pytree import tree_map_with_path_names
+from neuroimagedisttraining_tpu.utils.pytree import (
+    tree_by_name as _by_name,
+    tree_map_with_path_names,
+)
 
 PyTree = Any
 
@@ -198,9 +201,3 @@ def mask_hamming_distance(a: PyTree, b: PyTree) -> jax.Array:
         lambda x, y: jnp.sum(jnp.abs(x - y)), a, b))
     return jnp.sum(jnp.stack(parts))
 
-
-def _by_name(tree: PyTree, name: str):
-    node = tree
-    for part in name.split("/"):
-        node = node[part] if isinstance(node, dict) else node[int(part)]
-    return node

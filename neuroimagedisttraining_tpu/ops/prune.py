@@ -1,0 +1,90 @@
+"""Sub-FedAvg iterative-magnitude-pruning primitives
+(fedml_api/standalone/subavg/prune_func.py:9-87), jit-safe.
+
+``fake_prune``: per maskable layer, the ``each_prune_ratio`` percentile of
+|w| over currently-ALIVE weights (mask>0) becomes a threshold; weights with
+|w| below it are dropped from the mask (prune_func.py:9-30 — note the
+comparison is against the FULL tensor, so already-dead weights stay dead).
+The percentile uses numpy's linear interpolation between order statistics.
+
+``real_prune`` is just ``params * mask`` (prune_func.py:33-49) — engines use
+``tree_mul`` directly.
+
+``mask_distance_mean``: mean over maskable layers of the per-layer Hamming
+*fraction* (scipy.spatial.distance.hamming semantics, prune_func.py:52-66).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from neuroimagedisttraining_tpu.ops.masks import is_weight_kernel
+from neuroimagedisttraining_tpu.utils.pytree import (
+    tree_by_name as _by_name,
+    tree_map_with_path_names,
+)
+
+PyTree = Any
+
+
+def _percentile_alive(absw: jax.Array, mask: jax.Array,
+                      ratio: float) -> tuple[jax.Array, jax.Array]:
+    """(threshold, n_alive): the ``ratio`` quantile (linear interpolation,
+    np.percentile parity) of ``absw`` restricted to mask>0."""
+    alive = jnp.where(mask > 0, absw, jnp.inf)
+    n_alive = jnp.sum(mask > 0).astype(jnp.int32)
+    srt = jnp.sort(alive)
+    q = ratio * (n_alive.astype(jnp.float32) - 1.0)
+    lo = jnp.clip(jnp.floor(q).astype(jnp.int32), 0, absw.shape[0] - 1)
+    hi = jnp.clip(lo + 1, 0, absw.shape[0] - 1)
+    frac = q - lo.astype(jnp.float32)
+    v_lo = jnp.take(srt, lo)
+    v_hi = jnp.where(hi < n_alive, jnp.take(srt, hi), v_lo)
+    return v_lo + frac * (v_hi - v_lo), n_alive
+
+
+def fake_prune(each_prune_ratio: float, params: PyTree,
+               masks: PyTree) -> PyTree:
+    """Candidate next mask: drop the bottom ``each_prune_ratio`` fraction of
+    alive |w| per maskable layer; non-maskable leaves keep their mask."""
+
+    def prune(name, m):
+        if not is_weight_kernel(name, m):
+            return m
+        w = _by_name(params, name)
+        absw = jnp.abs(w.reshape(-1))
+        thr, n_alive = _percentile_alive(absw, m.reshape(-1),
+                                         each_prune_ratio)
+        new_m = jnp.where(absw < thr, 0.0, m.reshape(-1))
+        # empty alive set: reference would crash; we keep the (all-zero) mask
+        new_m = jnp.where(n_alive > 0, new_m, m.reshape(-1))
+        return new_m.reshape(m.shape)
+
+    return tree_map_with_path_names(prune, masks)
+
+
+def mask_distance_mean(m1: PyTree, m2: PyTree) -> jax.Array:
+    """Mean over maskable layers of per-layer differing-entry FRACTION
+    (prune_func.py:52-66 dist_masks)."""
+    fracs = []
+
+    def collect(name, a):
+        if is_weight_kernel(name, a):
+            b = _by_name(m2, name)
+            fracs.append(jnp.mean(jnp.abs(a - b)))
+        return a
+
+    tree_map_with_path_names(collect, m1)
+    return jnp.mean(jnp.stack(fracs))
+
+
+def density_all_leaves(params: PyTree) -> jax.Array:
+    """nonzero/total over EVERY leaf (print_pruning, prune_func.py:69-87) —
+    the ``dense`` floor check counts biases/norm params too."""
+    nz = sum(jnp.sum(x != 0) for x in jax.tree.leaves(params))
+    total = sum(x.size for x in jax.tree.leaves(params))
+    return nz.astype(jnp.float32) / total
+

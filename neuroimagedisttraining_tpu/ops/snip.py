@@ -28,7 +28,10 @@ import jax.numpy as jnp
 from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer
 from neuroimagedisttraining_tpu.ops.masks import is_weight_kernel
 from neuroimagedisttraining_tpu.ops.topk import kth_largest
-from neuroimagedisttraining_tpu.utils.pytree import tree_map_with_path_names
+from neuroimagedisttraining_tpu.utils.pytree import (
+    tree_by_name as _get,
+    tree_map_with_path_names,
+)
 
 PyTree = Any
 
@@ -120,9 +123,3 @@ def mask_from_scores(scores: PyTree, keep_ratio: float) -> tuple[PyTree, jax.Arr
 
     return tree_map_with_path_names(build, scores), threshold
 
-
-def _get(tree: PyTree, name: str):
-    node = tree
-    for part in name.split("/"):
-        node = node[part] if isinstance(node, dict) else node[int(part)]
-    return node

@@ -85,6 +85,15 @@ def tree_weighted_mean(stacked: PyTree, weights: jax.Array) -> PyTree:
     return jax.tree.map(leaf, stacked)
 
 
+def tree_by_name(tree: PyTree, name: str):
+    """Look up a leaf by its '/'-joined key path (the naming used by
+    tree_map_with_path_names)."""
+    node = tree
+    for part in name.split("/"):
+        node = node[part] if isinstance(node, dict) else node[int(part)]
+    return node
+
+
 def tree_map_with_path_names(fn: Callable[[str, jax.Array], jax.Array],
                              tree: PyTree) -> PyTree:
     """Map with a '/'-joined key-path string, for name-conditioned transforms

@@ -92,3 +92,257 @@ def test_dpsgd_end_to_end(tmp_path, synthetic_cohort):
     result = engine.train()
     assert np.isfinite(result["history"][-1]["train_loss"])
     assert 0.0 <= result["final_global"]["acc"] <= 1.0
+
+
+def _dispfl_engine(tmp_path, cohort, sparsity=None, **fed_kw):
+    from neuroimagedisttraining_tpu.config import SparsityConfig
+
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm="dispfl",
+        data=DataConfig(dataset="synthetic", partition_method="site"),
+        optim=OptimConfig(lr=1e-3, batch_size=8, epochs=1),
+        fed=FedConfig(client_num_in_total=4, comm_round=3,
+                      frequency_of_the_test=1, **fed_kw),
+        sparsity=sparsity or SparsityConfig(dense_ratio=0.5),
+        log_dir=str(tmp_path),
+    )
+    mesh = make_mesh()
+    fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh)
+    model = create_model(cfg.model, num_classes=1)
+    trainer = LocalTrainer(model, cfg.optim, num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    return create_engine("dispfl", cfg, fed, trainer, mesh=mesh, logger=log)
+
+
+def test_dispfl_end_to_end_with_dropout(tmp_path, synthetic_cohort):
+    """active=0.7 fault injection: rounds run, metrics finite, masks evolve."""
+    engine = _dispfl_engine(tmp_path, synthetic_cohort, active=0.7)
+    result = engine.train()
+    assert np.isfinite(result["history"][-1]["train_loss"])
+    assert 0.0 <= result["final_personal"]["acc"] <= 1.0
+    # fire/regrow happened: mask_change > 0 after round 0
+    assert result["history"][1]["mask_change"] > 0
+    # all-pairs hamming matrix is symmetric with zero diagonal
+    D = result["mask_dis_matrix"]
+    np.testing.assert_allclose(D, D.T)
+    assert np.all(np.diag(D) == 0)
+    assert engine.stat_info["sum_comm_params"] > 0
+    assert engine.stat_info["sum_training_flops"] > 0
+
+
+def test_dispfl_nnz_preserved_across_rounds(tmp_path, synthetic_cohort):
+    """fire drops exactly k per layer and regrow adds back exactly k, so
+    per-client per-layer nnz is invariant across rounds."""
+    from neuroimagedisttraining_tpu.engines.dispfl import DisPFLEngine
+
+    engine = _dispfl_engine(tmp_path, synthetic_cohort)
+    gs = engine.init_global_state()
+    masks0, _ = engine.init_masks_all(gs.params)
+    nnz0 = [int(np.asarray(m).sum())
+            for m in DisPFLEngine._maskable_leaves(masks0)]
+    result = engine.train()
+    nnz1 = [int(np.asarray(m).sum())
+            for m in DisPFLEngine._maskable_leaves(result["masks"])]
+    assert nnz0 == nnz1
+
+
+def test_dispfl_diff_spa_densities(tmp_path, synthetic_cohort):
+    from neuroimagedisttraining_tpu.config import SparsityConfig
+    from neuroimagedisttraining_tpu.ops.masks import is_weight_kernel
+
+    engine = _dispfl_engine(
+        tmp_path, synthetic_cohort,
+        sparsity=SparsityConfig(dense_ratio=0.5, diff_spa=True, uniform=True))
+    gs = engine.init_global_state()
+    masks, w_spa = engine.init_masks_all(gs.params)
+    assert w_spa[:4] == [0.2, 0.4, 0.6, 0.8]
+    # per-client overall density over maskable leaves tracks w_spa
+    flat = jax.tree_util.tree_leaves_with_path(masks)
+    per_client_nnz = np.zeros(4)
+    per_client_tot = np.zeros(4)
+    for path, m in flat:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if is_weight_kernel(name, m[0]):
+            per_client_nnz += np.asarray(m).reshape(m.shape[0], -1).sum(1)[:4]
+            per_client_tot += m[0].size
+    dens = per_client_nnz / per_client_tot
+    np.testing.assert_allclose(dens, [0.2, 0.4, 0.6, 0.8], atol=0.05)
+
+
+def test_dispfl_adjacency_semantics(tmp_path, synthetic_cohort):
+    engine = _dispfl_engine(tmp_path, synthetic_cohort, active=1.0, frac=0.5)
+    active = np.ones(engine.num_clients, bool)
+    A = engine.adjacency(0, active)
+    # every row includes self; padding clients are isolated
+    assert np.all(np.diag(A) == 1)
+    for c in range(engine.real_clients, engine.num_clients):
+        assert A[c].sum() == 1
+    # inactive client receives nothing but itself
+    active2 = active.copy()
+    active2[1] = False
+    A2 = engine.adjacency(0, active2)
+    assert A2[1].sum() == 1 and A2[1, 1] == 1
+
+
+# ---------------- Sub-FedAvg ----------------
+
+def test_subavg_fake_prune_percentile_matches_numpy():
+    from neuroimagedisttraining_tpu.ops import prune as P
+
+    rng = np.random.default_rng(0)
+    w = {"layer": {"kernel": jnp.asarray(rng.normal(size=(8, 16)),
+                                         jnp.float32)}}
+    m = {"layer": {"kernel": jnp.ones((8, 16), jnp.float32)}}
+    # knock out some entries so "alive" is a strict subset
+    m["layer"]["kernel"] = m["layer"]["kernel"].at[0, :8].set(0.0)
+    new = P.fake_prune(0.3, w, m)
+    # numpy reference: percentile over alive |w|, then |w| < thr -> 0
+    wn = np.asarray(w["layer"]["kernel"])
+    mn = np.asarray(m["layer"]["kernel"])
+    alive = np.abs(wn[mn > 0])
+    thr = np.percentile(alive, 30)
+    want = np.where(np.abs(wn) < thr, 0.0, mn)
+    np.testing.assert_allclose(np.asarray(new["layer"]["kernel"]), want)
+
+
+def test_subavg_end_to_end_prunes(tmp_path, synthetic_cohort):
+    """Loose thresholds so the accept-test fires: density drops below 1."""
+    from neuroimagedisttraining_tpu.config import SparsityConfig
+
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm="subavg",
+        data=DataConfig(dataset="synthetic", partition_method="site"),
+        optim=OptimConfig(lr=1e-2, batch_size=8, epochs=2),
+        fed=FedConfig(client_num_in_total=4, comm_round=3,
+                      frequency_of_the_test=1),
+        sparsity=SparsityConfig(each_prune_ratio=0.2, dist_thresh=0.0,
+                                acc_thresh=0.0, dense_ratio=0.1),
+        log_dir=str(tmp_path))
+    mesh = make_mesh()
+    fed, _ = federate_cohort(synthetic_cohort, partition_method="site",
+                             mesh=mesh)
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1), cfg.optim,
+                           num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    engine = create_engine("subavg", cfg, fed, trainer, mesh=mesh, logger=log)
+    result = engine.train()
+    assert np.isfinite(result["history"][-1]["train_loss"])
+    assert result["history"][-1]["prunes_accepted"] > 0
+    assert np.all(result["client_densities"] < 1.0)
+    assert np.all(result["client_densities"] > 0.0)
+
+
+def test_subavg_accept_test_rejects(tmp_path, synthetic_cohort):
+    """Impossible acc threshold -> no prune ever accepted, masks stay ones."""
+    from neuroimagedisttraining_tpu.config import SparsityConfig
+
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm="subavg",
+        data=DataConfig(dataset="synthetic", partition_method="site"),
+        optim=OptimConfig(lr=1e-2, batch_size=8, epochs=2),
+        fed=FedConfig(client_num_in_total=4, comm_round=2,
+                      frequency_of_the_test=1),
+        sparsity=SparsityConfig(each_prune_ratio=0.2, dist_thresh=0.0,
+                                acc_thresh=2.0, dense_ratio=0.1),
+        log_dir=str(tmp_path))
+    mesh = make_mesh()
+    fed, _ = federate_cohort(synthetic_cohort, partition_method="site",
+                             mesh=mesh)
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1), cfg.optim,
+                           num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    engine = create_engine("subavg", cfg, fed, trainer, mesh=mesh, logger=log)
+    result = engine.train()
+    assert result["history"][-1]["prunes_accepted"] == 0
+    for m in jax.tree.leaves(result["mask_pers"]):
+        assert bool(jnp.all(m == 1))
+
+
+# ---------------- FedFomo ----------------
+
+def _fomo_engine(tmp_path, cohort, **fed_kw):
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm="fedfomo",
+        data=DataConfig(dataset="synthetic", partition_method="site",
+                        val_fraction=0.25),
+        optim=OptimConfig(lr=1e-2, batch_size=8, epochs=1),
+        fed=FedConfig(client_num_in_total=4, comm_round=3,
+                      frequency_of_the_test=1, **fed_kw),
+        log_dir=str(tmp_path))
+    mesh = make_mesh()
+    fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh,
+                             val_fraction=0.25)
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1), cfg.optim,
+                           num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    return create_engine("fedfomo", cfg, fed, trainer, mesh=mesh, logger=log)
+
+
+def test_fedfomo_requires_val_split(tmp_path, synthetic_cohort):
+    cfg = ExperimentConfig(model="3dcnn_tiny", algorithm="fedfomo",
+                           log_dir=str(tmp_path))
+    mesh = make_mesh()
+    fed, _ = federate_cohort(synthetic_cohort, partition_method="site",
+                             mesh=mesh)  # no val_fraction
+    trainer = LocalTrainer(create_model("3dcnn_tiny", num_classes=1),
+                           cfg.optim, num_classes=1)
+    with pytest.raises(ValueError, match="val_fraction"):
+        create_engine("fedfomo", cfg, fed, trainer, mesh=mesh,
+                      logger=ExperimentLogger(str(tmp_path), "synthetic",
+                                              "x", console=False))
+
+
+def test_fedfomo_end_to_end(tmp_path, synthetic_cohort):
+    engine = _fomo_engine(tmp_path, synthetic_cohort)
+    result = engine.train()
+    assert np.isfinite(result["history"][-1]["train_loss"])
+    assert 0.0 <= result["final_personal"]["acc"] <= 1.0
+    # fomo state evolved away from its init
+    W = np.asarray(result["weights"])
+    assert not np.allclose(W[: engine.real_clients, : engine.real_clients],
+                           1.0 / engine.real_clients)
+    P = np.asarray(result["p_choose"])
+    assert not np.allclose(P, 1.0)
+    # aggregation stayed float (dtype discipline, SURVEY §3.5)
+    for leaf in jax.tree.leaves(result["personal_params"]):
+        assert jnp.issubdtype(leaf.dtype, jnp.floating)
+
+
+def test_fedfomo_partial_participation_uses_fomo_m(tmp_path,
+                                                   synthetic_cohort):
+    engine = _fomo_engine(tmp_path, synthetic_cohort, frac=0.5, fomo_m=1)
+    # neighbor sets: 1 chosen + self
+    for c in range(engine.real_clients):
+        nei = engine.benefit_choose(0, c, np.ones(engine.num_clients))
+        assert len(np.unique(nei)) <= 2
+        assert c in nei
+    result = engine.train()
+    assert np.isfinite(result["history"][-1]["train_loss"])
+
+
+def test_fedfomo_per_round_exceeding_real_clients_terminates(
+        tmp_path, synthetic_cohort):
+    """Regression: default 21-client config on a 4-site cohort used to spin
+    forever in benefit_choose's resample loop."""
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm="fedfomo",
+        data=DataConfig(dataset="synthetic", partition_method="site",
+                        val_fraction=0.25),
+        fed=FedConfig(client_num_in_total=21, comm_round=1),
+        log_dir=str(tmp_path))
+    mesh = make_mesh()
+    fed, _ = federate_cohort(synthetic_cohort, partition_method="site",
+                             mesh=mesh, val_fraction=0.25)
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1), cfg.optim,
+                           num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    engine = create_engine("fedfomo", cfg, fed, trainer, mesh=mesh,
+                           logger=log)
+    nei = engine.benefit_choose(0, 1, np.ones(engine.num_clients))
+    np.testing.assert_array_equal(np.sort(np.unique(nei)),
+                                  np.arange(engine.real_clients))
