@@ -1,0 +1,62 @@
+"""HDF5 cohort reader — the reference ABCD schema.
+
+The reference opens one HDF5 file with keys ``X`` (uint8 voxel volumes),
+``y`` (labels), ``site`` (acquisition-site labels), reads ``y``/``site``
+eagerly and replaces ``X`` with an index tensor for lazy per-batch fetching
+(ABCD/data_loader.py:105-119; the actual voxel I/O happens inside the
+trainers, my_model_trainer.py:185-199).
+
+Here the same split: ``load_abcd_hdf5(lazy=True)`` keeps ``X`` as the open
+h5py dataset (a lazy, sliceable handle the streaming layer fancy-reads per
+round), ``lazy=False`` materializes it (small cohorts / tests).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_abcd_hdf5(path: str, lazy: bool = True) -> dict:
+    """Open a reference-schema HDF5 cohort.
+
+    Returns ``{"X": h5py.Dataset | ndarray, "y": ndarray, "site": ndarray,
+    "file": h5py.File | None}``. With ``lazy=True`` the caller owns the open
+    file handle (close via ``cohort["file"].close()``); voxels are fetched
+    on demand. Schema parity: ABCD/data_loader.py:112-119.
+    """
+    import h5py
+
+    f = h5py.File(path, "r")
+    for key in ("X", "y", "site"):
+        if key not in f:
+            f.close()
+            raise KeyError(
+                f"HDF5 cohort {path!r} missing dataset {key!r} "
+                "(reference schema: X, y, site — ABCD/data_loader.py:112)")
+    y = np.asarray(f["y"])
+    site = np.asarray(f["site"])
+    if lazy:
+        return {"X": f["X"], "y": y, "site": site, "file": f}
+    X = np.asarray(f["X"])
+    f.close()
+    return {"X": X, "y": y, "site": site, "file": None}
+
+
+def fetch_rows(X_source, idx: np.ndarray) -> np.ndarray:
+    """Fancy-read rows by (possibly unsorted) indices, preserving order.
+
+    h5py requires increasing unique indices for fancy reads; the reference
+    sorts the batch index tensor before reading
+    (sailentgrads/my_model_trainer.py:185-193). We sort, read, and undo the
+    permutation so callers get rows in the order they asked for.
+    """
+    idx = np.asarray(idx)
+    if isinstance(X_source, np.ndarray):
+        return X_source[idx]
+    order = np.argsort(idx, kind="stable")
+    sorted_idx, inv = idx[order], np.empty_like(order)
+    inv[order] = np.arange(len(order))
+    # h5py also rejects duplicate indices; collapse then re-expand
+    uniq, uniq_inverse = np.unique(sorted_idx, return_inverse=True)
+    data = X_source[uniq]
+    return data[uniq_inverse][inv]

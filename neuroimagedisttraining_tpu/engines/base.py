@@ -34,19 +34,36 @@ class FederatedEngine:
     """Base class: owns config, trainer, data, mesh, logging, eval."""
 
     name = "base"
+    supports_streaming = False  # engines opt in (need all-client state
+    # resident otherwise)
 
-    def __init__(self, cfg: ExperimentConfig, fed_data: FederatedData,
+    def __init__(self, cfg: ExperimentConfig, fed_data: FederatedData | None,
                  trainer: LocalTrainer, mesh=None,
-                 logger: ExperimentLogger | None = None):
+                 logger: ExperimentLogger | None = None, stream=None):
+        """``fed_data``: device-resident federation, or None when running in
+        streaming mode with a ``StreamingFederation`` (cohort > HBM)."""
         self.cfg = cfg
         self.data = fed_data
+        self.stream = stream
         self.trainer = trainer
         self.mesh = mesh
         self.log = logger or ExperimentLogger(cfg.log_dir, cfg.data.dataset,
                                               cfg.identity())
         self._console = get_logger()
-        self.num_clients = int(fed_data.num_clients)  # includes mesh padding
-        self.real_clients = int(np.sum(np.asarray(fed_data.n_train) > 0))
+        if stream is not None and not self.supports_streaming:
+            raise ValueError(
+                f"algorithm {self.name!r} does not support --streaming "
+                "(needs the whole federation's state device-resident); "
+                "streaming currently supports: fedavg")
+        if fed_data is not None:
+            self.num_clients = int(fed_data.num_clients)  # incl. mesh padding
+            self._n_train_host = np.asarray(fed_data.n_train)
+        elif stream is not None:
+            self.num_clients = int(stream.num_clients)
+            self._n_train_host = np.asarray(stream.n_train)
+        else:
+            raise ValueError("need fed_data or stream")
+        self.real_clients = int(np.sum(self._n_train_host > 0))
         self.stat_info: dict[str, Any] = {
             "sum_comm_params": 0.0, "sum_training_flops": 0.0,
             "global_test_acc": [], "person_test_acc": [],
@@ -56,8 +73,11 @@ class FederatedEngine:
     # ---------- state init ----------
 
     def sample_input(self) -> jax.Array:
-        x = self.data.X_train[0, :1]
-        return jnp.zeros(x.shape, jnp.float32)
+        if self.data is not None:
+            shape = self.data.X_train.shape[2:]
+        else:
+            shape = self.stream.sample_shape
+        return jnp.zeros((1,) + tuple(shape), jnp.float32)
 
     def init_global_state(self) -> ClientState:
         rng = jax.random.key(self.cfg.seed)
@@ -171,8 +191,34 @@ class FederatedEngine:
     def weights_for(self, sampled: np.ndarray) -> jax.Array:
         """FedAvg weights = per-client sample counts of the sampled set
         (fedavg_api.py:102-117)."""
-        n = jnp.asarray(self.data.n_train)[jnp.asarray(sampled)]
+        n = jnp.asarray(self._n_train_host[np.asarray(sampled)])
         return n.astype(jnp.float32)
+
+    # ---------- streamed evaluation (cohort > HBM) ----------
+
+    def _eval_chunk_size(self) -> int:
+        return self.mesh.devices.size if self.mesh is not None else 4
+
+    def eval_global_stream(self, params, bstats, split: str = "test"
+                           ) -> dict[str, float]:
+        """Full-cohort eval of one model, streaming client chunks through
+        the same jitted per-chunk program as the resident path — metric
+        parity by construction."""
+        parts: list[tuple] = []
+        ns: list[np.ndarray] = []
+        for ids, X, y, n in self.stream.eval_chunks(self._eval_chunk_size(),
+                                                    split):
+            out = self._eval_global_jit(params, bstats, X, y, n)
+            parts.append(tuple(np.asarray(o)[: len(ids)] for o in out))
+            ns.append(np.asarray(jax.device_get(n))[: len(ids)])
+            if self.cfg.fed.ci:
+                break
+        cat = [np.concatenate([p[i] for p in parts]) for i in range(4)]
+        n_all = np.concatenate(ns)
+        if self.cfg.fed.ci:
+            cat = [c[:1] for c in cat]
+            n_all = n_all[:1]
+        return self._summarize(*cat, n=n_all)
 
     def train(self) -> dict[str, Any]:
         raise NotImplementedError

@@ -299,8 +299,12 @@ class DisPFLEngine(FederatedEngine):
                 per_params, per_bstats, masks_local, masks_shared, self.data,
                 A, rngs, self.round_lr(round_idx), jnp.float32(round_idx))
             real = self.real_clients
+            # comm = actual gossip edges: client c receives each neighbor
+            # j != c's sparse model (nnz of j's mask + dense leaves)
+            A_off = np.asarray(jax.device_get(A))[:real, :real].copy()
+            np.fill_diagonal(A_off, 0.0)
             self.stat_info["sum_comm_params"] += float(
-                2.0 * comm_per_client[:real].sum())
+                (A_off @ comm_per_client[:real]).sum())
             self.stat_info["sum_training_flops"] += flops_per_round
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:

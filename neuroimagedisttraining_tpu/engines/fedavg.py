@@ -28,76 +28,97 @@ from neuroimagedisttraining_tpu.utils import pytree as pt
 
 class FedAvgEngine(FederatedEngine):
     name = "fedavg"
+    supports_streaming = True
+
+    def _max_samples(self) -> int:
+        return (self.stream.nmax_train if self.stream is not None
+                else int(self.data.X_train.shape[1]))
+
+    def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr):
+        """One FedAvg round over pre-gathered sampled-client shards; shared
+        by the device-resident and streaming paths."""
+        trainer = self.trainer
+        o = self.cfg.optim
+        S = Xs.shape[0]
+        max_samples = self._max_samples()
+        cs = ClientState(
+            params=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
+            batch_stats=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape), bstats),
+            opt_state=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape),
+                trainer.opt.init(params)),
+            rng=rngs,
+        )
+
+        def local(cs_c, Xc, yc, nc):
+            return trainer.local_train(
+                cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples)
+
+        cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
+        w = ns.astype(jnp.float32)
+        new_params = pt.tree_weighted_mean(cs.params, w)
+        new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+        mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        return new_params, new_bstats, mean_loss
 
     @functools.cached_property
     def _round_jit(self):
-        trainer = self.trainer
-        o = self.cfg.optim
-        S = min(self.cfg.fed.client_num_per_round, self.real_clients)
-        max_samples = int(self.data.X_train.shape[1])
-
         def round_fn(params, bstats, data, sampled_idx, rngs, lr):
             Xs = jnp.take(data.X_train, sampled_idx, axis=0)
             ys = jnp.take(data.y_train, sampled_idx, axis=0)
             ns = jnp.take(data.n_train, sampled_idx, axis=0)
-            cs = ClientState(
-                params=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
-                batch_stats=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), bstats),
-                opt_state=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape),
-                    trainer.opt.init(params)),
-                rng=rngs,
-            )
-
-            def local(cs_c, Xc, yc, nc):
-                return trainer.local_train(
-                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                    batch_size=o.batch_size, max_samples=max_samples)
-
-            cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
-            w = ns.astype(jnp.float32)
-            new_params = pt.tree_weighted_mean(cs.params, w)
-            new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
-            mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
-            return new_params, new_bstats, mean_loss
+            return self._round_body(params, bstats, Xs, ys, ns, rngs, lr)
 
         return jax.jit(round_fn)
 
     @functools.cached_property
-    def _finetune_jit(self):
-        """Final per-client fine-tune from the aggregated model
-        (fedavg_api.py:79-88) — produces the personalized models."""
+    def _round_stream_jit(self):
+        return jax.jit(self._round_body)
+
+    def _finetune_body(self, params, bstats, X, y, n, rngs, lr):
+        """Per-client fine-tune from the aggregated model over a block of
+        clients (fedavg_api.py:79-88) — produces personalized models."""
         trainer = self.trainer
         o = self.cfg.optim
-        C = self.num_clients
-        max_samples = int(self.data.X_train.shape[1])
+        C = X.shape[0]
+        max_samples = self._max_samples()
+        cs = ClientState(
+            params=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (C,) + x.shape), params),
+            batch_stats=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (C,) + x.shape), bstats),
+            opt_state=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (C,) + x.shape),
+                trainer.opt.init(params)),
+            rng=rngs,
+        )
 
+        def local(cs_c, Xc, yc, nc):
+            return trainer.local_train(
+                cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples)
+
+        cs, _ = jax.vmap(local)(cs, X, y, n)
+        return cs
+
+    @functools.cached_property
+    def _finetune_jit(self):
         def ft(params, bstats, data, rngs, lr):
-            cs = ClientState(
-                params=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (C,) + x.shape), params),
-                batch_stats=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (C,) + x.shape), bstats),
-                opt_state=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (C,) + x.shape),
-                    trainer.opt.init(params)),
-                rng=rngs,
-            )
-
-            def local(cs_c, Xc, yc, nc):
-                return trainer.local_train(
-                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                    batch_size=o.batch_size, max_samples=max_samples)
-
-            cs, _ = jax.vmap(local)(cs, data.X_train, data.y_train,
-                                    data.n_train)
-            return cs
+            return self._finetune_body(params, bstats, data.X_train,
+                                       data.y_train, data.n_train, rngs, lr)
 
         return jax.jit(ft)
 
+    @functools.cached_property
+    def _finetune_stream_jit(self):
+        return jax.jit(self._finetune_body)
+
     def train(self):
+        if self.stream is not None:
+            return self._train_streaming()
         cfg = self.cfg
         gs = self.init_global_state()
         params, bstats = gs.params, gs.batch_stats
@@ -130,4 +151,67 @@ class FedAvgEngine(FederatedEngine):
         self.log.metrics(-1, global_=m_global, personal=m_person)
         return {"params": params, "batch_stats": bstats,
                 "personal": per_states, "history": history,
+                "final_global": m_global, "final_personal": m_person}
+
+    # ---------- streaming mode (cohort > HBM) ----------
+
+    def _train_streaming(self):
+        """Same round loop, but only the sampled clients' shards live on
+        device each round (double-buffered host reads), and evaluation +
+        the final fine-tune pass stream the cohort in client chunks."""
+        cfg = self.cfg
+        gs = self.init_global_state()
+        params, bstats = gs.params, gs.batch_stats
+        history = []
+        self.stream.prefetch_train(self.client_sampling(0))
+        for round_idx in range(cfg.fed.comm_round):
+            sampled = self.client_sampling(round_idx)
+            self.log.info("################ round %d (stream): clients %s",
+                          round_idx, sampled.tolist())
+            Xs, ys, ns = self.stream.get_train(sampled)
+            if round_idx + 1 < cfg.fed.comm_round:
+                # overlap next round's host read with this round's compute
+                self.stream.prefetch_train(
+                    self.client_sampling(round_idx + 1))
+            rngs = self.per_client_rngs(round_idx, sampled)
+            params, bstats, loss = self._round_stream_jit(
+                params, bstats, Xs, ys, ns, rngs,
+                self.round_lr(round_idx))
+            if round_idx % cfg.fed.frequency_of_the_test == 0 \
+                    or round_idx == cfg.fed.comm_round - 1:
+                m = self.eval_global_stream(params, bstats)
+                self.stat_info["global_test_acc"].append(m["acc"])
+                self.log.metrics(round_idx, train_loss=loss, **m)
+                history.append({"round": round_idx,
+                                "train_loss": float(loss), **m})
+        # final fine-tune: chunked over client blocks; personalized models
+        # are evaluated per block then discarded (they'd exceed HBM)
+        chunk = self._eval_chunk_size()
+        ft_lr = self.round_lr(-1)
+        per_parts, per_ns = [], []
+        test_iter = self.stream.eval_chunks(chunk, "test")
+        for ids, Xt, yt, nt in self.stream.eval_chunks(chunk, "train"):
+            if self.cfg.fed.ci and per_parts:
+                break  # CI escape hatch: first chunk only
+            rngs = self.per_client_rngs(
+                cfg.fed.comm_round,
+                np.concatenate([ids, np.full(chunk - len(ids), ids[-1])]))
+            states = self._finetune_stream_jit(params, bstats, Xt, yt, nt,
+                                               rngs, ft_lr)
+            ids_e, Xe, ye, ne = next(test_iter)
+            assert np.array_equal(ids, ids_e)
+            out = self._eval_personal_jit(states.params, states.batch_stats,
+                                          Xe, ye, ne)
+            per_parts.append(tuple(np.asarray(o)[: len(ids)] for o in out))
+            per_ns.append(np.asarray(jax.device_get(ne))[: len(ids)])
+        cat = [np.concatenate([p[i] for p in per_parts]) for i in range(4)]
+        n_cat = np.concatenate(per_ns)
+        if self.cfg.fed.ci:  # client 0 only, matching the resident CI path
+            cat, n_cat = [c[:1] for c in cat], n_cat[:1]
+        m_person = self._summarize(*cat, n=n_cat)
+        m_global = self.eval_global_stream(params, bstats)
+        self.stat_info["person_test_acc"].append(m_person["acc"])
+        self.log.metrics(-1, global_=m_global, personal=m_person)
+        return {"params": params, "batch_stats": bstats,
+                "personal": None, "history": history,
                 "final_global": m_global, "final_personal": m_person}
