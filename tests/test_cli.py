@@ -1,0 +1,96 @@
+"""CLI harness: flag mapping, experiment wiring, and a subprocess smoke."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from neuroimagedisttraining_tpu.__main__ import add_args, config_from_args
+
+
+def _parse(argv):
+    import argparse
+
+    return add_args(argparse.ArgumentParser()).parse_args(argv)
+
+
+def test_flag_mapping_reference_names():
+    args = _parse([
+        "--algorithm", "salientgrads", "--model", "3DCNN",
+        "--dataset", "ABCD", "--partition_method", "dir",
+        "--partition_alpha", "0.3", "--batch_size", "16", "--lr", "0.01",
+        "--lr_decay", "0.998", "--wd", "5e-4", "--epochs", "2",
+        "--client_num_in_total", "21", "--frac", "0.5",
+        "--comm_round", "200", "--dense_ratio", "0.2",
+        "--itersnip_iteration", "20", "--stratified_sampling",
+        "--each_prune_ratio", "0.2", "--lamda", "0.75", "--seed", "7",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.algorithm == "salientgrads"
+    assert cfg.data.partition_method == "dir"
+    assert cfg.optim.batch_size == 16 and cfg.optim.lr_decay == 0.998
+    assert cfg.fed.client_num_in_total == 21 and cfg.fed.frac == 0.5
+    assert cfg.fed.client_num_per_round == 10  # int(21 * 0.5)
+    assert cfg.sparsity.dense_ratio == 0.2
+    assert cfg.sparsity.itersnip_iterations == 20
+    assert cfg.sparsity.stratified_sampling is True
+    assert cfg.sparsity.each_prune_ratio == 0.2
+    assert cfg.fed.lamda == 0.75
+    assert cfg.seed == 7
+    assert "salientgrads" in cfg.identity() and "seed7" in cfg.identity()
+
+
+def test_snip_mask_off_switch():
+    # the reference's `--snip_mask type=bool` bug makes ANY string truthy
+    # (main_sailentgrads.py:125); our explicit off switch must actually work
+    assert config_from_args(_parse([])).sparsity.snip_mask is True
+    assert config_from_args(
+        _parse(["--no_snip_mask"])).sparsity.snip_mask is False
+
+
+def test_cli_subprocess_end_to_end(tmp_path):
+    """One shell command reproduces a FedAvg experiment (VERDICT r1 #6)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "neuroimagedisttraining_tpu",
+         "--algorithm", "fedavg", "--dataset", "synthetic",
+         "--model", "3dcnn_tiny", "--synthetic_num_subjects", "32",
+         "--synthetic_shape", "12", "14", "12",
+         "--client_num_in_total", "4", "--comm_round", "1",
+         "--batch_size", "4", "--epochs", "1", "--virtual_devices", "4",
+         "--log_dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "final_global" in result and "identity" in result
+    assert np.isfinite(result["final_global"]["loss"])
+    # file logging under LOG/<dataset>/<identity> (main_sailentgrads.py:184)
+    logs = list(tmp_path.glob("synthetic/*.log"))
+    assert logs, list(tmp_path.rglob("*"))
+
+
+def test_cli_unknown_dataset_errors(tmp_path):
+    import pytest
+
+    from neuroimagedisttraining_tpu.__main__ import build_experiment
+
+    cfg = config_from_args(_parse(["--dataset", "imagenet",
+                                   "--log_dir", str(tmp_path)]))
+    with pytest.raises(ValueError, match="no loader"):
+        build_experiment(cfg, console=False)
+
+
+def test_streaming_rejected_for_unsupported_algorithm(tmp_path):
+    import pytest
+
+    from neuroimagedisttraining_tpu.__main__ import build_experiment
+    from neuroimagedisttraining_tpu.data.synthetic import write_synthetic_hdf5
+
+    path = str(tmp_path / "c.h5")
+    write_synthetic_hdf5(path, num_subjects=16, shape=(8, 8, 8),
+                         num_sites=2, seed=0)
+    cfg = config_from_args(_parse([
+        "--algorithm", "salientgrads", "--dataset", "abcd_h5",
+        "--data_dir", path, "--log_dir", str(tmp_path)]))
+    with pytest.raises(ValueError, match="streaming"):
+        build_experiment(cfg, streaming=True, console=False)
