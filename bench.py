@@ -1,114 +1,175 @@
-"""Benchmark: federated training throughput of the flagship workload.
+"""Benchmark: federated training throughput of the flagship workload,
+measured on the SHIPPED engine path.
 
-Measures the ABCD-sex-classification federated simulation — AlexNet3D_Dropout
-(bf16 compute, rematerialized conv blocks) over full-size 121x145x121
-volumes, 4 simulated site-clients, batch 16, torch-parity SGD with
-post-round weighted FedAvg aggregation — with MULTIPLE federated rounds
-compiled into one XLA program (``lax.scan`` over rounds), the TPU-native
-shape of the whole framework. Reports samples/second of federated local SGD
-(forward + backward + optimizer + aggregation).
+Phase 1 — FedAvg rounds: times ``FedAvgEngine._round_jit`` (the exact
+program ``engine.train()`` runs: gather sampled clients -> vmapped local SGD
+-> weighted-mean aggregation) on AlexNet3D_Dropout over full-size
+121x145x121 volumes, 4 simulated site-clients, reference-canonical batch 16
+(BASELINE.md).
+
+Phase 2 — SalientGrads mask: times the one-shot federated SNIP mask
+pipeline (per-client saliency scores -> mean -> global top-k), giving the
+Pallas histogram-select kernel (ops/topk.py) real TPU executions, and
+asserts its threshold equals the XLA fallback's on-device.
+
+Reported extras: analytic GFLOP/sample (ops/flops.py), sustained TFLOP/s,
+and MFU against the visible chip's bf16 peak (device-kind table; "mfu" is
+null when the chip is unknown).
 
 ``vs_baseline`` compares against the reference's single-V100 sequential
 simulation. The reference publishes NO numbers (BASELINE.md), so the
-baseline constant below is an engineering estimate of AlexNet3D_Dropout
-training throughput on one V100 (torch 1.12, batch 16, 121^3 volumes,
-~0.25 s/step incl. HDF5 reads => ~64 samples/s). The north-star target in
-BASELINE.json is >= 8x on multi-chip; this bench runs on however many chips
-are visible (1 in the current harness).
+baseline constant is an engineering estimate of AlexNet3D_Dropout training
+throughput on one V100 (torch 1.12, batch 16, 121^3 volumes, ~0.25 s/step
+incl. HDF5 reads => ~64 samples/s). North star: >= 8x (BASELINE.json).
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+Env knobs: BENCH_BATCH (default 16), BENCH_CLIENTS (4), BENCH_ROUNDS (3).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
-V100_BASELINE_SAMPLES_PER_SEC = 64.0  # documented estimate, see module docstring
+V100_BASELINE_SAMPLES_PER_SEC = 64.0  # documented estimate, see docstring
+
+# per-chip bf16 peak FLOP/s by device kind substring
+_PEAK_TFLOPS = {
+    "v2": 45.0, "v3": 123.0, "v4": 275.0,
+    "v5e": 197.0, "v5 lite": 197.0, "v5p": 459.0,
+    "v6e": 918.0, "trillium": 918.0,
+}
+
+
+def _chip_peak_tflops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in sorted(_PEAK_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return peak
+    return None
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
-    from neuroimagedisttraining_tpu.config import OptimConfig
-    from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer
-    from neuroimagedisttraining_tpu.models import AlexNet3D_Dropout
-    from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig, SparsityConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import FederatedData
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.ops import flops as flops_ops
+    from neuroimagedisttraining_tpu.ops.topk import kth_largest
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
 
-    n_clients = 4          # simulated clients per chip
-    batch = 16             # reference canonical batch (BASELINE.md)
-    steps = 4              # local steps per client per round
-    rounds_per_call = 4    # federated rounds fused into one XLA program
+    batch = int(os.environ.get("BENCH_BATCH", 16))
+    n_clients = int(os.environ.get("BENCH_CLIENTS", 4))
+    n_rounds = int(os.environ.get("BENCH_ROUNDS", 3))
+    n_local = 64
     shape = (121, 145, 121)
-    n_local = 64           # device-resident samples per client (uint8)
+    epochs = 1
+    steps = -(-n_local // batch)  # ceil: local steps per client per epoch
 
-    model = AlexNet3D_Dropout(num_classes=1, dtype=jnp.bfloat16)
-    trainer = LocalTrainer(model, OptimConfig(batch_size=batch, epochs=1),
-                           num_classes=1)
+    cfg = ExperimentConfig(
+        model="3DCNN", num_classes=1, algorithm="fedavg",
+        data=DataConfig(dataset="synthetic"),
+        optim=OptimConfig(lr=1e-3, batch_size=batch, epochs=epochs),
+        fed=FedConfig(client_num_in_total=n_clients, comm_round=n_rounds,
+                      frequency_of_the_test=10**9),
+        sparsity=SparsityConfig(dense_ratio=0.5, itersnip_iterations=1),
+        log_dir="/tmp/nidt_bench")
 
-    cs0 = trainer.init_client_state(jax.random.key(0),
-                                    jnp.zeros((1,) + shape, jnp.float32))
-    X = jax.random.randint(jax.random.key(2),
-                           (n_clients, n_local) + shape, 0, 255,
+    # device-resident synthetic federation at real ABCD shapes
+    kx, ky = jax.random.split(jax.random.key(2))
+    X = jax.random.randint(kx, (n_clients, n_local) + shape, 0, 255,
                            dtype=jnp.int32).astype(jnp.uint8)
-    y = jax.random.randint(jax.random.key(3), (n_clients, n_local), 0, 2,
-                           dtype=jnp.int32)
-    n_valid = jnp.full((n_clients,), n_local, jnp.int32)
-    max_samples = steps * batch
+    y = jax.random.randint(ky, (n_clients, n_local), 0, 2, dtype=jnp.int32)
+    n = jnp.full((n_clients,), n_local, jnp.int32)
+    fed = FederatedData(X_train=X, y_train=y, n_train=n,
+                        X_test=X[:, :8], y_test=y[:, :8],
+                        n_test=jnp.full((n_clients,), 8, jnp.int32))
 
-    def bcast(t):
-        return jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (n_clients,) + x.shape), t)
+    from neuroimagedisttraining_tpu.models import AlexNet3D_Dropout
 
-    @jax.jit
-    def simulate(params, bstats, X, y, n_valid, rng):
-        w = n_valid.astype(jnp.float32)
-        def round_body(carry, r):
-            params, bstats, rng = carry
-            rng, sub = jax.random.split(rng)
-            cs = ClientState(params=bcast(params), batch_stats=bcast(bstats),
-                             opt_state=bcast(trainer.opt.init(params)),
-                             rng=jax.random.split(sub, n_clients))
+    remat_env = os.environ.get("BENCH_REMAT", "0")
+    remat: bool | str = {"0": False, "1": True}.get(remat_env, remat_env)
+    model = AlexNet3D_Dropout(num_classes=1, dtype=jnp.bfloat16, remat=remat)
+    trainer = LocalTrainer(model, cfg.optim, num_classes=1)
+    log = ExperimentLogger("/tmp/nidt_bench", "synthetic", cfg.identity(),
+                           console=False)
+    engine = create_engine("fedavg", cfg, fed, trainer, logger=log)
 
-            def local(cs_c, Xc, yc, nc):
-                return trainer.local_train(cs_c, Xc, yc, nc,
-                                           jnp.float32(1e-3), epochs=1,
-                                           batch_size=batch,
-                                           max_samples=max_samples)
+    gs = engine.init_global_state()
+    params, bstats = gs.params, gs.batch_stats
+    sampled = jnp.asarray(engine.client_sampling(0))
 
-            cs, losses = jax.vmap(local)(cs, X, y, n_valid)
-            params = tree_weighted_mean(cs.params, w)
-            bstats = tree_weighted_mean(cs.batch_stats, w)
-            return (params, bstats, rng), jnp.mean(losses)
+    def one_round(params, bstats, r):
+        rngs = engine.per_client_rngs(r, np.arange(n_clients))
+        return engine._round_jit(params, bstats, fed, sampled, rngs,
+                                 engine.round_lr(r))
 
-        (params, bstats, _), losses = jax.lax.scan(
-            round_body, (params, bstats, rng), jnp.arange(rounds_per_call))
-        return params, bstats, jnp.mean(losses)
+    # compile + warmup
+    params, bstats, loss = one_round(params, bstats, 0)
+    jax.block_until_ready((params, bstats))
 
-    params, bstats = cs0.params, cs0.batch_stats
-    # compile + warmup (first call includes compilation)
-    params, bstats, loss = simulate(params, bstats, X, y, n_valid,
-                                    jax.random.key(7))
-    float(loss)  # hard sync through the host
-
-    n_calls = 3
     t0 = time.perf_counter()
-    for i in range(n_calls):
-        params, bstats, loss = simulate(params, bstats, X, y, n_valid,
-                                        jax.random.key(i))
-    float(loss)  # hard sync
+    for r in range(n_rounds):
+        params, bstats, loss = one_round(params, bstats, r + 1)
+    jax.block_until_ready((params, bstats))
     dt = time.perf_counter() - t0
 
-    samples = n_calls * rounds_per_call * n_clients * steps * batch
+    samples = n_rounds * n_clients * epochs * steps * batch
     sps = samples / dt
+
+    # analytic cost + MFU
+    sample_in = trainer._prep(jnp.zeros((1,) + shape, jnp.float32))
+    flops_per_sample = flops_ops.count_training_flops_per_sample(
+        model, params, sample_in, batch_stats=bstats)
+    sustained = sps * flops_per_sample
+    peak = _chip_peak_tflops(jax.devices()[0])
+    mfu = (sustained / (peak * 1e12)) if peak else None
+
+    # ---- phase 2: SalientGrads mask pipeline + Pallas/XLA agreement ----
+    sg = create_engine("salientgrads", cfg, fed, trainer, logger=log)
+    masks, _ = sg.generate_global_mask(params, bstats)  # compile + warmup
+    jax.block_until_ready(masks)
+    t0 = time.perf_counter()
+    masks, thr = sg.generate_global_mask(params, bstats)
+    jax.block_until_ready(masks)
+    mask_ms = (time.perf_counter() - t0) * 1e3
+
+    scores = jax.random.uniform(jax.random.key(5), (1 << 22,))
+    on_tpu = jax.default_backend() == "tpu"
+    thr_pallas = kth_largest(scores, 1 << 21, use_pallas=on_tpu)
+    thr_xla = kth_largest(scores, 1 << 21, use_pallas=False)
+    pallas_ok = bool(jnp.equal(thr_pallas, thr_xla))
+    if on_tpu:
+        t0 = time.perf_counter()
+        kth_largest(scores, 1 << 21, use_pallas=True).block_until_ready()
+        topk_ms = (time.perf_counter() - t0) * 1e3
+    else:
+        topk_ms = None
+
     print(json.dumps({
         "metric": "abcd_fedavg_train_samples_per_sec",
         "value": round(sps, 2),
-        "unit": "samples/s (AlexNet3D 121x145x121, b16, 4 clients, "
-                "4 rounds/program)",
+        "unit": f"samples/s (AlexNet3D 121x145x121, b{batch}, "
+                f"{n_clients} clients, shipped FedAvgEngine round program)",
         "vs_baseline": round(sps / V100_BASELINE_SAMPLES_PER_SEC, 3),
+        "gflops_per_sample": round(flops_per_sample / 1e9, 2),
+        "sustained_tflops": round(sustained / 1e12, 2),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "peak_tflops_assumed": peak,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "salientgrads_mask_ms": round(mask_ms, 1),
+        "pallas_topk_ms_4m": round(topk_ms, 1) if topk_ms else None,
+        "pallas_threshold_matches_xla": pallas_ok,
     }))
 
 

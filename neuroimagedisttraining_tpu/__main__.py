@@ -90,6 +90,14 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     # FedFomo
     parser.add_argument("--fomo_m", type=int, default=5)
     parser.add_argument("--val_fraction", type=float, default=0.0)
+    # robust aggregation (RobustAggregator args, robust_aggregation.py:32-36)
+    parser.add_argument("--defense_type", type=str, default="none",
+                        help="none | norm_diff_clipping | weak_dp")
+    parser.add_argument("--norm_bound", type=float, default=5.0)
+    parser.add_argument("--stddev", type=float, default=0.05)
+    # 3D-model rematerialization policy (PROFILE.md)
+    parser.add_argument("--remat", type=str, default="auto",
+                        help="auto | none | stem | all")
     # synthetic data knobs (tests / demos without the private cohort)
     parser.add_argument("--synthetic_num_subjects", type=int, default=256)
     parser.add_argument("--synthetic_shape", type=int, nargs=3,
@@ -104,6 +112,9 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--virtual_devices", type=int, default=0,
                         help="provision N virtual CPU devices (mesh "
                              "simulation without TPU hardware)")
+    parser.add_argument("--profile_dir", type=str, default="",
+                        help="capture a jax.profiler trace of training "
+                             "into this dir (TensorBoard-loadable)")
     return parser
 
 
@@ -126,7 +137,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             client_num_in_total=args.client_num_in_total, frac=args.frac,
             comm_round=args.comm_round, cs=args.cs, active=args.active,
             lamda=args.lamda, local_epochs=args.local_epochs,
-            fomo_m=args.fomo_m,
+            fomo_m=args.fomo_m, defense_type=args.defense_type,
+            norm_bound=args.norm_bound, stddev=args.stddev,
             frequency_of_the_test=args.frequency_of_the_test,
             ci=bool(args.ci)),
         sparsity=SparsityConfig(
@@ -142,6 +154,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             save_masks=args.save_masks),
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        remat=args.remat,
         log_dir=args.log_dir)
 
 
@@ -166,6 +179,7 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
     log.info("config: %s", cfg.to_json())
 
     stream = None
+    cohort = None
     if dataset in ("abcd", "abcd_h5"):
         cohort = load_abcd_hdf5(d.data_dir, lazy=streaming)
     elif dataset == "synthetic":
@@ -174,12 +188,27 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
             shape=d.synthetic_shape,
             num_sites=max(4, cfg.fed.client_num_in_total // 4),
             seed=cfg.seed)
+    elif dataset in ("cifar10", "cifar100", "tiny", "synthetic_vision"):
+        if streaming:
+            raise ValueError("streaming mode is for ABCD-scale cohorts")
+        from neuroimagedisttraining_tpu.data.vision import federate_vision
+        method = d.partition_method if d.partition_method != "site" else "dir"
+        fed, info = federate_vision(
+            "cifar10" if dataset == "synthetic_vision" else dataset,
+            d.data_dir, method, d.partition_alpha,
+            cfg.fed.client_num_in_total, mesh=mesh,
+            val_fraction=d.val_fraction, seed=cfg.seed,
+            synthetic=dataset == "synthetic_vision",
+            num_classes=cfg.num_classes if cfg.num_classes > 1 else None)
+        log.info("partition: %s", json.dumps(info.get("train_counts")))
     else:
         raise ValueError(
-            f"dataset {dataset!r} has no loader yet (have: abcd/abcd_h5/"
-            "synthetic; cifar/tiny land with the vision data layer)")
+            f"dataset {dataset!r} has no loader (have: abcd/abcd_h5/"
+            "synthetic/cifar10/cifar100/tiny/synthetic_vision)")
 
-    if streaming:
+    if cohort is None:
+        pass  # vision federation already built above
+    elif streaming:
         if d.partition_method != "site":
             raise ValueError("streaming mode currently partitions by site")
         train_map, test_map, _ = P.site_partition(cohort["site"], seed=42)
@@ -194,7 +223,20 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
             val_fraction=d.val_fraction)
         log.info("partition: %s", json.dumps(info.get("train_counts")))
 
-    model = create_model(cfg.model, num_classes=cfg.num_classes)
+    # remat policy for the 3D family (PROFILE.md): no-remat is ~21% faster
+    # but only ~64 full-size samples fit in flight per chip; above that use
+    # stem remat (f0+f1 — same speed as full remat, less HBM).
+    remat: bool | str | None
+    if cfg.remat == "auto":
+        import jax
+
+        n_dev = max(1, len(jax.devices()) if mesh is None
+                    else mesh.devices.size)
+        per_dev = -(-cfg.fed.client_num_per_round // n_dev)
+        remat = False if per_dev * cfg.optim.batch_size <= 64 else "stem"
+    else:
+        remat = {"none": False, "stem": "stem", "all": True}[cfg.remat]
+    model = create_model(cfg.model, num_classes=cfg.num_classes, remat=remat)
     trainer = LocalTrainer(model, cfg.optim, num_classes=cfg.num_classes)
     return create_engine(cfg.algorithm, cfg, fed, trainer, mesh=mesh,
                          logger=log, stream=stream)
@@ -214,13 +256,24 @@ def main(argv: list[str] | None = None) -> int:
     random.seed(args.seed)
     np.random.seed(args.seed)
 
+    # vision datasets imply their class counts unless overridden
+    _vision_classes = {"cifar10": 10, "synthetic_vision": 10,
+                       "cifar100": 100, "tiny": 200}
+    if args.num_classes == 1 and args.dataset.lower() in _vision_classes:
+        args.num_classes = _vision_classes[args.dataset.lower()]
+
     cfg = config_from_args(args)
     mesh = None
     if not args.streaming:
         from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
         mesh = make_mesh()
     engine = build_experiment(cfg, streaming=args.streaming, mesh=mesh)
-    result = engine.train()
+    from neuroimagedisttraining_tpu.utils.profiling import (
+        failure_context, profile_trace,
+    )
+    with failure_context(name=cfg.identity()), \
+            profile_trace(args.profile_dir, enabled=bool(args.profile_dir)):
+        result = engine.train()
 
     final = {k: v for k, v in result.items()
              if k in ("final_global", "final_personal", "mask_density")}

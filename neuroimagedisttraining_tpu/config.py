@@ -89,6 +89,12 @@ class FedConfig:
     local_epochs: int = 1
     # FedFomo
     fomo_m: int = 5                # number of models requested per round
+    # Robust aggregation (fedml_core/robustness/robust_aggregation.py:32-55;
+    # the reference constructs RobustAggregator(args) from defense_type /
+    # norm_bound / stddev flags)
+    defense_type: str = "none"     # none | norm_diff_clipping | weak_dp
+    norm_bound: float = 5.0        # clip threshold for the update-norm diff
+    stddev: float = 0.05           # weak-DP Gaussian noise stddev
     # Evaluation cadence
     frequency_of_the_test: int = 1
     ci: bool = False               # CI mode: evaluate client 0 only
@@ -116,6 +122,9 @@ class ExperimentConfig:
     mesh_shape: tuple[int, ...] = ()   # () => all visible devices on one "clients" axis
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
+    remat: str = "auto"            # auto | none | stem | all — 3D-model
+    # rematerialization policy (PROFILE.md); auto picks from samples
+    # in flight per device (build_experiment)
     checkpoint_dir: str = ""
     checkpoint_every: int = 0          # rounds; 0 disables
     log_dir: str = "LOG"

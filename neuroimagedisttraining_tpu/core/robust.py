@@ -1,0 +1,72 @@
+"""Byzantine-robust aggregation defenses.
+
+Parity target: fedml_core/robustness/robust_aggregation.py:28-55 —
+``RobustAggregator.norm_diff_clipping`` (w_t + clip(w_local − w_t), clip
+scale = max(1, ‖diff‖/norm_bound)) and ``.add_noise`` (weak-DP Gaussian).
+
+The reference excludes BatchNorm running stats from the clipped vector by
+key-name filtering (``is_weight_param``, robust_aggregation.py:28-29). Here
+the exclusion is structural: running stats live in the separate
+``batch_stats`` collection, so clipping the ``params`` pytree alone IS the
+reference's filter — no name matching needed.
+
+The reference ships the aggregator but nothing in the fork calls it
+(SURVEY.md §2.1); BASELINE.json's robustness config ("robust aggregation
+under Byzantine clients") defines the behavior contract. Defenses compose as
+pure functions on stacked client pytrees, applied between local training and
+``tree_weighted_mean`` — inside the jitted round program, so the per-client
+clip norms reduce over the client mesh axis on ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from neuroimagedisttraining_tpu.utils import pytree as pt
+
+DEFENSES = ("none", "norm_diff_clipping", "weak_dp")
+
+
+def norm_diff_clip(local_params, global_params, norm_bound):
+    """w_t + diff / max(1, ‖diff‖/norm_bound), diff = w_local − w_t
+    (robust_aggregation.py:38-49)."""
+    diff = pt.tree_sub(local_params, global_params)
+    norm = pt.tree_norm(diff)
+    scale = jnp.maximum(1.0, norm / jnp.float32(norm_bound))
+    return pt.tree_add(global_params, pt.tree_scale(diff, 1.0 / scale))
+
+
+def add_weak_dp_noise(params, rng, stddev):
+    """Per-leaf Gaussian noise N(0, stddev²) (robust_aggregation.py:51-55)."""
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(rng, len(leaves))
+    noised = [
+        (x + jax.random.normal(k, x.shape, jnp.float32)
+         * jnp.float32(stddev)).astype(x.dtype)
+        for x, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, noised)
+
+
+def defend_stacked(stacked_params, global_params, *, defense: str,
+                   norm_bound: float, stddev: float, rngs=None):
+    """Apply a defense to each client's params along the leading client axis.
+
+    ``norm_diff_clipping``: clip every client's update norm to norm_bound.
+    ``weak_dp``: clipping + per-client Gaussian noise (the weak-DP defense
+    uses the clipped update as its sensitivity bound, so noise composes on
+    top of clipping). ``rngs``: [C] stacked PRNG keys, required for weak_dp.
+    """
+    if defense == "none":
+        return stacked_params
+    if defense not in DEFENSES:
+        raise ValueError(f"unknown defense {defense!r}; one of {DEFENSES}")
+    clipped = jax.vmap(lambda p: norm_diff_clip(p, global_params, norm_bound)
+                       )(stacked_params)
+    if defense == "weak_dp":
+        if rngs is None:
+            raise ValueError("weak_dp needs per-client rngs")
+        clipped = jax.vmap(
+            lambda p, r: add_weak_dp_noise(p, r, stddev))(clipped, rngs)
+    return clipped

@@ -65,17 +65,24 @@ def build_federated_data(
     X: np.ndarray, y: np.ndarray,
     train_map: dict[int, np.ndarray], test_map: dict[int, np.ndarray],
     mesh=None, val_map: dict[int, np.ndarray] | None = None,
+    X_eval: np.ndarray | None = None, y_eval: np.ndarray | None = None,
 ) -> FederatedData:
     """Assemble + (optionally) shard the federation over a mesh. The client
     count is padded up to a multiple of the mesh size with zero-sample
-    clients (their aggregation weight is always 0)."""
+    clients (their aggregation weight is always 0).
+
+    ``X_eval``/``y_eval``: separate pool that ``test_map`` indexes into —
+    vision datasets ship distinct train/test arrays (cifar10
+    data_loader.py:63-72); ABCD-style cohorts index one pool for both."""
     C = len(train_map)
     pad = 0
     if mesh is not None:
         d = mesh.devices.size
         pad = (d - C % d) % d
+    Xev = X if X_eval is None else X_eval
+    yev = y if y_eval is None else y_eval
     Xtr, ytr, ntr = _stack_pad(X, y, train_map, pad)
-    Xte, yte, nte = _stack_pad(X, y, test_map, pad)
+    Xte, yte, nte = _stack_pad(Xev, yev, test_map, pad)
     parts = dict(X_train=Xtr, y_train=ytr, n_train=ntr,
                  X_test=Xte, y_test=yte, n_test=nte)
     if val_map is not None:
@@ -121,8 +128,8 @@ def federate_cohort(data: dict[str, np.ndarray], partition_method: str = "site",
         # carve validation out of each client's train shard (FedFomo 9-tuple,
         # cifar10/data_val_loader.py:83-260)
         val_map, new_train = {}, {}
+        rs = np.random.RandomState(seed + 1)  # one stream across clients
         for c, idx in train_map.items():
-            rs = np.random.RandomState(seed + 1)
             idx = np.array(idx, copy=True)
             rs.shuffle(idx)
             nv = max(1, int(len(idx) * val_fraction))

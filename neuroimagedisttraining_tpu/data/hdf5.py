@@ -50,13 +50,16 @@ def fetch_rows(X_source, idx: np.ndarray) -> np.ndarray:
     (sailentgrads/my_model_trainer.py:185-193). We sort, read, and undo the
     permutation so callers get rows in the order they asked for.
     """
+    from neuroimagedisttraining_tpu.utils import native
+
     idx = np.asarray(idx)
     if isinstance(X_source, np.ndarray):
-        return X_source[idx]
+        # multithreaded native row gather (numpy fallback inside)
+        return native.gather_rows(X_source, idx)
     order = np.argsort(idx, kind="stable")
     sorted_idx, inv = idx[order], np.empty_like(order)
     inv[order] = np.arange(len(order))
     # h5py also rejects duplicate indices; collapse then re-expand
     uniq, uniq_inverse = np.unique(sorted_idx, return_inverse=True)
-    data = X_source[uniq]
-    return data[uniq_inverse][inv]
+    data = np.ascontiguousarray(X_source[uniq])
+    return native.gather_rows(data, uniq_inverse[inv])

@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 from neuroimagedisttraining_tpu.data.hdf5 import fetch_rows
+from neuroimagedisttraining_tpu.utils import native
 
 
 class StreamingFederation:
@@ -72,7 +73,12 @@ class StreamingFederation:
         for j, c in enumerate(client_ids):
             idx = idx_map[int(c)]
             if len(idx):
-                Xs[j, : len(idx)] = fetch_rows(self.X, idx)
+                if isinstance(self.X, np.ndarray):
+                    # native multithreaded gather straight into the padded
+                    # round buffer (no intermediate copy)
+                    native.gather_rows(self.X, idx, out=Xs[j])
+                else:
+                    Xs[j, : len(idx)] = fetch_rows(self.X, idx)
                 ys[j, : len(idx)] = self.y[idx]
             ns[j] = len(idx)
         return Xs, ys, ns

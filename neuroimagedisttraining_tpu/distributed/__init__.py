@@ -1,0 +1,14 @@
+"""Cross-silo distributed control plane (the reference's
+fedml_core/distributed, rebuilt TPU-native: msgpack messages + TCP sockets
+for control, XLA collectives over ICI/DCN for bulk tensors)."""
+
+from neuroimagedisttraining_tpu.distributed.message import Message  # noqa: F401
+from neuroimagedisttraining_tpu.distributed.comm import (  # noqa: F401
+    BaseCommManager, Observer, SocketCommManager,
+)
+from neuroimagedisttraining_tpu.distributed.managers import (  # noqa: F401
+    ClientManager, DistributedManager, ServerManager,
+)
+from neuroimagedisttraining_tpu.distributed.cross_silo import (  # noqa: F401
+    FedAvgClientProc, FedAvgServer, init_multihost,
+)

@@ -1,0 +1,160 @@
+"""Transport-agnostic communication abstraction + the TCP socket backend.
+
+Abstraction parity: fedml_core/distributed/communication/base_com_manager.py:7-27
+(``BaseCommManager``: send_message / add_observer / handle_receive_message /
+stop_receive_message) and observer.py:4-7 (``Observer.receive_message``).
+
+Backend re-design: the reference ships MPI (daemon send/recv threads +
+0.3 s polling loop, mpi/com_manager.py:13-98), a gRPC manager that cannot
+import in the fork, and MQTT. None of those suit a TPU-pod deployment; the
+bulk path there is XLA collectives over ICI/DCN (parallel/mesh.py), and the
+control plane only carries small coordination messages. This backend is a
+dependency-free TCP transport: length-prefixed msgpack frames, one listener
+thread per process, blocking dispatch via a queue (no polling sleep), clean
+shutdown via sentinel (the reference kills threads with
+PyThreadState_SetAsyncExc, mpi_send_thread.py:47-53 — unsound; we join).
+
+Rank->address resolution mirrors the gRPC backend's ip-config table
+(grpc_comm_manager.py:53-74): {rank: (host, base_port + rank)}.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from abc import ABC, abstractmethod
+
+from neuroimagedisttraining_tpu.distributed.message import Message
+
+BASE_PORT = 50000  # parity: gRPC backend's 50000 + rank (grpc_server.py)
+
+
+class Observer(ABC):
+    @abstractmethod
+    def receive_message(self, msg_type: str, msg: Message) -> None: ...
+
+
+class BaseCommManager(ABC):
+    """5-method contract (base_com_manager.py:7-27)."""
+
+    @abstractmethod
+    def send_message(self, msg: Message) -> None: ...
+
+    @abstractmethod
+    def add_observer(self, observer: Observer) -> None: ...
+
+    @abstractmethod
+    def remove_observer(self, observer: Observer) -> None: ...
+
+    @abstractmethod
+    def handle_receive_message(self) -> None: ...
+
+    @abstractmethod
+    def stop_receive_message(self) -> None: ...
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class SocketCommManager(BaseCommManager):
+    """Point-to-point TCP manager for one rank.
+
+    Every rank listens on ``base_port + rank``; ``send_message`` opens a
+    short-lived connection to the receiver's port and writes one
+    length-prefixed frame. ``handle_receive_message`` blocks dispatching
+    queued messages to observers until ``stop_receive_message``.
+    """
+
+    _STOP = object()
+
+    def __init__(self, rank: int, world_size: int,
+                 host_map: dict[int, str] | None = None,
+                 base_port: int = BASE_PORT):
+        self.rank = rank
+        self.world_size = world_size
+        self.base_port = base_port
+        self.host_map = host_map or {r: "127.0.0.1"
+                                     for r in range(world_size)}
+        self._observers: list[Observer] = []
+        self._q: queue.Queue = queue.Queue()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", base_port + rank))
+        self._server.listen(world_size * 2)
+        self._running = True
+        self._listener = threading.Thread(target=self._listen_loop,
+                                          daemon=True)
+        self._listener.start()
+
+    # ---- receive side ----
+
+    def _listen_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return  # socket closed during shutdown
+            with conn:
+                header = _recv_exact(conn, 8)
+                if header is None:
+                    continue
+                (length,) = struct.unpack("!Q", header)
+                raw = _recv_exact(conn, length)
+                if raw is None:
+                    continue
+            self._q.put(Message.from_bytes(raw))
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        """Blocking dispatch loop (the reference polls with a 0.3 s sleep,
+        mpi/com_manager.py:71-79; a blocking queue needs no sleep)."""
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+            for obs in list(self._observers):
+                obs.receive_message(item.msg_type, item)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._q.put(self._STOP)
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+    # ---- send side ----
+
+    def send_message(self, msg: Message, retries: int = 50,
+                     retry_delay: float = 0.1) -> None:
+        import time
+
+        raw = msg.to_bytes()
+        addr = (self.host_map[msg.receiver_id],
+                self.base_port + msg.receiver_id)
+        last_err: Exception | None = None
+        for _ in range(retries):  # receiver may not be listening yet
+            try:
+                with socket.create_connection(addr, timeout=10.0) as conn:
+                    conn.sendall(struct.pack("!Q", len(raw)) + raw)
+                return
+            except OSError as e:
+                last_err = e
+                time.sleep(retry_delay)
+        raise ConnectionError(
+            f"rank {self.rank} could not reach rank {msg.receiver_id} "
+            f"at {addr}: {last_err}")

@@ -1,0 +1,154 @@
+"""Cross-silo federated orchestration over the socket control plane.
+
+The capability SURVEY §2.3 requires: a server/client message loop carrying
+the reference protocol {register -> init/broadcast params -> local train ->
+upload update -> aggregate -> sync or finish} (client_manager.py /
+server_manager.py semantics), as runnable processes. Within one silo the
+bulk compute path is still the jitted SPMD round program; this layer
+coordinates *between* silos (separate hosts/processes), where the
+reference's MPI/gRPC runtime would have lived — model payloads ride the
+msgpack codec, and each silo trains with its own jitted LocalTrainer round.
+
+``FedAvgServer.run()`` drives ``comm_round`` rounds; each
+``FedAvgClientProc`` owns a ``train_fn(params, round_idx) -> (params,
+num_samples)`` — silos are free to implement it with any engine. Weighted
+aggregation happens on the server in float32 numpy (parity:
+fedavg_api.py:102-117).
+
+Multi-host TPU pods: use ``init_multihost`` (jax.distributed) so each silo
+process joins one global JAX runtime and bulk tensors can instead ride DCN
+collectives; the socket plane then only carries control messages.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+import jax
+
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.distributed.managers import (
+    ClientManager, ServerManager,
+)
+
+
+def init_multihost(coordinator_address: str, num_processes: int,
+                   process_id: int) -> None:
+    """Join this process to a multi-host JAX runtime (DCN collectives).
+    Thin wrapper so silos opt in with one call; requires all processes to
+    call it before any backend touch."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def _to_numpy_tree(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+class FedAvgServer(ServerManager):
+    """Rank 0. Aggregates client updates sample-weighted per round."""
+
+    def __init__(self, init_params, comm_round: int, num_clients: int,
+                 **kw):
+        super().__init__(rank=0, world_size=num_clients + 1, **kw)
+        self.params = _to_numpy_tree(init_params)
+        self.comm_round = comm_round
+        self.num_clients = num_clients
+        self.round_idx = 0
+        self._registered: set[int] = set()
+        self._updates: dict[int, tuple] = {}
+        self.history: list[dict] = []
+        self._done = threading.Event()
+
+    # ---- handlers ----
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_REGISTER, self._on_register)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_SEND_MODEL, self._on_model)
+
+    def _on_register(self, msg: M.Message) -> None:
+        self._registered.add(msg.sender_id)
+        if len(self._registered) == self.num_clients:
+            self._broadcast_sync(M.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _on_model(self, msg: M.Message) -> None:
+        self._updates[msg.sender_id] = (
+            msg.get(M.ARG_MODEL_PARAMS), float(msg.get(M.ARG_NUM_SAMPLES)))
+        if len(self._updates) < self.num_clients:
+            return
+        # weighted FedAvg (fedavg_api.py:102-117)
+        trees, ws = zip(*self._updates.values())
+        w = np.asarray(ws, np.float64)
+        w = w / w.sum()
+        self.params = jax.tree.map(
+            lambda *leaves: sum(
+                wi * np.asarray(leaf, np.float32)
+                for wi, leaf in zip(w, leaves)).astype(
+                    np.asarray(leaves[0]).dtype),
+            *trees)
+        self._updates.clear()
+        self.history.append({"round": self.round_idx,
+                             "clients": int(len(ws))})
+        self.round_idx += 1
+        if self.round_idx >= self.comm_round:
+            self._broadcast_finish()
+            self._done.set()
+            self.finish()
+        else:
+            self._broadcast_sync(M.MSG_TYPE_S2C_SYNC_MODEL)
+
+    # ---- sends ----
+
+    def _broadcast_sync(self, msg_type: str) -> None:
+        for c in range(1, self.num_clients + 1):
+            msg = M.Message(msg_type, 0, c)
+            msg.add(M.ARG_MODEL_PARAMS, self.params)
+            msg.add(M.ARG_ROUND_IDX, self.round_idx)
+            msg.add(M.ARG_CLIENT_INDEX, c - 1)
+            self.send_message(msg)
+
+    def _broadcast_finish(self) -> None:
+        for c in range(1, self.num_clients + 1):
+            self.send_message(M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
+
+
+class FedAvgClientProc(ClientManager):
+    """Rank >= 1. Trains via the injected ``train_fn`` on every sync."""
+
+    def __init__(self, rank: int, num_clients: int,
+                 train_fn: Callable, **kw):
+        super().__init__(rank=rank, world_size=num_clients + 1, **kw)
+        self.train_fn = train_fn
+        self.final_params = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_SYNC_MODEL, self._on_sync)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_FINISH, self._on_finish)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        reg = M.Message(M.MSG_TYPE_C2S_REGISTER, self.rank, 0)
+        self.send_message(reg)
+        self.com_manager.handle_receive_message()
+
+    def _on_sync(self, msg: M.Message) -> None:
+        params = msg.get(M.ARG_MODEL_PARAMS)
+        round_idx = int(msg.get(M.ARG_ROUND_IDX))
+        new_params, n = self.train_fn(params, round_idx)
+        out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+        out.add(M.ARG_MODEL_PARAMS, _to_numpy_tree(new_params))
+        out.add(M.ARG_NUM_SAMPLES, float(n))
+        self.send_message(out)
+
+    def _on_finish(self, msg: M.Message) -> None:
+        self.final_params = None  # server holds the aggregate
+        self.finish()

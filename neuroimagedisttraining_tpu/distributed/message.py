@@ -1,0 +1,81 @@
+"""Typed message model for the cross-silo control plane.
+
+Semantics parity with fedml_core/distributed/communication/message.py:5-74:
+a message is {msg_type, sender_id, receiver_id} + a key-value payload whose
+values may be model-parameter pytrees. Codec re-design: the reference
+serializes to JSON (message.py:62-65 — model weights would ship as JSON
+lists); here the wire format is a 2-frame msgpack envelope — a small header
+dict plus a flax-msgpack body for array payloads — so a 2.6 M-param model is
+~10 MB binary, not ~60 MB of JSON text.
+
+Message-type constants keep the reference protocol contract
+(SURVEY.md §5.8): init/broadcast params -> local train -> upload update ->
+aggregate, plus register/finish lifecycle.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import jax
+from flax import serialization
+
+# protocol message types (client_manager.py / server_manager.py handler keys)
+MSG_TYPE_CONNECTION_IS_READY = "connection_ready"
+MSG_TYPE_C2S_REGISTER = "client_register"
+MSG_TYPE_S2C_INIT_CONFIG = "server_init_config"
+MSG_TYPE_S2C_SYNC_MODEL = "server_sync_model"
+MSG_TYPE_C2S_SEND_MODEL = "client_send_model"
+MSG_TYPE_S2C_FINISH = "server_finish"
+
+# payload keys (Message.MSG_ARG_KEY_* parity)
+ARG_MODEL_PARAMS = "model_params"
+ARG_NUM_SAMPLES = "num_samples"
+ARG_CLIENT_INDEX = "client_index"
+ARG_ROUND_IDX = "round_idx"
+
+_MAGIC = b"NIDT1"
+
+
+class Message:
+    """dict-shaped message with typed header (message.py:5-35)."""
+
+    def __init__(self, msg_type: str = "default", sender_id: int = 0,
+                 receiver_id: int = 0):
+        self.msg_type = msg_type
+        self.sender_id = int(sender_id)
+        self.receiver_id = int(receiver_id)
+        self.params: dict[str, Any] = {}
+
+    def add(self, key: str, value: Any) -> None:
+        self.params[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.params.get(key, default)
+
+    # ---- codec ----
+
+    def to_bytes(self) -> bytes:
+        body = {
+            "h": {"t": self.msg_type, "s": self.sender_id,
+                  "r": self.receiver_id},
+            "p": jax.tree.map(
+                lambda v: np.asarray(v)
+                if isinstance(v, (jax.Array, np.ndarray)) else v,
+                self.params),
+        }
+        return _MAGIC + serialization.msgpack_serialize(body)
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "Message":
+        if raw[: len(_MAGIC)] != _MAGIC:
+            raise ValueError("bad message frame (magic mismatch)")
+        body = serialization.msgpack_restore(raw[len(_MAGIC):])
+        m = Message(body["h"]["t"], body["h"]["s"], body["h"]["r"])
+        m.params = body["p"]
+        return m
+
+    def __repr__(self) -> str:  # small, no payload dump
+        return (f"Message({self.msg_type}, {self.sender_id}->"
+                f"{self.receiver_id}, keys={sorted(self.params)})")

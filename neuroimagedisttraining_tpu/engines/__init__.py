@@ -9,6 +9,7 @@ from neuroimagedisttraining_tpu.engines.dpsgd import DPSGDEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.dispfl import DisPFLEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.subavg import SubFedAvgEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.fedfomo import FedFomoEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.turboaggregate import TurboAggregateEngine  # noqa: F401
 
 ENGINES = {
     "fedavg": FedAvgEngine,
@@ -21,6 +22,7 @@ ENGINES = {
     "subavg": SubFedAvgEngine,
     "sub-fedavg": SubFedAvgEngine,
     "fedfomo": FedFomoEngine,
+    "turboaggregate": TurboAggregateEngine,
 }
 
 

@@ -286,7 +286,14 @@ class DisPFLEngine(FederatedEngine):
             for c in range(self.real_clients))
 
         history = []
-        for round_idx in range(cfg.fed.comm_round):
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            per_params, per_bstats = (restored["per_params"],
+                                      restored["per_bstats"])
+            masks_local, masks_shared = (restored["masks_local"],
+                                         restored["masks_shared"])
+            history = restored["history"]
+        for round_idx in range(start, cfg.fed.comm_round):
             active = self.active_draw(round_idx)
             A = jnp.asarray(self.adjacency(round_idx, active))
             rngs = self.per_client_rngs(round_idx,
@@ -320,6 +327,10 @@ class DisPFLEngine(FederatedEngine):
                                 "personal_acc": mp["acc"],
                                 "mask_change": float(
                                     np.sum(np.asarray(dist_self)[:real]))})
+            self.maybe_checkpoint(round_idx, {
+                "per_params": per_params, "per_bstats": per_bstats,
+                "masks_local": masks_local, "masks_shared": masks_shared,
+                "history": history})
 
         dist_matrix = np.asarray(jax.device_get(
             self._pairwise_hamming_jit(masks_local)))[: self.real_clients,

@@ -101,7 +101,13 @@ class DittoEngine(FederatedEngine):
                         rng=None), self.num_clients)
         per_params, per_bstats = per.params, per.batch_stats
         history = []
-        for round_idx in range(cfg.fed.comm_round):
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            params, bstats = restored["params"], restored["batch_stats"]
+            per_params, per_bstats = (restored["per_params"],
+                                      restored["per_bstats"])
+            history = restored["history"]
+        for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             rngs = self.per_client_rngs(round_idx, sampled)
             params, bstats, per_params, per_bstats, loss = self._round_jit(
@@ -120,6 +126,10 @@ class DittoEngine(FederatedEngine):
                                 "train_loss": float(loss),
                                 "personal_acc": m["acc"],
                                 "global_acc": mg["acc"]})
+            self.maybe_checkpoint(round_idx, {
+                "params": params, "batch_stats": bstats,
+                "per_params": per_params, "per_bstats": per_bstats,
+                "history": history})
         m = self.eval_personalized(ClientState(
             params=per_params, batch_stats=per_bstats, opt_state=None,
             rng=None))

@@ -142,7 +142,14 @@ class DPSGDEngine(FederatedEngine):
         per_params, per_bstats = per.params, per.batch_stats
         g_params, g_bstats = gs.params, gs.batch_stats
         history = []
-        for round_idx in range(cfg.fed.comm_round):
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            per_params, per_bstats = (restored["per_params"],
+                                      restored["per_bstats"])
+            g_params, g_bstats = (restored["g_params"],
+                                  restored["g_bstats"])
+            history = restored["history"]
+        for round_idx in range(start, cfg.fed.comm_round):
             M = jnp.asarray(self.mixing_matrix(round_idx))
             rngs = self.per_client_rngs(round_idx,
                                         np.arange(self.num_clients))
@@ -173,6 +180,10 @@ class DPSGDEngine(FederatedEngine):
                     params=ft_p, batch_stats=ft_b, opt_state=None, rng=None))
                 self.log.metrics(-1, finetune_after_round=round_idx,
                                  finetune_personal=mft)
+            self.maybe_checkpoint(round_idx, {
+                "per_params": per_params, "per_bstats": per_bstats,
+                "g_params": g_params, "g_bstats": g_bstats,
+                "history": history})
         return {"personal_params": per_params, "global_params": g_params,
                 "history": history,
                 "final_global": self.eval_global(g_params, g_bstats)}

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.utils import pytree as pt
@@ -59,7 +60,14 @@ class FedAvgEngine(FederatedEngine):
 
         cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
         w = ns.astype(jnp.float32)
-        new_params = pt.tree_weighted_mean(cs.params, w)
+        # robust defenses (norm-diff clipping / weak DP) between local train
+        # and aggregation; batch_stats are never clipped (structural parity
+        # with is_weight_param, robust_aggregation.py:28-29)
+        f = self.cfg.fed
+        client_params = robust.defend_stacked(
+            cs.params, params, defense=f.defense_type,
+            norm_bound=f.norm_bound, stddev=f.stddev, rngs=cs.rng)
+        new_params = pt.tree_weighted_mean(client_params, w)
         new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
         mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
         return new_params, new_bstats, mean_loss
@@ -120,10 +128,15 @@ class FedAvgEngine(FederatedEngine):
         if self.stream is not None:
             return self._train_streaming()
         cfg = self.cfg
-        gs = self.init_global_state()
-        params, bstats = gs.params, gs.batch_stats
-        history = []
-        for round_idx in range(cfg.fed.comm_round):
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            params, bstats = restored["params"], restored["batch_stats"]
+            history = restored["history"]
+        else:
+            gs = self.init_global_state()
+            params, bstats = gs.params, gs.batch_stats
+            history = []
+        for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
@@ -138,6 +151,8 @@ class FedAvgEngine(FederatedEngine):
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx, "train_loss": float(loss),
                                 **m})
+            self.maybe_checkpoint(round_idx, {
+                "params": params, "batch_stats": bstats, "history": history})
         # final fine-tune pass -> personalized models + final eval at "-1"
         rngs = self.per_client_rngs(cfg.fed.comm_round,
                                     np.arange(self.num_clients))
@@ -160,11 +175,16 @@ class FedAvgEngine(FederatedEngine):
         device each round (double-buffered host reads), and evaluation +
         the final fine-tune pass stream the cohort in client chunks."""
         cfg = self.cfg
-        gs = self.init_global_state()
-        params, bstats = gs.params, gs.batch_stats
-        history = []
-        self.stream.prefetch_train(self.client_sampling(0))
-        for round_idx in range(cfg.fed.comm_round):
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            params, bstats = restored["params"], restored["batch_stats"]
+            history = restored["history"]
+        else:
+            gs = self.init_global_state()
+            params, bstats = gs.params, gs.batch_stats
+            history = []
+        self.stream.prefetch_train(self.client_sampling(start))
+        for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d (stream): clients %s",
                           round_idx, sampled.tolist())
@@ -184,6 +204,8 @@ class FedAvgEngine(FederatedEngine):
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx,
                                 "train_loss": float(loss), **m})
+            self.maybe_checkpoint(round_idx, {
+                "params": params, "batch_stats": bstats, "history": history})
         # final fine-tune: chunked over client blocks; personalized models
         # are evaluated per block then discarded (they'd exceed HBM)
         chunk = self._eval_chunk_size()

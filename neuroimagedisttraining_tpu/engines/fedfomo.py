@@ -204,7 +204,14 @@ class FedFomoEngine(FederatedEngine):
         n_params = pt.tree_size(gs.params)
 
         history = []
-        for round_idx in range(cfg.fed.comm_round):
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            per_params, per_bstats = (restored["per_params"],
+                                      restored["per_bstats"])
+            weights = jnp.asarray(restored["weights"])
+            p_choose = jnp.asarray(restored["p_choose"])
+            history = restored["history"]
+        for round_idx in range(start, cfg.fed.comm_round):
             pch = np.asarray(jax.device_get(p_choose))
             A = np.zeros((C, C), np.float32)
             n_model_transfers = 0
@@ -234,6 +241,10 @@ class FedFomoEngine(FederatedEngine):
                 history.append({"round": round_idx,
                                 "train_loss": float(loss),
                                 "personal_acc": mp["acc"]})
+            self.maybe_checkpoint(round_idx, {
+                "per_params": per_params, "per_bstats": per_bstats,
+                "weights": weights, "p_choose": p_choose,
+                "history": history})
         m_person = self.eval_personalized(ClientState(
             params=per_params, batch_stats=per_bstats, opt_state=None,
             rng=None))

@@ -52,7 +52,12 @@ class LocalEngine(FederatedEngine):
                         opt_state=None, rng=None), self.num_clients)
         per_params, per_bstats = per.params, per.batch_stats
         history = []
-        for round_idx in range(cfg.fed.comm_round):
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            per_params, per_bstats = (restored["per_params"],
+                                      restored["per_bstats"])
+            history = restored["history"]
+        for round_idx in range(start, cfg.fed.comm_round):
             rngs = self.per_client_rngs(round_idx,
                                         np.arange(self.num_clients))
             per_params, per_bstats, loss = self._round_jit(
@@ -67,6 +72,9 @@ class LocalEngine(FederatedEngine):
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx,
                                 "train_loss": float(loss), **m})
+            self.maybe_checkpoint(round_idx, {
+                "per_params": per_params, "per_bstats": per_bstats,
+                "history": history})
         m = self.eval_personalized(ClientState(
             params=per_params, batch_stats=per_bstats, opt_state=None,
             rng=None))

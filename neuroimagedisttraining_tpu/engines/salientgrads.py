@@ -140,7 +140,11 @@ class SalientGradsEngine(FederatedEngine):
         gs = self.init_global_state()
         params, bstats = gs.params, gs.batch_stats
 
-        masks, thr = self.generate_global_mask(params, bstats)
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            masks = restored["masks"]  # phase 1 not recomputed on resume
+        else:
+            masks, thr = self.generate_global_mask(params, bstats)
         density = float(mask_density(masks))
         self.log.info("global SNIP mask density = %.4f (target %.4f)",
                       density, cfg.sparsity.dense_ratio)
@@ -166,7 +170,12 @@ class SalientGradsEngine(FederatedEngine):
         per_params, per_bstats = per.params, per.batch_stats
 
         history = []
-        for round_idx in range(cfg.fed.comm_round):
+        if restored is not None:
+            params, bstats = restored["params"], restored["batch_stats"]
+            per_params, per_bstats = (restored["per_params"],
+                                      restored["per_bstats"])
+            history = restored["history"]
+        for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
@@ -192,6 +201,10 @@ class SalientGradsEngine(FederatedEngine):
                 history.append({"round": round_idx,
                                 "train_loss": float(loss), **m,
                                 "personal_acc": mp["acc"]})
+            self.maybe_checkpoint(round_idx, {
+                "params": params, "batch_stats": bstats,
+                "per_params": per_params, "per_bstats": per_bstats,
+                "masks": masks, "history": history})
         m_global = self.eval_global(params, bstats)
         m_person = self.eval_personalized(ClientState(
             params=per_params, batch_stats=per_bstats, opt_state=None,

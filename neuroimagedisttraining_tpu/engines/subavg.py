@@ -167,7 +167,11 @@ class SubFedAvgEngine(FederatedEngine):
         n_params = pt.tree_size(params)
 
         history = []
-        for round_idx in range(cfg.fed.comm_round):
+        start, restored = self.restore_checkpoint()
+        if restored is not None:
+            params, bstats = restored["params"], restored["batch_stats"]
+            mask_pers, history = restored["mask_pers"], restored["history"]
+        for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
@@ -195,6 +199,9 @@ class SubFedAvgEngine(FederatedEngine):
                                 "personal_acc": mp["acc"],
                                 "mean_mask_dist": float(mean_dist),
                                 "prunes_accepted": int(n_accept)})
+            self.maybe_checkpoint(round_idx, {
+                "params": params, "batch_stats": bstats,
+                "mask_pers": mask_pers, "history": history})
         m_person = self.eval_masked_global(params, bstats, mask_pers)
         self.log.metrics(-1, personal=m_person)
         densities = np.asarray(jax.device_get(jax.vmap(

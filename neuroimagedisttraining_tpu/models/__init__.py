@@ -4,6 +4,21 @@
 (fedml_experiments/standalone/sailentgrads/main_sailentgrads.py:164-178:
 ``--model 3DCNN`` -> ``AlexNet3D_Dropout(num_classes=1)``), extended with
 every model family the reference zoo contains.
+
+Explicitly SKIPPED reference models (vestigial — constructed by no
+main_*.py entry point, SURVEY.md §2.5):
+
+- ``Meta_net``/``resnet_meta``/``resnet_meta_2`` (cnn_meta.py:17-110):
+  mask-parameterized structured-pruning experiments wired only to the
+  unused ``set_client.py`` legacy clients.
+- The DARTS NAS suite (darts/, 1,986 LoC): upstream FedNAS baggage; no
+  experiment harness in the fork references it.
+- ``batchnorm_utils`` sync-BN helpers: torch-DDP-specific; cross-replica
+  BN on TPU would be an axis-name mean inside shard_map, unused by every
+  reference experiment.
+
+The reference's ``resnet_ip`` per-batch-BN personalization variant IS
+covered: ``--model resnet18_ip`` (norm="ipbn", resnet2d._Norm).
 """
 
 from __future__ import annotations
@@ -37,15 +52,24 @@ from neuroimagedisttraining_tpu.models.vision2d import (  # noqa: F401
 )
 
 
-def create_model(name: str, num_classes: int = 1, dtype=jnp.float32):
-    """Build a model by its reference CLI name."""
+def create_model(name: str, num_classes: int = 1, dtype=jnp.float32,
+                 remat: bool | str | None = None):
+    """Build a model by its reference CLI name. ``remat`` (None = model
+    default) applies to the 3D family: False | "stem" | True — see
+    AlexNet3D_Dropout.remat and PROFILE.md."""
     name = name.lower()
+    rkw = {} if remat is None else {"remat": remat}
     if name in ("3dcnn", "alexnet3d", "alexnet3d_dropout"):
-        return AlexNet3D_Dropout(num_classes=num_classes, dtype=dtype)
+        return AlexNet3D_Dropout(num_classes=num_classes, dtype=dtype, **rkw)
+    if name in ("3dcnn_gn", "alexnet3d_dropout_gn"):
+        return AlexNet3D_Dropout(num_classes=num_classes, dtype=dtype,
+                                 norm="group", **rkw)
     if name in ("3dcnn_deeper", "alexnet3d_deeper_dropout"):
-        return AlexNet3D_Deeper_Dropout(num_classes=num_classes, dtype=dtype)
+        return AlexNet3D_Deeper_Dropout(num_classes=num_classes, dtype=dtype,
+                                        **rkw)
     if name in ("3dcnn_regression", "alexnet3d_dropout_regression"):
-        return AlexNet3D_Dropout_Regression(num_classes=num_classes, dtype=dtype)
+        return AlexNet3D_Dropout_Regression(num_classes=num_classes,
+                                            dtype=dtype, **rkw)
     if name in ("3dcnn_tiny", "tiny3dcnn"):
         return Tiny3DCNN(num_classes=num_classes, dtype=dtype)
     if name in ("resnet3d", "resnet_l3", "resnet3d_l3"):
@@ -56,6 +80,8 @@ def create_model(name: str, num_classes: int = 1, dtype=jnp.float32):
         return original_resnet18(num_classes=num_classes, dtype=dtype)
     if name == "tiny_resnet18":
         return tiny_resnet18(num_classes=num_classes, dtype=dtype)
+    if name in ("resnet18_ip", "resnet_ip"):
+        return ResNet18(num_classes=num_classes, norm="ipbn", dtype=dtype)
     if name == "vgg11":
         return vgg11(num_classes=num_classes, dtype=dtype)
     if name == "vgg16":

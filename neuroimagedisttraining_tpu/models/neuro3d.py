@@ -45,14 +45,20 @@ class ConvBNReLU3D(nn.Module):
     stride: int = 1
     pad: int = 0
     dtype: Dtype = jnp.float32
+    norm: str = "batch"  # "batch" | "group" (3D GroupNorm option — parity
+    # with the functional GroupNorm3d, group_normalization.py:7-118)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(self.features, (self.kernel,) * 3, strides=(self.stride,) * 3,
                     padding=[(self.pad, self.pad)] * 3, dtype=self.dtype,
                     name="conv")(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype, name="bn")(x)
+        if self.norm == "group":
+            x = nn.GroupNorm(num_groups=min(32, self.features),
+                             dtype=self.dtype, name="gn")(x)
+        else:
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype, name="bn")(x)
         return nn.relu(x)
 
 
@@ -68,19 +74,36 @@ class AlexNet3D_Dropout(nn.Module):
     input_rank = 5  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 2
     dtype: Dtype = jnp.float32
-    remat: bool = True
+    # Rematerialization policy (HBM vs FLOPs trade; measured on TPU v5e,
+    # PROFILE.md): False = none — fastest (+21% over remat) but only fits
+    # ~64 samples in flight per chip (e.g. b16 x 4 vmapped clients);
+    # "stem" = f0+f1 only (the large activations; costs the same as True
+    # since f0's recompute IS the remat tax, but needs less HBM); True =
+    # all stages. The harness picks automatically from the federation
+    # shape (--remat auto, __main__.build_experiment).
+    remat: bool | str = "stem"
+    norm: str = "batch"  # "group" => GN3D variant (no running stats)
+
+    def _blk(self, stage: int):
+        if self.remat is True or (self.remat == "stem" and stage <= 1):
+            return RematConvBNReLU3D
+        return ConvBNReLU3D
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        Blk = RematConvBNReLU3D if self.remat else ConvBNReLU3D
         x = x.astype(self.dtype)
-        x = Blk(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = self._blk(0)(64, kernel=5, stride=2, pad=0, dtype=self.dtype,
+                         norm=self.norm, name="f0")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = Blk(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = self._blk(1)(128, kernel=3, stride=1, pad=0, dtype=self.dtype,
+                         norm=self.norm, name="f1")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
-        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
-        x = Blk(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        x = self._blk(2)(192, kernel=3, pad=1, dtype=self.dtype,
+                         norm=self.norm, name="f2")(x, train)
+        x = self._blk(3)(192, kernel=3, pad=1, dtype=self.dtype,
+                         norm=self.norm, name="f3")(x, train)
+        x = self._blk(4)(128, kernel=3, pad=1, dtype=self.dtype,
+                         norm=self.norm, name="f4")(x, train)
         x = _pool(x, "max", 3, 3)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dropout(0.5, deterministic=not train)(x)
@@ -96,20 +119,24 @@ class AlexNet3D_Deeper_Dropout(nn.Module):
     input_rank = 5  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 2
     dtype: Dtype = jnp.float32
-    remat: bool = True
+    remat: bool | str = "stem"  # same policy semantics as AlexNet3D_Dropout
+
+    def _blk(self, stage: int):
+        if self.remat is True or (self.remat == "stem" and stage <= 1):
+            return RematConvBNReLU3D
+        return ConvBNReLU3D
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        Blk = RematConvBNReLU3D if self.remat else ConvBNReLU3D
         x = x.astype(self.dtype)
-        x = Blk(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = self._blk(0)(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = Blk(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = self._blk(1)(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
-        x = Blk(384, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
-        x = Blk(256, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
-        x = Blk(256, kernel=3, pad=1, dtype=self.dtype, name="f5")(x, train)
+        x = self._blk(2)(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
+        x = self._blk(3)(384, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
+        x = self._blk(4)(256, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        x = self._blk(5)(256, kernel=3, pad=1, dtype=self.dtype, name="f5")(x, train)
         x = _pool(x, "max", 3, 3)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dropout(0.5, deterministic=not train)(x)
@@ -126,19 +153,23 @@ class AlexNet3D_Dropout_Regression(nn.Module):
     input_rank = 5  # input ndim incl. batch+channel (unannotated: not a flax field)
     num_classes: int = 1
     dtype: Dtype = jnp.float32
-    remat: bool = True
+    remat: bool | str = "stem"  # same policy semantics as AlexNet3D_Dropout
+
+    def _blk(self, stage: int):
+        if self.remat is True or (self.remat == "stem" and stage <= 1):
+            return RematConvBNReLU3D
+        return ConvBNReLU3D
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        Blk = RematConvBNReLU3D if self.remat else ConvBNReLU3D
         x = x.astype(self.dtype)
-        x = Blk(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
+        x = self._blk(0)(64, kernel=5, stride=2, pad=0, dtype=self.dtype, name="f0")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = Blk(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
+        x = self._blk(1)(128, kernel=3, stride=1, pad=0, dtype=self.dtype, name="f1")(x, train)
         x = _pool(x, "max", 3, 3)
-        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
-        x = Blk(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
-        x = Blk(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
+        x = self._blk(2)(192, kernel=3, pad=1, dtype=self.dtype, name="f2")(x, train)
+        x = self._blk(3)(192, kernel=3, pad=1, dtype=self.dtype, name="f3")(x, train)
+        x = self._blk(4)(128, kernel=3, pad=1, dtype=self.dtype, name="f4")(x, train)
         xp = _pool(x, "max", 3, 3)
         x = xp.reshape((xp.shape[0], -1))
         x = nn.Dropout(0.5, deterministic=not train)(x)

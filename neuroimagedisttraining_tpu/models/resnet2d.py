@@ -13,20 +13,37 @@ from __future__ import annotations
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 Dtype = Any
 
 
 class _Norm(nn.Module):
-    """BatchNorm or GroupNorm(32), selected by ``kind``."""
-    kind: str  # "bn" | "gn"
+    """BatchNorm, GroupNorm(32), or IP-norm, selected by ``kind``.
+
+    ``ipbn`` = per-batch statistics that are NEVER tracked (the reference's
+    resnet_ip "independent personalization" BN, resnet_ip.py:33-359 —
+    track_running_stats=False): every forward, train or eval, normalizes by
+    the current batch's mean/var; only scale/bias are learnable state."""
+    kind: str  # "bn" | "gn" | "ipbn"
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if self.kind == "gn":
             return nn.GroupNorm(num_groups=32, dtype=jnp.float32, name="norm")(x)
+        if self.kind == "ipbn":
+            axes = tuple(range(x.ndim - 1))
+            x32 = x.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=axes, keepdims=True)
+            var = jnp.var(x32, axis=axes, keepdims=True)
+            y = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+            scale = self.param("scale", nn.initializers.ones,
+                               (x.shape[-1],), jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros,
+                              (x.shape[-1],), jnp.float32)
+            return (y * scale + bias).astype(x.dtype)
         return nn.BatchNorm(use_running_average=not train, momentum=0.9,
                             epsilon=1e-5, dtype=jnp.float32, name="norm")(x)
 

@@ -1,0 +1,80 @@
+// Native host-side data-path kernels for the streaming federation.
+//
+// The reference's data plane leans on native code inside its dependencies
+// (libhdf5 fancy reads + torch pinned-tensor copies, SURVEY.md §2.9); the
+// in-Python part — assembling per-client row batches — is a single-threaded
+// numpy gather. Here that gather is a multithreaded row memcpy: ABCD rows
+// are ~2.1 MB of uint8 each, so the copy is memory-bandwidth-bound and
+// scales with threads until DRAM saturates (~4-8x over one core on the
+// 5-CPU hosts BASELINE.md records).
+//
+// Exposed via ctypes (no pybind11 in this image); see utils/native.py for
+// the build-on-first-use wrapper and the numpy fallback.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void copy_span(const uint8_t* src, const int64_t* idx, int64_t row_bytes,
+               uint8_t* dst, int64_t begin, int64_t end) {
+  for (int64_t i = begin; i < end; ++i) {
+    std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+                static_cast<size_t>(row_bytes));
+  }
+}
+
+void dequant_span(const uint8_t* src, const int64_t* idx, int64_t row_elems,
+                  float* dst, float scale, float shift, int64_t begin,
+                  int64_t end) {
+  for (int64_t i = begin; i < end; ++i) {
+    const uint8_t* s = src + idx[i] * row_elems;
+    float* d = dst + i * row_elems;
+    for (int64_t e = 0; e < row_elems; ++e) {
+      d[e] = static_cast<float>(s[e]) * scale + shift;
+    }
+  }
+}
+
+template <typename Fn>
+void parallel_rows(int64_t n_rows, int n_threads, Fn fn) {
+  if (n_threads <= 1 || n_rows < 2) {
+    fn(0, n_rows);
+    return;
+  }
+  std::vector<std::thread> workers;
+  int64_t per = (n_rows + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t begin = t * per;
+    int64_t end = begin + per < n_rows ? begin + per : n_rows;
+    if (begin >= end) break;
+    workers.emplace_back([=] { fn(begin, end); });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// dst[i] = src[idx[i]] for uint8 rows of row_bytes each.
+void nidt_gather_rows_u8(const uint8_t* src, const int64_t* idx,
+                         int64_t n_rows, int64_t row_bytes, uint8_t* dst,
+                         int n_threads) {
+  parallel_rows(n_rows, n_threads, [&](int64_t b, int64_t e) {
+    copy_span(src, idx, row_bytes, dst, b, e);
+  });
+}
+
+// dst[i] = float(src[idx[i]]) * scale + shift (fused gather + dequantize).
+void nidt_gather_dequant_u8_f32(const uint8_t* src, const int64_t* idx,
+                                int64_t n_rows, int64_t row_elems, float* dst,
+                                float scale, float shift, int n_threads) {
+  parallel_rows(n_rows, n_threads, [&](int64_t b, int64_t e) {
+    dequant_span(src, idx, row_elems, dst, scale, shift, b, e);
+  });
+}
+
+}  // extern "C"

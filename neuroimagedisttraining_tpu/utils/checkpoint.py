@@ -1,0 +1,108 @@
+"""Round-granular checkpoint/resume.
+
+The reference has NO checkpointing — 3-day SLURM runs killed at the time
+limit lost everything (SURVEY.md §5.4, DisPFL/error3469448.err). This module
+is the rebuild requirement SURVEY names: save {params, per-client stacked
+states, masks, opt state, round idx, PRNG keys, history, stat accumulators}
+every ``checkpoint_every`` rounds; resume replays the remaining rounds
+bitwise-identically (all per-round randomness is derived from the round
+index, so state + round is a complete resume point).
+
+Format: flax msgpack over a dict pytree of numpy arrays, written atomically
+(tmp + rename). Typed JAX PRNG keys are encoded via ``jax.random.key_data``
+and rebuilt with ``wrap_key_data`` on load.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+_CKPT_RE = re.compile(r"^ckpt_(\d{8})\.msgpack$")
+_KEY_MARK = "__prng_key_data__"
+
+
+def _is_prng_key(x) -> bool:
+    return isinstance(x, jax.Array) and jnp.issubdtype(x.dtype,
+                                                       jax.dtypes.prng_key)
+
+
+def _encode(tree: Any) -> Any:
+    def enc(x):
+        if _is_prng_key(x):
+            return {_KEY_MARK: np.asarray(jax.random.key_data(x))}
+        if isinstance(x, (jax.Array, np.ndarray)):
+            return np.asarray(x)
+        return x
+
+    return jax.tree.map(enc, tree)
+
+
+def _decode(tree: Any) -> Any:
+    def is_marked(x):
+        return isinstance(x, dict) and _KEY_MARK in x
+
+    def dec(x):
+        if is_marked(x):
+            return jax.random.wrap_key_data(jnp.asarray(x[_KEY_MARK]))
+        return x
+
+    return jax.tree.map(dec, tree, is_leaf=is_marked)
+
+
+def _path(ckpt_dir: str, round_idx: int) -> str:
+    return os.path.join(ckpt_dir, f"ckpt_{round_idx:08d}.msgpack")
+
+
+def save_checkpoint(ckpt_dir: str, round_idx: int, state: dict,
+                    keep: int = 3) -> str:
+    """Atomically write the state pytree for ``round_idx`` (the round just
+    completed); prune to the newest ``keep`` checkpoints."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    payload = {"round": int(round_idx), "state": _encode(state)}
+    raw = serialization.msgpack_serialize(payload)
+    final = _path(ckpt_dir, round_idx)
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(raw)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    for old in list_checkpoints(ckpt_dir)[:-keep]:
+        os.unlink(_path(ckpt_dir, old))
+    return final
+
+
+def list_checkpoints(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def load_checkpoint(ckpt_dir: str, round_idx: int | None = None
+                    ) -> tuple[int, dict] | None:
+    """Load the given (or latest) checkpoint. Returns (round_idx, state) —
+    ``round_idx`` is the last COMPLETED round; resume at round_idx + 1."""
+    rounds = list_checkpoints(ckpt_dir)
+    if not rounds:
+        return None
+    if round_idx is None:
+        round_idx = rounds[-1]
+    elif round_idx not in rounds:
+        raise FileNotFoundError(
+            f"no checkpoint for round {round_idx} in {ckpt_dir} "
+            f"(have {rounds})")
+    with open(_path(ckpt_dir, round_idx), "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    return int(payload["round"]), _decode(payload["state"])

@@ -1,0 +1,114 @@
+"""ctypes loader for the native host-data-path library (native/gather.cpp).
+
+Build-on-first-use: compiles the shared library with g++ into the package's
+``native/`` directory the first time it's needed (pybind11 is not in this
+image; ctypes + extern "C" needs no Python headers at all). Every entry
+point has a numpy fallback, so the framework runs — just slower on the
+host-streaming path — on boxes without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                           "native")
+_SRC = os.path.join(_NATIVE_DIR, "gather.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libnidt_gather.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | bool | None = None  # None = not tried, False = failed
+
+DEFAULT_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The library handle, building it if necessary; None when unavailable."""
+    global _lib
+    with _lock:
+        if _lib is False:
+            return None
+        if _lib is not None:
+            return _lib
+        try:
+            fresh = (os.path.isfile(_SO)
+                     and os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+        except OSError:
+            # source missing (e.g. binary-only install): use the .so as-is
+            fresh = os.path.isfile(_SO)
+        if not fresh and not _build():
+            _lib = False
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _lib = False
+            return None
+        lib.nidt_gather_rows_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
+        lib.nidt_gather_dequant_u8_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int]
+        _lib = lib
+        return lib
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                out: np.ndarray | None = None,
+                n_threads: int = DEFAULT_THREADS) -> np.ndarray:
+    """dst[i] = src[idx[i]] — multithreaded row gather for uint8 sources,
+    numpy fallback otherwise. ``out`` may supply a preallocated target
+    (e.g. a slice of the padded round buffer)."""
+    idx = np.ascontiguousarray(idx, np.int64)
+    lib = load()
+    if (lib is None or src.dtype != np.uint8
+            or not src.flags["C_CONTIGUOUS"]):
+        gathered = src[idx]
+        if out is None:
+            return gathered
+        out[: len(idx)] = gathered
+        return out
+    row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.itemsize
+    if out is None:
+        out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    dst = out[: len(idx)]
+    assert dst.flags["C_CONTIGUOUS"]
+    lib.nidt_gather_rows_u8(
+        src.ctypes.data, idx.ctypes.data, len(idx), row_bytes,
+        dst.ctypes.data, n_threads)
+    return out
+
+
+def gather_dequant(src: np.ndarray, idx: np.ndarray, scale: float = 1.0,
+                   shift: float = 0.0,
+                   n_threads: int = DEFAULT_THREADS) -> np.ndarray:
+    """dst[i] = float32(src[idx[i]]) * scale + shift, fused."""
+    idx = np.ascontiguousarray(idx, np.int64)
+    lib = load()
+    if (lib is None or src.dtype != np.uint8
+            or not src.flags["C_CONTIGUOUS"]):
+        return src[idx].astype(np.float32) * scale + shift
+    row_elems = int(np.prod(src.shape[1:], dtype=np.int64))
+    out = np.empty((len(idx),) + src.shape[1:], np.float32)
+    lib.nidt_gather_dequant_u8_f32(
+        src.ctypes.data, idx.ctypes.data, len(idx), row_elems,
+        out.ctypes.data, ctypes.c_float(scale), ctypes.c_float(shift),
+        n_threads)
+    return out

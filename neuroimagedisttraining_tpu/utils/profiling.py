@@ -1,0 +1,63 @@
+"""Profiling hooks + failure context.
+
+SURVEY §5.1: the reference has no timeline profiler, only hook-based FLOPs
+counting; the TPU equivalent it prescribes is ``jax.profiler`` traces (+ the
+analytic FLOPs model in ops/flops.py). ``profile_trace`` wraps any span in a
+TensorBoard-loadable trace capture (XLA ops, HBM, ICI); the CLI exposes it
+as ``--profile_dir``.
+
+SURVEY §5.3 / §2.7: the reference's failure handling is the
+``raise_MPI_error`` context manager — log traceback, then
+``MPI.COMM_WORLD.Abort()`` (fedml_api/utils/context.py:9-18).
+``failure_context`` is the equivalent for our runtime: log, run the
+registered teardown (e.g. a comm manager's stop, or
+``jax.distributed.shutdown`` in multi-host mode), re-raise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import traceback
+from typing import Callable
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None, enabled: bool = True):
+    """Capture a jax.profiler trace of the enclosed span into ``log_dir``
+    (viewable in TensorBoard / XProf). No-op when disabled or dir empty."""
+    if not (enabled and log_dir):
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-span inside a trace (shows up on the timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def failure_context(logger: logging.Logger | None = None,
+                    teardown: Callable[[], None] | None = None,
+                    name: str = "run"):
+    """Log-then-teardown-then-reraise (raise_MPI_error parity,
+    context.py:9-18 — minus the unsound process Abort: teardown is
+    caller-supplied and the exception propagates)."""
+    log = logger or logging.getLogger("neuroimagedisttraining_tpu")
+    try:
+        yield
+    except Exception:
+        log.error("FATAL in %s:\n%s", name, traceback.format_exc())
+        if teardown is not None:
+            try:
+                teardown()
+            except Exception:
+                log.error("teardown after failure also failed:\n%s",
+                          traceback.format_exc())
+        raise

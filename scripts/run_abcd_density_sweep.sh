@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Density sweep 0.05-0.5 (the reference's salientgradssparsity* job family,
+# Jobs/salientgradssparsitywith100iteration70sps.sh) with IterSNIP 100.
+set -euo pipefail
+
+H5=${1:?usage: run_abcd_density_sweep.sh /path/to/abcd.h5}
+
+for d in 0.05 0.1 0.2 0.3 0.5; do
+    python -m neuroimagedisttraining_tpu \
+        --algorithm salientgrads --dataset abcd_h5 --data_dir "$H5" \
+        --model 3DCNN --num_classes 1 --client_num_in_total 21 \
+        --comm_round 200 --batch_size 16 --dense_ratio "$d" \
+        --itersnip_iteration 100 --tag "sweep_d${d}"
+done

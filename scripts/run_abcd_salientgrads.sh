@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# The reference's flagship job (fedml_experiments/standalone/sailentgrads/
+# Jobs/sailentgradsjob.sh:39-51): SalientGrads on ABCD sex classification,
+# 21 site-clients, 200 rounds, density sweep. One TPU host replaces the
+# 1xV100 SLURM allocation; no scheduler pragmas needed.
+set -euo pipefail
+
+H5=${1:?usage: run_abcd_salientgrads.sh /path/to/abcd.h5 [density]}
+DENSITY=${2:-0.5}
+
+python -m neuroimagedisttraining_tpu \
+    --algorithm salientgrads --dataset abcd_h5 --data_dir "$H5" \
+    --model 3DCNN --num_classes 1 --partition_method site \
+    --client_num_in_total 21 --frac 1.0 --comm_round 200 \
+    --batch_size 16 --epochs 2 --lr 0.01 --lr_decay 0.998 --wd 5e-4 \
+    --dense_ratio "$DENSITY" --itersnip_iteration 1 \
+    --checkpoint_dir "ckpt_salientgrads_d${DENSITY}" --checkpoint_every 10 \
+    --tag "d${DENSITY}"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# The reference's CIFAR sweep config (Jobs/sailentgradsjob.sh:39-51,
+# BASELINE.md): ResNet-18, Dirichlet alpha=0.3, 100 clients, frac 0.1,
+# 500 rounds. Expects cifar-10-batches-py/ (or data.npz) under DATA_DIR.
+set -euo pipefail
+
+DATA_DIR=${1:-./data}
+DENSITY=${2:-0.5}
+
+python -m neuroimagedisttraining_tpu \
+    --algorithm salientgrads --dataset cifar10 --data_dir "$DATA_DIR" \
+    --model resnet18 --partition_method dir --partition_alpha 0.3 \
+    --client_num_in_total 100 --frac 0.1 --comm_round 500 \
+    --batch_size 16 --epochs 2 --lr 0.01 --dense_ratio "$DENSITY" \
+    --tag "cifar_d${DENSITY}"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Fast CPU smoke of every algorithm engine on synthetic data (the CI-mode
+# role of the reference's --ci flag, sailentgrads_api.py:260-265).
+set -euo pipefail
+
+COMMON="--dataset synthetic --model 3dcnn_tiny --synthetic_num_subjects 32 \
+  --synthetic_shape 12 14 12 --client_num_in_total 4 --comm_round 2 \
+  --batch_size 4 --epochs 1 --lr 5e-4 --virtual_devices 8 --log_dir /tmp/nidt_smoke"
+
+for algo in fedavg salientgrads dispfl subavg dpsgd ditto local turboaggregate; do
+    echo "=== $algo ==="
+    python -m neuroimagedisttraining_tpu --algorithm "$algo" $COMMON
+done
+# fedfomo needs a validation split
+echo "=== fedfomo ==="
+python -m neuroimagedisttraining_tpu --algorithm fedfomo --val_fraction 0.2 $COMMON

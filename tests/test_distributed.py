@@ -1,0 +1,129 @@
+"""Cross-silo control plane: message codec, TCP transport, handler-registry
+managers, and the full register->broadcast->train->upload->aggregate->finish
+protocol loop (fedml_core/distributed semantics, SURVEY §2.2/§2.3)."""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.distributed.comm import SocketCommManager
+from neuroimagedisttraining_tpu.distributed.cross_silo import (
+    FedAvgClientProc, FedAvgServer,
+)
+
+
+def test_message_codec_roundtrip():
+    msg = M.Message(M.MSG_TYPE_S2C_SYNC_MODEL, 0, 3)
+    msg.add(M.ARG_MODEL_PARAMS, {"w": np.arange(6, dtype=np.float32)
+                                 .reshape(2, 3), "b": np.float32(1.5)})
+    msg.add(M.ARG_ROUND_IDX, 7)
+    back = M.Message.from_bytes(msg.to_bytes())
+    assert back.msg_type == M.MSG_TYPE_S2C_SYNC_MODEL
+    assert back.sender_id == 0 and back.receiver_id == 3
+    assert back.get(M.ARG_ROUND_IDX) == 7
+    np.testing.assert_array_equal(back.get(M.ARG_MODEL_PARAMS)["w"],
+                                  np.arange(6, dtype=np.float32)
+                                  .reshape(2, 3))
+
+
+def test_socket_transport_point_to_point():
+    a = SocketCommManager(0, 2, base_port=52210)
+    b = SocketCommManager(1, 2, base_port=52210)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append((t, int(np.asarray(m.get("x")))))
+            b.stop_receive_message()
+
+    b.add_observer(Obs())
+    runner = threading.Thread(target=b.handle_receive_message)
+    runner.start()
+    msg = M.Message("ping", 0, 1)
+    msg.add("x", np.int64(41))
+    a.send_message(msg)
+    runner.join(timeout=10)
+    a.stop_receive_message()
+    assert got == [("ping", 41)]
+
+
+def _run_protocol(num_clients, comm_round, base_port, lr=0.5):
+    """Server + clients on real sockets; client c's 'training' moves params
+    toward the constant c+1, weight n_c = 10*(c+1)."""
+    init = {"w": np.zeros((3,), np.float32)}
+
+    def make_train_fn(c):
+        def train_fn(params, round_idx):
+            p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+            p["w"] = p["w"] + lr * ((c + 1) - p["w"])
+            return p, 10.0 * (c + 1)
+
+        return train_fn
+
+    server = FedAvgServer(init, comm_round, num_clients,
+                          base_port=base_port)
+    clients = [FedAvgClientProc(c + 1, num_clients,
+                                make_train_fn(c), base_port=base_port)
+               for c in range(num_clients)]
+    threads = [threading.Thread(target=m.run)
+               for m in [server] + clients]
+    for t in threads:
+        t.start()
+    server._done.wait(timeout=60)
+    for t in threads:
+        t.join(timeout=10)
+    return server
+
+
+def test_cross_silo_fedavg_protocol():
+    server = _run_protocol(num_clients=3, comm_round=2, base_port=52300)
+    assert len(server.history) == 2
+    # closed-form check: one round from w=0 gives w_c = lr*(c+1);
+    # weighted mean with weights (1,2,3)/6 -> lr * (1*1+2*2+3*3)/6
+    lr = 0.5
+    r1 = lr * (1 * 1 + 2 * 2 + 3 * 3) / 6.0
+    # round 2: each client pulls r1 toward (c+1) then weighted mean again
+    vals = [r1 + lr * ((c + 1) - r1) for c in range(3)]
+    r2 = sum((c + 1) * v for c, v in enumerate(vals)) / 6.0
+    np.testing.assert_allclose(server.params["w"],
+                               np.full(3, r2, np.float32), rtol=1e-6)
+
+
+def _spawn_client(rank, num_clients, base_port):
+    # separate PROCESS: genuine cross-address-space message loop
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        FedAvgClientProc,
+    )
+
+    def train_fn(params, round_idx):
+        p = {k: np.asarray(v, np.float32) + rank for k, v in params.items()}
+        return p, float(rank)
+
+    FedAvgClientProc(rank, num_clients, train_fn,
+                     base_port=base_port).run()
+
+
+def test_cross_silo_multiprocess_smoke():
+    """Two real OS processes register, train, and the server aggregates —
+    the multi-process capability check (VERDICT round-1 item 9)."""
+    ctx = mp.get_context("spawn")
+    base_port = 52400
+    procs = [ctx.Process(target=_spawn_client, args=(r, 2, base_port),
+                         daemon=True) for r in (1, 2)]
+    for p in procs:
+        p.start()
+    server = FedAvgServer({"w": np.zeros((2,), np.float32)}, 1, 2,
+                          base_port=base_port)
+    t = threading.Thread(target=server.run)
+    t.start()
+    assert server._done.wait(timeout=120), "protocol did not complete"
+    t.join(timeout=10)
+    for p in procs:
+        p.join(timeout=10)
+    # weighted mean of (0+1) w=1 and (0+2) w=2 -> (1*1 + 2*2)/3
+    np.testing.assert_allclose(server.params["w"],
+                               np.full(2, 5.0 / 3.0, np.float32), rtol=1e-6)
+    time.sleep(0.1)

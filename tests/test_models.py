@@ -99,6 +99,7 @@ def test_cnn_original_fedavg_param_count():
 @pytest.mark.parametrize("name,shape,nc", [
     ("resnet18", (1, 32, 32, 3), 10),
     ("tiny_resnet18", (1, 64, 64, 3), 200),
+    ("resnet18_ip", (1, 32, 32, 3), 10),
     ("vgg11", (1, 32, 32, 3), 10),
     ("cnn_cifar10", (1, 32, 32, 3), 10),
     ("cnn_cifar100", (1, 32, 32, 3), 100),
@@ -111,6 +112,30 @@ def test_registry_models_forward(name, shape, nc):
     x = jnp.zeros(shape)
     _, out, _ = _init_and_apply(model, x)
     assert primary_logits(out).shape == (shape[0], nc)
+
+
+def test_norm_variants_have_no_running_stats():
+    """GN-3D and resnet_ip variants must carry NO batch_stats collection —
+    GroupNorm is stat-free and IP-norm never tracks (resnet_ip semantics,
+    track_running_stats=False). The 3D variant is shape-checked lazily at
+    the real ABCD shape (the full AlexNet3D stack needs >= ~41^3 inputs)."""
+    import jax
+
+    # resnet18_ip: real forward at CIFAR shape
+    model = create_model("resnet18_ip", num_classes=2)
+    variables, out, _ = _init_and_apply(model, jnp.zeros((1, 32, 32, 3)))
+    assert primary_logits(out).shape == (1, 2)
+    assert not jax.tree.leaves(dict(variables).get("batch_stats", {}))
+
+    # 3dcnn_gn: eval_shape at ABCD scale (no compute)
+    m3 = create_model("3dcnn_gn", num_classes=2)
+    variables = jax.eval_shape(
+        lambda: m3.init({"params": jax.random.key(0),
+                         "dropout": jax.random.key(1)},
+                        jnp.zeros((1, 121, 145, 121, 1)), train=False))
+    assert not jax.tree.leaves(dict(variables).get("batch_stats", {}))
+    # GN params exist where BN params would have been
+    assert "gn" in variables["params"]["f0"]
 
 
 def test_lenet5_flatten_matches_caffe_5x5_to_4x4():

@@ -1,0 +1,93 @@
+"""Robust aggregation: norm-diff clipping + weak-DP noise
+(robust_aggregation.py:28-55 parity) and the Byzantine-client scenario from
+BASELINE.json's robustness config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core import robust
+from neuroimagedisttraining_tpu.utils import pytree as pt
+
+
+def _tree(rng, scale=1.0):
+    return {"w": jnp.asarray(rng.normal(size=(4, 3)) * scale, jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(5,)) * scale, jnp.float32)}
+
+
+def test_clip_noop_inside_bound():
+    rng = np.random.default_rng(0)
+    g = _tree(rng)
+    local = pt.tree_add(g, pt.tree_scale(pt.tree_ones_like(g), 1e-3))
+    out = robust.norm_diff_clip(local, g, norm_bound=5.0)
+    for k in g:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(local[k]),
+                                   rtol=1e-6)
+
+
+def test_clip_bounds_update_norm():
+    rng = np.random.default_rng(1)
+    g = _tree(rng)
+    local = pt.tree_add(g, _tree(rng, scale=100.0))
+    out = robust.norm_diff_clip(local, g, norm_bound=2.0)
+    norm = float(pt.tree_norm(pt.tree_sub(out, g)))
+    assert abs(norm - 2.0) < 1e-4  # clipped exactly to the bound
+    # direction preserved: clipped diff parallel to raw diff
+    raw = pt.tree_vector(pt.tree_sub(local, g))
+    clp = pt.tree_vector(pt.tree_sub(out, g))
+    cos = float(jnp.vdot(raw, clp) / (jnp.linalg.norm(raw)
+                                      * jnp.linalg.norm(clp)))
+    assert cos > 0.9999
+
+
+def test_byzantine_client_neutralized():
+    """One client ships a 100x-norm update; with clipping the aggregate stays
+    near the honest mean, without it the aggregate is dragged away."""
+    rng = np.random.default_rng(2)
+    g = _tree(rng)
+    honest = [pt.tree_add(g, _tree(rng, scale=0.1)) for _ in range(3)]
+    byz = pt.tree_add(g, _tree(rng, scale=100.0))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *(honest + [byz]))
+    w = jnp.ones((4,), jnp.float32)
+
+    plain = pt.tree_weighted_mean(stacked, w)
+    defended = pt.tree_weighted_mean(
+        robust.defend_stacked(stacked, g, defense="norm_diff_clipping",
+                              norm_bound=1.0, stddev=0.0), w)
+    honest_mean = pt.tree_weighted_mean(
+        jax.tree.map(lambda *xs: jnp.stack(xs), *honest),
+        jnp.ones((3,), jnp.float32))
+
+    err_plain = float(pt.tree_norm(pt.tree_sub(plain, honest_mean)))
+    err_def = float(pt.tree_norm(pt.tree_sub(defended, honest_mean)))
+    assert err_def < 1.0
+    assert err_plain > 10 * err_def
+
+
+def test_weak_dp_noise_statistics():
+    g = {"w": jnp.zeros((200, 200), jnp.float32)}
+    out = robust.add_weak_dp_noise(g, jax.random.key(0), stddev=0.05)
+    got = np.asarray(out["w"])
+    assert abs(got.std() - 0.05) < 0.005
+    assert abs(got.mean()) < 0.005
+
+
+def test_defense_unknown_raises():
+    g = {"w": jnp.zeros((2,), jnp.float32)}
+    stacked = jax.tree.map(lambda x: jnp.stack([x, x]), g)
+    try:
+        robust.defend_stacked(stacked, g, defense="krum", norm_bound=1.0,
+                              stddev=0.0)
+        raise AssertionError("should have raised")
+    except ValueError:
+        pass
+
+
+def test_fedavg_with_defense_runs(tmp_path, synthetic_cohort):
+    from tests.test_fedavg import _make_engine
+
+    engine = _make_engine(tmp_path, synthetic_cohort,
+                          defense_type="weak_dp", norm_bound=5.0,
+                          stddev=0.01)
+    result = engine.train()
+    assert np.isfinite(result["history"][-1]["train_loss"])

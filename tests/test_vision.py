@@ -1,0 +1,137 @@
+"""Vision data layer: CIFAR-style partition modes (n_cls/dir/my_part),
+label-proportional test splits, npz ingestion, and an end-to-end 2D CNN
+federation (cifar10/data_loader.py:75-249 parity)."""
+
+import numpy as np
+
+from neuroimagedisttraining_tpu.data import partition as P
+from neuroimagedisttraining_tpu.data import vision as V
+
+
+def _labels(n=1000, n_cls=10, seed=0):
+    return np.random.default_rng(seed).integers(0, n_cls, n).astype(np.int32)
+
+
+def test_n_cls_partition_limits_classes_per_client():
+    y = _labels()
+    m = V.vision_partition(y, client_number=8, alpha=2, method="n_cls",
+                           seed=3)
+    sizes = [len(m[c]) for c in range(8)]
+    assert sum(sizes) == len(y)
+    # every client holds samples from (at most) alpha distinct classes
+    for c in range(8):
+        assert len(np.unique(y[m[c]])) <= 2
+
+
+def test_dir_partition_covers_everything_once():
+    y = _labels()
+    m = V.vision_partition(y, client_number=5, alpha=0.3, method="dir",
+                           seed=1)
+    allidx = np.sort(np.concatenate([m[c] for c in range(5)]))
+    # dir mode never refills class pools: exact cover, no duplicates
+    np.testing.assert_array_equal(allidx, np.arange(len(y)))
+    # heterogeneity: per-client class distributions differ
+    stats = P.record_data_stats(y, m)
+    h0 = np.asarray([stats[0].get(k, 0) for k in range(10)], float)
+    h1 = np.asarray([stats[1].get(k, 0) for k in range(10)], float)
+    assert not np.allclose(h0 / h0.sum(), h1 / h1.sum(), atol=0.02)
+
+
+def test_my_part_groups_share_priors():
+    y = _labels(2000)
+    m = V.vision_partition(y, client_number=8, alpha=4, method="my_part",
+                           seed=2)
+    assert sum(len(m[c]) for c in range(8)) == len(y)
+    stats = P.record_data_stats(y, m)
+    # clients 0,1 share a shard-group prior; 0 and 7 don't. Compare class
+    # histograms: same-group pairs should be closer than cross-group.
+    def hist(c):
+        h = np.asarray([stats[c].get(k, 0) for k in range(10)], float)
+        return h / max(h.sum(), 1)
+
+    same = np.abs(hist(0) - hist(1)).sum()
+    cross = np.abs(hist(0) - hist(7)).sum()
+    assert same < cross + 0.5  # statistical, loose
+
+
+def test_proportional_test_split_matches_train_mix():
+    y_tr = _labels(4000, seed=5)
+    y_te = _labels(1000, seed=6)
+    m = V.vision_partition(y_tr, client_number=4, alpha=2, method="n_cls",
+                           seed=7)
+    stats = P.record_data_stats(y_tr, m)
+    tmap = V.proportional_test_split(y_te, stats, 4, seed=8)
+    for c in range(4):
+        train_classes = set(stats[c])
+        test_classes = set(np.unique(y_te[tmap[c]]).tolist())
+        # client's test classes only come from its train classes
+        assert test_classes <= train_classes
+
+
+def test_npz_ingestion_roundtrip(tmp_path):
+    Xtr, ytr, Xte, yte = V.synthetic_vision_cohort(64, 16, hw=8)
+    path = str(tmp_path / "toy.npz")
+    np.savez(path, X_train=Xtr, y_train=ytr, X_test=Xte, y_test=yte)
+    gXtr, gytr, gXte, gyte = V.load_vision_dataset("tiny", path)
+    np.testing.assert_allclose(gXtr, Xtr)
+    np.testing.assert_array_equal(gyte, yte)
+
+
+def test_uint8_pickle_batches_normalized(tmp_path):
+    # fabricate a cifar-10-batches-py folder and check normalization
+    import pickle
+
+    folder = tmp_path / "cifar-10-batches-py"
+    folder.mkdir()
+    rng = np.random.default_rng(0)
+    for name, n in [("data_batch_1", 20), ("test_batch", 10)]:
+        d = {b"data": rng.integers(0, 256, size=(n, 3072), dtype=np.uint8),
+             b"labels": rng.integers(0, 10, size=n).tolist()}
+        with open(folder / name, "wb") as f:
+            pickle.dump(d, f)
+    for i in range(2, 6):
+        with open(folder / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": rng.integers(0, 256, size=(4, 3072),
+                                               dtype=np.uint8),
+                         b"labels": rng.integers(0, 10, size=4).tolist()}, f)
+    Xtr, ytr, Xte, yte = V.load_vision_dataset("cifar10", str(tmp_path))
+    assert Xtr.shape[1:] == (32, 32, 3)
+    assert Xtr.dtype == np.float32
+    assert abs(float(Xtr.mean())) < 0.3  # roughly centered after normalize
+
+
+def test_federated_vision_end_to_end(tmp_path):
+    """2D CNN federation over the synthetic vision cohort: accuracy beats
+    chance after a few FedAvg rounds (public cross-check path,
+    SURVEY hard-part #5)."""
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.vision import federate_vision
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    mesh = make_mesh()
+    fed, info = federate_vision("cifar10", "", "dir", 0.5, 4, mesh=mesh,
+                                seed=0, synthetic=True)
+    assert fed.X_train.ndim == 5  # [C, N, H, W, 3]
+    cfg = ExperimentConfig(
+        model="cnn_cifar10", num_classes=10, algorithm="fedavg",
+        data=DataConfig(dataset="cifar10", partition_method="dir"),
+        optim=OptimConfig(lr=0.01, batch_size=16, epochs=2),
+        fed=FedConfig(client_num_in_total=4, comm_round=4),
+        log_dir=str(tmp_path))
+    model = create_model("cnn_cifar10", num_classes=10)
+    trainer = LocalTrainer(model, cfg.optim, num_classes=10)
+    log = ExperimentLogger(str(tmp_path), "cifar10", cfg.identity(),
+                           console=False)
+    engine = create_engine("fedavg", cfg, fed, trainer, mesh=mesh,
+                           logger=log)
+    res = engine.train()
+    assert res["final_global"]["acc"] > 0.2  # 10-class chance = 0.1
+    assert jnp.isfinite(res["history"][-1]["train_loss"])
