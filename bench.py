@@ -114,14 +114,16 @@ def main() -> None:
         return engine._round_jit(params, bstats, fed, sampled, rngs,
                                  engine.round_lr(r))
 
-    # compile + warmup
+    # compile + warmup (value sync: block_until_ready proved unreliable
+    # through the remote-TPU tunnel — see PROFILE.md finding 3)
     params, bstats, loss = one_round(params, bstats, 0)
-    jax.block_until_ready((params, bstats))
+    float(loss)
 
     t0 = time.perf_counter()
     for r in range(n_rounds):
         params, bstats, loss = one_round(params, bstats, r + 1)
-    jax.block_until_ready((params, bstats))
+    # the final loss depends on the final params chain => full sync
+    float(loss)
     dt = time.perf_counter() - t0
 
     samples = n_rounds * n_clients * epochs * steps * batch
@@ -137,11 +139,17 @@ def main() -> None:
 
     # ---- phase 2: SalientGrads mask pipeline + Pallas/XLA agreement ----
     sg = create_engine("salientgrads", cfg, fed, trainer, logger=log)
-    masks, _ = sg.generate_global_mask(params, bstats)  # compile + warmup
-    jax.block_until_ready(masks)
+
+    def _mask_sync(masks):
+        # value-sync through EVERY mask leaf (the threshold alone completes
+        # before the downstream per-leaf comparisons do)
+        return float(sum(jnp.sum(m) for m in jax.tree.leaves(masks)))
+
+    masks, thr = sg.generate_global_mask(params, bstats)  # compile + warmup
+    _mask_sync(masks)
     t0 = time.perf_counter()
     masks, thr = sg.generate_global_mask(params, bstats)
-    jax.block_until_ready(masks)
+    _mask_sync(masks)
     mask_ms = (time.perf_counter() - t0) * 1e3
 
     scores = jax.random.uniform(jax.random.key(5), (1 << 22,))
@@ -151,7 +159,7 @@ def main() -> None:
     pallas_ok = bool(jnp.equal(thr_pallas, thr_xla))
     if on_tpu:
         t0 = time.perf_counter()
-        kth_largest(scores, 1 << 21, use_pallas=True).block_until_ready()
+        float(kth_largest(scores, 1 << 21, use_pallas=True))
         topk_ms = (time.perf_counter() - t0) * 1e3
     else:
         topk_ms = None

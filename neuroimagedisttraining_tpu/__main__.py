@@ -275,6 +275,21 @@ def main(argv: list[str] | None = None) -> int:
             profile_trace(args.profile_dir, enabled=bool(args.profile_dir)):
         result = engine.train()
 
+    # persist the stat accumulators (the reference pickles stat_info at end
+    # of training and crashed when the results dir was missing,
+    # subavg_api.py:218-220 / subavg/error3437295.err — we create the dir)
+    import os
+
+    from neuroimagedisttraining_tpu.utils.logging import _jsonable
+
+    stats_path = os.path.join(cfg.log_dir, args.dataset.lower(),
+                              cfg.identity() + ".stats.json")
+    os.makedirs(os.path.dirname(stats_path), exist_ok=True)
+    with open(stats_path, "w") as f:
+        json.dump(_jsonable({k: v for k, v in engine.stat_info.items()
+                             if not k.startswith("final_masks")}),
+                  f, default=str)
+
     final = {k: v for k, v in result.items()
              if k in ("final_global", "final_personal", "mask_density")}
     print(json.dumps({"identity": cfg.identity(), **final}, default=float))

@@ -98,12 +98,57 @@ def _load_npz(data_dir: str):
     return None
 
 
+def _load_tiny_imagenet_folder(data_dir: str):
+    """Canonical tiny-imagenet-200 ImageFolder layout (the reference's
+    loader wraps it in torchvision ImageFolder,
+    tiny_imagenet/data_loader.py:81-121): train/<wnid>/images/*.JPEG with
+    classes in sorted-wnid order; val/ images labeled by
+    val_annotations.txt. Requires PIL; returns None when layout absent."""
+    root = data_dir
+    if os.path.isdir(os.path.join(data_dir, "tiny-imagenet-200")):
+        root = os.path.join(data_dir, "tiny-imagenet-200")
+    train_dir = os.path.join(root, "train")
+    val_dir = os.path.join(root, "val")
+    if not (os.path.isdir(train_dir) and os.path.isdir(val_dir)):
+        return None
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+
+    wnids = sorted(d for d in os.listdir(train_dir)
+                   if os.path.isdir(os.path.join(train_dir, d)))
+    cls = {w: i for i, w in enumerate(wnids)}  # ImageFolder sorted order
+
+    def read(path):
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB"), np.uint8)
+
+    Xtr, ytr = [], []
+    for w in wnids:
+        img_dir = os.path.join(train_dir, w, "images")
+        for f in sorted(os.listdir(img_dir)):
+            Xtr.append(read(os.path.join(img_dir, f)))
+            ytr.append(cls[w])
+    Xte, yte = [], []
+    ann = os.path.join(val_dir, "val_annotations.txt")
+    with open(ann) as fh:
+        for line in fh:
+            parts = line.split("\t")
+            if len(parts) < 2 or parts[1] not in cls:
+                continue
+            Xte.append(read(os.path.join(val_dir, "images", parts[0])))
+            yte.append(cls[parts[1]])
+    return (np.stack(Xtr), np.asarray(ytr, np.int32),
+            np.stack(Xte), np.asarray(yte, np.int32))
+
+
 def load_vision_dataset(name: str, data_dir: str):
     """-> (X_train f32 normalized [N,H,W,C], y_train i32, X_test, y_test)."""
     if name in ("cifar10", "cifar100"):
         raw = _load_pickle_batches(data_dir, name) or _load_npz(data_dir)
     elif name == "tiny":
-        raw = _load_npz(data_dir)
+        raw = _load_tiny_imagenet_folder(data_dir) or _load_npz(data_dir)
     else:
         raise ValueError(f"unknown vision dataset {name!r}")
     if raw is None:

@@ -98,20 +98,29 @@ class SocketCommManager(BaseCommManager):
     # ---- receive side ----
 
     def _listen_loop(self) -> None:
+        import logging
+
+        log = logging.getLogger("neuroimagedisttraining_tpu.comm")
         while self._running:
             try:
                 conn, _ = self._server.accept()
             except OSError:
                 return  # socket closed during shutdown
-            with conn:
-                header = _recv_exact(conn, 8)
-                if header is None:
-                    continue
-                (length,) = struct.unpack("!Q", header)
-                raw = _recv_exact(conn, length)
-                if raw is None:
-                    continue
-            self._q.put(Message.from_bytes(raw))
+            # one bad peer (RST mid-frame, corrupt payload) must not kill
+            # the rank's only listener thread — log and keep serving
+            try:
+                with conn:
+                    header = _recv_exact(conn, 8)
+                    if header is None:
+                        continue
+                    (length,) = struct.unpack("!Q", header)
+                    raw = _recv_exact(conn, length)
+                    if raw is None:
+                        continue
+                self._q.put(Message.from_bytes(raw))
+            except (OSError, ValueError) as e:
+                log.warning("rank %d: dropped malformed/aborted frame: %s",
+                            self.rank, e)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

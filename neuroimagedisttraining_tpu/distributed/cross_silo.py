@@ -38,7 +38,16 @@ def init_multihost(coordinator_address: str, num_processes: int,
                    process_id: int) -> None:
     """Join this process to a multi-host JAX runtime (DCN collectives).
     Thin wrapper so silos opt in with one call; requires all processes to
-    call it before any backend touch."""
+    call it before any backend touch.
+
+    On real TPU pods this makes every host's chips part of one global mesh
+    (libtpu handles cross-host wiring) so the client axis spans hosts and
+    aggregation rides ICI/DCN. NOTE: it cannot be smoke-tested in this
+    build's CPU backend — two CPU processes each come up with
+    process_count=1 (multiprocess CPU clustering is disabled in this jax
+    build; verified empirically), so the cross-process capability test
+    lives in the socket control plane instead
+    (tests/test_distributed.py::test_cross_silo_multiprocess_smoke)."""
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.fedavg import FedAvgEngine
 from neuroimagedisttraining_tpu.ops import mpc
@@ -71,9 +72,16 @@ class TurboAggregateEngine(FedAvgEngine):
             cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
             w = ns.astype(jnp.float32)
             wn = w / jnp.maximum(jnp.sum(w), 1e-12)
+            # robust defenses apply BEFORE weighting/sharing, same stage as
+            # FedAvgEngine._round_body (clipping composes with secure agg:
+            # each silo clips its own update before secret-sharing it)
+            f = self.cfg.fed
+            client_params = robust.defend_stacked(
+                cs.params, params, defense=f.defense_type,
+                norm_bound=f.norm_bound, stddev=f.stddev, rngs=cs.rng)
             weighted = jax.tree.map(
                 lambda x: x.astype(jnp.float32)
-                * wn.reshape((-1,) + (1,) * (x.ndim - 1)), cs.params)
+                * wn.reshape((-1,) + (1,) * (x.ndim - 1)), client_params)
             new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
             mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
             return weighted, new_bstats, mean_loss

@@ -103,14 +103,20 @@ def bgw_decode(shares, worker_idx, p: int = P_DEFAULT) -> np.ndarray:
 # ---------------- LCC (Lagrange Coded Computing) ----------------
 
 def _lcc_points(N: int, K: int, T: int, p: int):
-    """Symmetric evaluation/interpolation point grids (LCC_encoding,
-    mpc_function.py:122-125)."""
+    """Evaluation (alphas) / interpolation (betas) point grids.
+
+    DELIBERATE DEVIATION from the reference: mpc_function.py:122-125
+    centers BOTH grids around 0, so they overlap — a worker whose alpha
+    equals a data-chunk beta receives that chunk IN THE CLEAR (f(beta_j) is
+    the plaintext chunk j), voiding the T-privacy guarantee. Here the
+    alphas start strictly after the betas, keeping the grids disjoint; the
+    encode/decode pair stays self-consistent, only the (broken) share
+    values differ from the reference's."""
     n_beta = K + T
     stt_b = -(n_beta // 2)
-    stt_a = -(N // 2)
-    betas = np.mod(np.arange(stt_b, stt_b + n_beta, dtype=np.int64), p)
-    alphas = np.mod(np.arange(stt_a, stt_a + N, dtype=np.int64), p)
-    return alphas, betas
+    betas = np.arange(stt_b, stt_b + n_beta, dtype=np.int64)
+    alphas = np.arange(betas[-1] + 1, betas[-1] + 1 + N, dtype=np.int64)
+    return np.mod(alphas, p), np.mod(betas, p)
 
 
 def lcc_encode(X, N: int, K: int, T: int, p: int = P_DEFAULT,

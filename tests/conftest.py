@@ -17,6 +17,13 @@ from neuroimagedisttraining_tpu.parallel.mesh import provision_virtual_devices  
 
 provision_virtual_devices(8)
 
+# Persistent XLA compilation cache: the suite is compile-bound (~100 jitted
+# engine programs); warm-cache reruns skip nearly all of it.
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/nidt_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

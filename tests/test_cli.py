@@ -67,6 +67,12 @@ def test_cli_subprocess_end_to_end(tmp_path):
     # file logging under LOG/<dataset>/<identity> (main_sailentgrads.py:184)
     logs = list(tmp_path.glob("synthetic/*.log"))
     assert logs, list(tmp_path.rglob("*"))
+    # stat_info persisted at end of training (reference stat pickle,
+    # subavg_api.py:218-220)
+    stats = list(tmp_path.glob("synthetic/*.stats.json"))
+    assert stats, list(tmp_path.rglob("*"))
+    blob = json.loads(stats[0].read_text())
+    assert "sum_training_flops" in blob and "global_test_acc" in blob
 
 
 def test_cli_unknown_dataset_errors(tmp_path):

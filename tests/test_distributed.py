@@ -2,7 +2,9 @@
 managers, and the full register->broadcast->train->upload->aggregate->finish
 protocol loop (fedml_core/distributed semantics, SURVEY §2.2/§2.3)."""
 
+import itertools
 import multiprocessing as mp
+import os
 import threading
 import time
 
@@ -13,6 +15,14 @@ from neuroimagedisttraining_tpu.distributed.comm import SocketCommManager
 from neuroimagedisttraining_tpu.distributed.cross_silo import (
     FedAvgClientProc, FedAvgServer,
 )
+
+_PORT_SEQ = itertools.count()
+
+
+def _base_port() -> int:
+    """Per-process, per-test unique port block so concurrent pytest runs
+    (or a parallel full-suite invocation) never collide on fixed ports."""
+    return 51000 + (os.getpid() % 180) * 64 + next(_PORT_SEQ) * 8
 
 
 def test_message_codec_roundtrip():
@@ -30,8 +40,9 @@ def test_message_codec_roundtrip():
 
 
 def test_socket_transport_point_to_point():
-    a = SocketCommManager(0, 2, base_port=52210)
-    b = SocketCommManager(1, 2, base_port=52210)
+    bp = _base_port()
+    a = SocketCommManager(0, 2, base_port=bp)
+    b = SocketCommManager(1, 2, base_port=bp)
     got = []
 
     class Obs:
@@ -48,6 +59,38 @@ def test_socket_transport_point_to_point():
     runner.join(timeout=10)
     a.stop_receive_message()
     assert got == [("ping", 41)]
+
+
+def test_listener_survives_malformed_frame():
+    """A corrupt frame or aborted connection must not kill the rank's only
+    listener thread — later well-formed messages still arrive."""
+    import socket
+    import struct
+
+    bp = _base_port()
+    b = SocketCommManager(1, 2, base_port=bp)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(t)
+            b.stop_receive_message()
+
+    b.add_observer(Obs())
+    runner = threading.Thread(target=b.handle_receive_message)
+    runner.start()
+    # garbage frame: valid length prefix, bad magic
+    with socket.create_connection(("127.0.0.1", bp + 1), timeout=5) as c:
+        c.sendall(struct.pack("!Q", 4) + b"junk")
+    # aborted connection: length prefix promising more than is sent
+    with socket.create_connection(("127.0.0.1", bp + 1), timeout=5) as c:
+        c.sendall(struct.pack("!Q", 1 << 20) + b"partial")
+    # a real message still gets through
+    a = SocketCommManager(0, 2, base_port=bp)
+    a.send_message(M.Message("after-junk", 0, 1))
+    runner.join(timeout=15)
+    a.stop_receive_message()
+    assert got == ["after-junk"]
 
 
 def _run_protocol(num_clients, comm_round, base_port, lr=0.5):
@@ -79,7 +122,7 @@ def _run_protocol(num_clients, comm_round, base_port, lr=0.5):
 
 
 def test_cross_silo_fedavg_protocol():
-    server = _run_protocol(num_clients=3, comm_round=2, base_port=52300)
+    server = _run_protocol(num_clients=3, comm_round=2, base_port=_base_port())
     assert len(server.history) == 2
     # closed-form check: one round from w=0 gives w_c = lr*(c+1);
     # weighted mean with weights (1,2,3)/6 -> lr * (1*1+2*2+3*3)/6
@@ -90,6 +133,67 @@ def test_cross_silo_fedavg_protocol():
     r2 = sum((c + 1) * v for c, v in enumerate(vals)) / 6.0
     np.testing.assert_allclose(server.params["w"],
                                np.full(3, r2, np.float32), rtol=1e-6)
+
+
+def test_cross_silo_with_real_trainer(tmp_path):
+    """Real flax model pytrees ride the control plane: each silo trains the
+    tiny 3D CNN with the shipped LocalTrainer on its own shard; the server
+    aggregate equals the in-process weighted mean of the silos' results."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.config import OptimConfig
+    from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer
+    from neuroimagedisttraining_tpu.models import create_model
+
+    model = create_model("3dcnn_tiny", num_classes=1)
+    trainer = LocalTrainer(model, OptimConfig(batch_size=4, epochs=1),
+                           num_classes=1)
+    shape = (10, 12, 10)
+    gs = trainer.init_client_state(jax.random.key(0),
+                                   jnp.zeros((1,) + shape))
+    rng = np.random.default_rng(0)
+    shards = []
+    for c in range(2):
+        X = jnp.asarray(rng.integers(0, 255, size=(8,) + shape), jnp.uint8)
+        y = jnp.asarray(rng.integers(0, 2, size=(8,)), jnp.int32)
+        shards.append((X, y))
+
+    def make_train_fn(c):
+        X, y = shards[c]
+
+        def train_fn(params, round_idx):
+            p32 = jax.tree.map(jnp.asarray, params)
+            cs = ClientState(params=p32, batch_stats=gs.batch_stats,
+                             opt_state=trainer.opt.init(p32),
+                             rng=jax.random.fold_in(jax.random.key(5), c))
+            cs, _ = trainer.local_train(cs, X, y, jnp.int32(8),
+                                        jnp.float32(1e-3), epochs=1,
+                                        batch_size=4, max_samples=8)
+            return jax.tree.map(np.asarray, cs.params), 8.0
+
+        return train_fn
+
+    base_port = _base_port()
+    server = FedAvgServer(gs.params, 1, 2, base_port=base_port)
+    clients = [FedAvgClientProc(c + 1, 2, make_train_fn(c),
+                                base_port=base_port) for c in range(2)]
+    threads = [threading.Thread(target=m.run) for m in [server] + clients]
+    for t in threads:
+        t.start()
+    assert server._done.wait(timeout=300)
+    for t in threads:
+        t.join(timeout=10)
+
+    # in-process control: the same two local_trains, plain weighted mean
+    want_parts = [make_train_fn(c)(gs.params, 0)[0] for c in range(2)]
+    want = jax.tree.map(lambda a, b: (a.astype(np.float64)
+                                      + b.astype(np.float64)) / 2.0,
+                        *want_parts)
+    for ls, lw in zip(jax.tree.leaves(server.params),
+                      jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(ls, np.float64), lw,
+                                   rtol=1e-5, atol=1e-7)
 
 
 def _spawn_client(rank, num_clients, base_port):
@@ -110,7 +214,7 @@ def test_cross_silo_multiprocess_smoke():
     """Two real OS processes register, train, and the server aggregates —
     the multi-process capability check (VERDICT round-1 item 9)."""
     ctx = mp.get_context("spawn")
-    base_port = 52400
+    base_port = _base_port()
     procs = [ctx.Process(target=_spawn_client, args=(r, 2, base_port),
                          daemon=True) for r in (1, 2)]
     for p in procs:

@@ -71,6 +71,20 @@ def test_lcc_roundtrip():
     np.testing.assert_array_equal(rec, X)
 
 
+def test_lcc_shares_never_leak_plaintext_chunks():
+    """Evaluation points must be disjoint from interpolation points — the
+    reference's overlapping grids hand workers raw data chunks in the
+    clear (deliberate deviation, see _lcc_points docstring)."""
+    rng = np.random.default_rng(7)
+    X = rng.integers(0, 1000, size=(8, 5)).astype(np.int64)
+    N, K, T = 9, 4, 2
+    shares = mpc.lcc_encode(X, N, K, T, rng=rng)
+    chunks = X.reshape(K, 2, 5)
+    for i in range(N):
+        for j in range(K):
+            assert not np.array_equal(shares[i] % P, chunks[j] % P), (i, j)
+
+
 def test_additive_shares_sum_and_mask():
     rng = np.random.default_rng(4)
     x = rng.integers(0, 1000, size=(10,)).astype(np.int64)

@@ -110,6 +110,49 @@ def test_streaming_fedavg_identical_to_resident(h5_cohort, tmp_path):
     assert res["final_personal"]["acc"] == st["final_personal"]["acc"]
 
 
+def test_streaming_checkpoint_resume(h5_cohort, tmp_path):
+    """Checkpoint/resume also works in streaming mode: kill back to the
+    round-0 checkpoint, resume, final metrics equal the uninterrupted run."""
+    import os
+
+    from neuroimagedisttraining_tpu.utils import checkpoint as ckpt
+
+    path, data = h5_cohort
+    ck = str(tmp_path / "ck")
+
+    def run():
+        lazy = load_abcd_hdf5(path, lazy=True)
+        train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
+        stream = StreamingFederation(lazy["X"], lazy["y"], train_map,
+                                     test_map)
+        cfg = ExperimentConfig(
+            model="3dcnn_tiny", num_classes=1, algorithm="fedavg",
+            data=DataConfig(dataset="synthetic", partition_method="site"),
+            optim=OptimConfig(lr=1e-2, batch_size=4, epochs=1),
+            fed=FedConfig(client_num_in_total=4, comm_round=2,
+                          frequency_of_the_test=1),
+            checkpoint_dir=ck, checkpoint_every=1,
+            log_dir=str(tmp_path), tag="stck")
+        trainer = LocalTrainer(create_model(cfg.model, num_classes=1),
+                               cfg.optim, num_classes=1)
+        log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                               console=False)
+        engine = create_engine("fedavg", cfg, None, trainer, mesh=None,
+                               logger=log, stream=stream)
+        try:
+            return engine.train()
+        finally:
+            stream.close()
+            lazy["file"].close()
+
+    full = run()
+    assert ckpt.list_checkpoints(ck) == [0, 1]
+    os.unlink(os.path.join(ck, "ckpt_00000001.msgpack"))  # kill after r0
+    resumed = run()
+    assert resumed["final_global"] == full["final_global"]
+    assert len(resumed["history"]) == 2
+
+
 def test_streaming_double_buffer_prefetch(h5_cohort):
     path, data = h5_cohort
     lazy = load_abcd_hdf5(path, lazy=True)

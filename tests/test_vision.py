@@ -3,6 +3,7 @@ label-proportional test splits, npz ingestion, and an end-to-end 2D CNN
 federation (cifar10/data_loader.py:75-249 parity)."""
 
 import numpy as np
+import pytest
 
 from neuroimagedisttraining_tpu.data import partition as P
 from neuroimagedisttraining_tpu.data import vision as V
@@ -98,6 +99,37 @@ def test_uint8_pickle_batches_normalized(tmp_path):
     assert Xtr.shape[1:] == (32, 32, 3)
     assert Xtr.dtype == np.float32
     assert abs(float(Xtr.mean())) < 0.3  # roughly centered after normalize
+
+
+def test_tiny_imagenet_folder_reader(tmp_path):
+    """Fabricate the canonical tiny-imagenet-200 layout and read it."""
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    root = tmp_path / "tiny-imagenet-200"
+    rng = np.random.default_rng(0)
+    wnids = ["n01443537", "n01629819"]
+    (root / "train").mkdir(parents=True)
+    for w in wnids:
+        d = root / "train" / w / "images"
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = rng.integers(0, 256, size=(64, 64, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{w}_{i}.JPEG")
+    vd = root / "val" / "images"
+    vd.mkdir(parents=True)
+    lines = []
+    for i, w in enumerate(wnids):
+        arr = rng.integers(0, 256, size=(64, 64, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(vd / f"val_{i}.JPEG")
+        lines.append(f"val_{i}.JPEG\t{w}\t0\t0\t10\t10\n")
+    (root / "val" / "val_annotations.txt").write_text("".join(lines))
+
+    Xtr, ytr, Xte, yte = V.load_vision_dataset("tiny", str(tmp_path))
+    assert Xtr.shape == (6, 64, 64, 3) and Xtr.dtype == np.float32
+    np.testing.assert_array_equal(np.unique(ytr), [0, 1])
+    assert Xte.shape[0] == 2
+    np.testing.assert_array_equal(yte, [0, 1])
 
 
 def test_federated_vision_end_to_end(tmp_path):
