@@ -115,6 +115,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", type=str, default="",
                         help="capture a jax.profiler trace of training "
                              "into this dir (TensorBoard-loadable)")
+    parser.add_argument("--compile_cache_dir", type=str,
+                        default="/tmp/nidt_jax_cache",
+                        help="persistent XLA compile cache (repeat "
+                             "experiments skip recompiles); empty disables")
     return parser
 
 
@@ -252,6 +256,13 @@ def main(argv: list[str] | None = None) -> int:
         )
         provision_virtual_devices(args.virtual_devices)
 
+    if args.compile_cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          args.compile_cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
     # deterministic seeding (main_sailentgrads.py:264-268)
     random.seed(args.seed)
     np.random.seed(args.seed)
@@ -277,14 +288,13 @@ def main(argv: list[str] | None = None) -> int:
 
     # persist the stat accumulators (the reference pickles stat_info at end
     # of training and crashed when the results dir was missing,
-    # subavg_api.py:218-220 / subavg/error3437295.err — we create the dir)
+    # subavg_api.py:218-220 / subavg/error3437295.err — the logger already
+    # created its dir, which is the single source of truth for the layout)
     import os
 
     from neuroimagedisttraining_tpu.utils.logging import _jsonable
 
-    stats_path = os.path.join(cfg.log_dir, args.dataset.lower(),
-                              cfg.identity() + ".stats.json")
-    os.makedirs(os.path.dirname(stats_path), exist_ok=True)
+    stats_path = os.path.join(engine.log.dir, cfg.identity() + ".stats.json")
     with open(stats_path, "w") as f:
         json.dump(_jsonable({k: v for k, v in engine.stat_info.items()
                              if not k.startswith("final_masks")}),

@@ -162,7 +162,7 @@ def load_vision_dataset(name: str, data_dir: str):
             Xte.astype(np.float32), yte.astype(np.int32))
 
 
-def synthetic_vision_cohort(num_train: int = 512, num_test: int = 128,
+def synthetic_vision_cohort(num_train: int = 256, num_test: int = 96,
                             num_classes: int = 10, hw: int = 32,
                             seed: int = 0):
     """Tiny class-separable images for tests: class-k images carry a mean

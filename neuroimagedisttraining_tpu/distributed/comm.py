@@ -118,7 +118,9 @@ class SocketCommManager(BaseCommManager):
                     if raw is None:
                         continue
                 self._q.put(Message.from_bytes(raw))
-            except (OSError, ValueError) as e:
+            except Exception as e:  # noqa: BLE001 — any bad peer data
+                # (wrong schema -> TypeError/KeyError, msgpack OutOfData,
+                # RST -> OSError) must not kill the only listener thread
                 log.warning("rank %d: dropped malformed/aborted frame: %s",
                             self.rank, e)
 

@@ -50,13 +50,14 @@ def provision_virtual_devices(n: int) -> bool:
     return True
 
 
-def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
-    """1-D mesh over all (or the first N) visible devices, axis "clients"."""
+def make_mesh(num_devices: int | None = None, devices=None,
+              axis_name: str = CLIENT_AXIS) -> Mesh:
+    """1-D mesh over all (or the first N) visible devices."""
     if devices is None:
         devices = jax.devices()
     if num_devices is not None:
         devices = devices[:num_devices]
-    return Mesh(np.asarray(devices), (CLIENT_AXIS,))
+    return Mesh(np.asarray(devices), (axis_name,))
 
 
 def client_sharding(mesh: Mesh) -> NamedSharding:

@@ -91,3 +91,37 @@ def test_fedavg_with_defense_runs(tmp_path, synthetic_cohort):
                           stddev=0.01)
     result = engine.train()
     assert np.isfinite(result["history"][-1]["train_loss"])
+
+
+def test_fedavg_round_clipping_bounds_byzantine_update(tmp_path,
+                                                       synthetic_cohort):
+    """Engine-level: poison one client's data so its gradients explode;
+    with norm_diff_clipping the post-round global moves a bounded distance
+    from the init, without it the aggregate is dragged far away."""
+    import jax.numpy as jnp
+
+    from tests.test_fedavg import _make_engine
+
+    def poisoned_round(engine):
+        gs = engine.init_global_state()
+        data = engine.data
+        # client 0's labels adversarially flipped + inputs scaled: huge
+        # gradients (the Byzantine update), honest clients unchanged
+        Xb = data.X_train.at[0].set(255)
+        yb = data.y_train.at[0].set(1 - data.y_train[0])
+        data = data.replace(X_train=Xb, y_train=yb)
+        sampled = jnp.asarray(engine.client_sampling(0))
+        rngs = engine.per_client_rngs(0, np.asarray(sampled))
+        params, _, _ = engine._round_jit(
+            gs.params, gs.batch_stats, data, sampled, rngs,
+            jnp.float32(0.5))  # big lr amplifies the poison
+        return float(pt.tree_norm(pt.tree_sub(params, gs.params)))
+
+    drift_plain = poisoned_round(_make_engine(tmp_path, synthetic_cohort))
+    drift_clip = poisoned_round(_make_engine(
+        tmp_path, synthetic_cohort, defense_type="norm_diff_clipping",
+        norm_bound=0.5))
+    # every clipped client update has norm <= 0.5, so the weighted mean
+    # cannot drift farther than the bound
+    assert drift_clip <= 0.5 + 1e-4
+    assert drift_plain > drift_clip
