@@ -293,15 +293,18 @@ def proportional_test_split(y_test: np.ndarray, train_stats: dict,
 def federate_vision(name: str, data_dir: str, partition_method: str,
                     alpha: float, client_number: int, mesh=None,
                     val_fraction: float = 0.0, seed: int = 0,
-                    synthetic: bool = False, num_classes: int | None = None):
+                    synthetic: bool = False, num_classes: int | None = None,
+                    synthetic_num: tuple[int, int] | None = None):
     """-> (FederatedData, info): the vision counterpart of federate_cohort,
     with separate train/test pools and the reference's partition modes."""
     from neuroimagedisttraining_tpu.data import partition as P
     from neuroimagedisttraining_tpu.data.federate import build_federated_data
 
     if synthetic:
+        # sizes default inside synthetic_vision_cohort (single source)
         Xtr, ytr, Xte, yte = synthetic_vision_cohort(
-            seed=seed, num_classes=num_classes or 10)
+            *(synthetic_num or ()), seed=seed,
+            num_classes=num_classes or 10)
     else:
         Xtr, ytr, Xte, yte = load_vision_dataset(name, data_dir)
     n_cls = int(num_classes if num_classes is not None else ytr.max() + 1)

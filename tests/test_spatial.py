@@ -21,7 +21,7 @@ def _reference_conv(x, k, b):
     return out if b is None else out + b
 
 
-@pytest.mark.parametrize("kd,cin,cout", [(3, 1, 4), (5, 2, 3)])
+@pytest.mark.parametrize("kd,cin,cout", [(1, 1, 2), (3, 1, 4), (5, 2, 3)])
 def test_depth_sharded_conv_matches_unsharded(kd, cin, cout):
     rng = np.random.default_rng(0)
     mesh = make_space_mesh(8)
