@@ -5,17 +5,22 @@
 ``--model 3DCNN`` -> ``AlexNet3D_Dropout(num_classes=1)``), extended with
 every model family the reference zoo contains.
 
-Explicitly SKIPPED reference models (vestigial — constructed by no
-main_*.py entry point, SURVEY.md §2.5):
+Also covered, though vestigial in the reference (constructed by no
+main_*.py entry point, SURVEY.md §2.5): the meta/mask models
+(``models/meta.py`` — CNNCifarMeta + MetaNet hypernetwork,
+cnn_meta.py:17-176) and the DARTS NAS suite (``models/darts.py`` —
+search supernet, GDAS, exact-autodiff bilevel architect, genotype
+derivation, fixed-genotype evaluation net).
 
-- ``Meta_net``/``resnet_meta``/``resnet_meta_2`` (cnn_meta.py:17-110):
-  mask-parameterized structured-pruning experiments wired only to the
-  unused ``set_client.py`` legacy clients.
-- The DARTS NAS suite (darts/, 1,986 LoC): upstream FedNAS baggage; no
-  experiment harness in the fork references it.
+Explicitly SKIPPED:
+
 - ``batchnorm_utils`` sync-BN helpers: torch-DDP-specific; cross-replica
   BN on TPU would be an axis-name mean inside shard_map, unused by every
   reference experiment.
+- ``resnet_meta.py``/``resnet_meta_2.py``: the same mask-hypernetwork
+  pattern as cnn_meta applied to a ResNet trunk; the pattern is covered
+  by models/meta.py (MetaNet is trunk-agnostic), the specific trunks are
+  dead code even upstream.
 
 The reference's ``resnet_ip`` per-batch-BN personalization variant IS
 covered: ``--model resnet18_ip`` (norm="ipbn", resnet2d._Norm).
@@ -39,6 +44,21 @@ from neuroimagedisttraining_tpu.models.resnet2d import (  # noqa: F401
     customized_resnet18,
     original_resnet18,
     tiny_resnet18,
+)
+from neuroimagedisttraining_tpu.models.darts import (  # noqa: F401
+    DARTS_V1,
+    DARTS_V2,
+    DartsNetwork,
+    DartsSearch,
+    DartsSearchNet,
+    FedNAS_V1,
+    Genotype,
+    PRIMITIVES,
+    derive_genotype,
+)
+from neuroimagedisttraining_tpu.models.meta import (  # noqa: F401
+    CNNCifarMeta,
+    MetaNet,
 )
 from neuroimagedisttraining_tpu.models.vision2d import (  # noqa: F401
     VGG,
@@ -96,6 +116,16 @@ def create_model(name: str, num_classes: int = 1, dtype=jnp.float32,
         return LeNet5(num_classes=num_classes, dtype=dtype)
     if name == "lenet5_cifar":
         return LeNet5_cifar(num_classes=num_classes, dtype=dtype)
+    if name == "darts_search":
+        return DartsSearchNet(num_classes=num_classes, dtype=dtype)
+    if name in ("darts", "darts_v2"):
+        return DartsNetwork(genotype=DARTS_V2, num_classes=num_classes,
+                            dtype=dtype)
+    if name == "fednas_v1":
+        return DartsNetwork(genotype=FedNAS_V1, num_classes=num_classes,
+                            dtype=dtype)
+    if name in ("cnn_cifar10_meta", "cnn_meta"):
+        return CNNCifarMeta(num_classes=num_classes, dtype=dtype)
     raise ValueError(f"unknown model: {name!r}")
 
 

@@ -1,0 +1,718 @@
+"""DARTS differentiable architecture search — TPU-native (Flax/NHWC).
+
+Covers the reference's DARTS NAS suite (SURVEY §2.5, ~2k LoC of upstream
+FedNAS baggage), re-designed rather than translated:
+
+- candidate operations: reference operations.py:4-107 (sep/dil convs,
+  pools, skip, zero, factorized reduce). NHWC, depthwise via
+  ``feature_group_count``; avg-pool replicates torch's
+  ``count_include_pad=False`` denominator.
+- search network: softmax-mixed ops over a DAG cell
+  (model_search.py:10-246). Architecture logits live in the SAME flax
+  param tree as weights (``alphas_normal``/``alphas_reduce``) and are
+  split off by name for the bilevel optimizers — no special Parameter
+  class, no ``arch_parameters()`` accessors.
+- GDAS variant (model_search_gdas.py): straight-through Gumbel-softmax
+  hard op selection per edge, ``gumbel=True`` + a ``gumbel`` RNG stream
+  (one fused program; the reference builds a second model class).
+- genotype constants + derivation: genotypes.py:1-91,
+  model_search.py:258-291 (top-2 incoming edges by best non-'none'
+  weight).
+- evaluation network from a fixed genotype with drop-path + auxiliary
+  head: model.py:9-160.
+- bilevel architect (architect.py): the torch version approximates the
+  second-order term of the unrolled objective with finite differences
+  (architect.py:121-180). Here the inner SGD step is differentiated
+  EXACTLY — ``jax.grad`` through ``w' = w - eta*(mu*buf + dL_tr/dw +
+  wd*w)`` — and XLA compiles the whole bilevel step into one program.
+  The FedNAS first-order variant (``step_v2``, architect.py:57-104:
+  g_val + lambda*g_train on arch params) is ``arch_grad_regularized``.
+
+BatchNorm during search runs in batch-stats mode with no running-average
+tracking (torch keeps train-mode BN whose running stats are never
+consumed, operations.py affine=False) — the search step stays purely
+functional. The fixed evaluation network tracks ``batch_stats`` like the
+rest of the zoo.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+Genotype = namedtuple("Genotype", "normal normal_concat reduce reduce_concat")
+
+PRIMITIVES = (
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+)
+
+# Published architecture constants (genotypes.py:16-91).
+DARTS_V1 = Genotype(
+    normal=[("sep_conv_3x3", 1), ("sep_conv_3x3", 0), ("skip_connect", 0),
+            ("sep_conv_3x3", 1), ("skip_connect", 0), ("sep_conv_3x3", 1),
+            ("sep_conv_3x3", 0), ("skip_connect", 2)],
+    normal_concat=[2, 3, 4, 5],
+    reduce=[("max_pool_3x3", 0), ("max_pool_3x3", 1), ("skip_connect", 2),
+            ("max_pool_3x3", 0), ("max_pool_3x3", 0), ("skip_connect", 2),
+            ("skip_connect", 2), ("avg_pool_3x3", 0)],
+    reduce_concat=[2, 3, 4, 5])
+DARTS_V2 = Genotype(
+    normal=[("sep_conv_3x3", 0), ("sep_conv_3x3", 1), ("sep_conv_3x3", 0),
+            ("sep_conv_3x3", 1), ("sep_conv_3x3", 1), ("skip_connect", 0),
+            ("skip_connect", 0), ("dil_conv_3x3", 2)],
+    normal_concat=[2, 3, 4, 5],
+    reduce=[("max_pool_3x3", 0), ("max_pool_3x3", 1), ("skip_connect", 2),
+            ("max_pool_3x3", 1), ("max_pool_3x3", 0), ("skip_connect", 2),
+            ("skip_connect", 2), ("max_pool_3x3", 1)],
+    reduce_concat=[2, 3, 4, 5])
+FedNAS_V1 = Genotype(
+    normal=[("sep_conv_3x3", 1), ("sep_conv_3x3", 0), ("sep_conv_3x3", 2),
+            ("sep_conv_5x5", 0), ("sep_conv_3x3", 1), ("sep_conv_5x5", 3),
+            ("dil_conv_5x5", 3), ("sep_conv_3x3", 4)],
+    normal_concat=list(range(2, 6)),
+    reduce=[("max_pool_3x3", 0), ("skip_connect", 1), ("max_pool_3x3", 0),
+            ("max_pool_3x3", 2), ("max_pool_3x3", 0), ("dil_conv_5x5", 1),
+            ("max_pool_3x3", 0), ("dil_conv_5x5", 2)],
+    reduce_concat=list(range(2, 6)))
+DARTS = DARTS_V2
+
+
+# ---------------------------------------------------------------------------
+# candidate operations (operations.py:4-107)
+# ---------------------------------------------------------------------------
+
+
+def _pair(v: int) -> tuple[int, int]:
+    return (v, v)
+
+
+def _pad(k: int, dilation: int = 1) -> Sequence[tuple[int, int]]:
+    p = dilation * (k - 1) // 2
+    return [(p, p), (p, p)]
+
+
+def avg_pool_3x3(x: jax.Array, stride: int) -> jax.Array:
+    """3x3 avg pool, pad 1, torch ``count_include_pad=False``: divide each
+    window sum by the number of REAL (unpadded) elements in the window."""
+    pad = [(1, 1), (1, 1)]
+    s = nn.pooling.pool(x, 0.0, jax.lax.add, (3, 3), _pair(stride), pad)
+    ones = jnp.ones((1,) + x.shape[1:3] + (1,), x.dtype)
+    cnt = nn.pooling.pool(ones, 0.0, jax.lax.add, (3, 3), _pair(stride), pad)
+    return s / cnt
+
+
+def max_pool_3x3(x: jax.Array, stride: int) -> jax.Array:
+    return nn.max_pool(x, (3, 3), _pair(stride), [(1, 1), (1, 1)])
+
+
+class _BN(nn.Module):
+    """Normalization in two modes. Search mode (``track=False``): per-batch
+    statistics, stateless — no ``batch_stats`` collection at all, so the
+    bilevel step stays purely functional (the torch search net also never
+    consumes its running stats: train-mode BN, affine=False,
+    operations.py). Fixed-net mode (``track=True``): standard tracked
+    BatchNorm honoring train/eval."""
+
+    affine: bool = True
+    track: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.track:
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                epsilon=1e-5, use_scale=self.affine,
+                                use_bias=self.affine, dtype=self.dtype)(x)
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        var = jnp.var(x, axis=(0, 1, 2))
+        y = (x - mean) * jax.lax.rsqrt(var + 1e-5)
+        if self.affine:
+            c = x.shape[-1]
+            y = (y * self.param("scale", nn.initializers.ones, (c,))
+                 + self.param("bias", nn.initializers.zeros, (c,)))
+        return y.astype(self.dtype)
+
+
+class ReLUConvBN(nn.Module):
+    c_out: int
+    kernel: int
+    stride: int
+    affine: bool = True
+    track: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.relu(x)
+        x = nn.Conv(self.c_out, _pair(self.kernel), _pair(self.stride),
+                    padding=_pad(self.kernel), use_bias=False,
+                    dtype=self.dtype)(x)
+        return _BN(self.affine, self.track, self.dtype)(x, train)
+
+
+class SepConv(nn.Module):
+    """Two stacked depthwise-separable convs (operations.py:55-71)."""
+
+    c_out: int
+    kernel: int
+    stride: int
+    affine: bool = True
+    track: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c_in = x.shape[-1]
+        x = nn.relu(x)
+        x = nn.Conv(c_in, _pair(self.kernel), _pair(self.stride),
+                    padding=_pad(self.kernel), feature_group_count=c_in,
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.Conv(c_in, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = _BN(self.affine, self.track, self.dtype)(x, train)
+        x = nn.relu(x)
+        x = nn.Conv(c_in, _pair(self.kernel), (1, 1),
+                    padding=_pad(self.kernel), feature_group_count=c_in,
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.Conv(self.c_out, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        return _BN(self.affine, self.track, self.dtype)(x, train)
+
+
+class DilConv(nn.Module):
+    c_out: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+    affine: bool = True
+    track: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c_in = x.shape[-1]
+        x = nn.relu(x)
+        x = nn.Conv(c_in, _pair(self.kernel), _pair(self.stride),
+                    padding=_pad(self.kernel, self.dilation),
+                    kernel_dilation=_pair(self.dilation),
+                    feature_group_count=c_in, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.Conv(self.c_out, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        return _BN(self.affine, self.track, self.dtype)(x, train)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 channel-preserving reduce: two offset 1x1/s2 convs,
+    concatenated (operations.py:95-107)."""
+
+    c_out: int
+    affine: bool = True
+    track: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.relu(x)
+        a = nn.Conv(self.c_out // 2, (1, 1), (2, 2), padding="VALID",
+                    use_bias=False, dtype=self.dtype)(x)
+        b = nn.Conv(self.c_out // 2, (1, 1), (2, 2), padding="VALID",
+                    use_bias=False, dtype=self.dtype)(x[:, 1:, 1:, :])
+        out = jnp.concatenate([a, b], axis=-1)
+        return _BN(self.affine, self.track, self.dtype)(out, train)
+
+
+class Conv7x1_1x7(nn.Module):
+    """Factorized 7x7 (operations.py:14-19); used by the NASNet genotype."""
+
+    c_out: int
+    stride: int
+    affine: bool = True
+    track: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.relu(x)
+        x = nn.Conv(self.c_out, (1, 7), (1, self.stride),
+                    padding=[(0, 0), (3, 3)], use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.Conv(self.c_out, (7, 1), (self.stride, 1),
+                    padding=[(3, 3), (0, 0)], use_bias=False,
+                    dtype=self.dtype)(x)
+        return _BN(self.affine, self.track, self.dtype)(x, train)
+
+
+def _zero(x: jax.Array, stride: int) -> jax.Array:
+    if stride == 1:
+        return jnp.zeros_like(x)
+    return jnp.zeros_like(x[:, ::stride, ::stride, :])
+
+
+class _Op(nn.Module):
+    """One primitive by name (OPS table, operations.py:4-20). In search
+    mode (``bn_after_pool=True``) pooling ops get a trailing affine-less
+    BN (model_search.py:17-18)."""
+
+    prim: str
+    c: int
+    stride: int
+    affine: bool = True
+    track: bool = False
+    bn_after_pool: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        n, s = self.prim, self.stride
+        kw = dict(affine=self.affine, track=self.track, dtype=self.dtype)
+        if n == "none":
+            return _zero(x, s)
+        if n == "skip_connect":
+            return x if s == 1 else FactorizedReduce(self.c, **kw)(x, train)
+        if n in ("max_pool_3x3", "avg_pool_3x3"):
+            y = (max_pool_3x3(x, s) if n.startswith("max")
+                 else avg_pool_3x3(x, s))
+            if self.bn_after_pool:
+                y = _BN(False, self.track, self.dtype)(y, train)
+            return y
+        if n.startswith("sep_conv"):
+            k = int(n[-1])
+            return SepConv(self.c, k, s, **kw)(x, train)
+        if n.startswith("dil_conv"):
+            k = int(n[-1])
+            return DilConv(self.c, k, s, 2, **kw)(x, train)
+        if n == "conv_7x1_1x7":
+            return Conv7x1_1x7(self.c, s, **kw)(x, train)
+        raise ValueError(f"unknown primitive {n!r}")
+
+
+# ---------------------------------------------------------------------------
+# search network (model_search.py)
+# ---------------------------------------------------------------------------
+
+
+def num_edges(steps: int) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class MixedOp(nn.Module):
+    """Softmax-weighted sum over all primitives (model_search.py:10-23)."""
+
+    c: int
+    stride: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, weights, train: bool = True):
+        outs = [_Op(p, self.c, self.stride, affine=False,
+                    bn_after_pool=True, dtype=self.dtype)(x, train)
+                for p in PRIMITIVES]
+        return sum(w * o for w, o in zip(weights, outs))
+
+
+class SearchCell(nn.Module):
+    """DAG cell: 2 preprocessed inputs + ``steps`` intermediate nodes, each
+    the weighted sum of mixed ops over all predecessors
+    (model_search.py:26-60)."""
+
+    c: int
+    steps: int
+    multiplier: int
+    reduction: bool
+    reduction_prev: bool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train: bool = True):
+        pre = dict(affine=False, dtype=self.dtype)
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.c, **pre)(s0, train)
+        else:
+            s0 = ReLUConvBN(self.c, 1, 1, **pre)(s0, train)
+        s1 = ReLUConvBN(self.c, 1, 1, **pre)(s1, train)
+        states = [s0, s1]
+        offset = 0
+        for _ in range(self.steps):
+            s = sum(
+                MixedOp(self.c,
+                        2 if self.reduction and j < 2 else 1,
+                        dtype=self.dtype)(h, weights[offset + j], train)
+                for j, h in enumerate(states))
+            offset += len(states)
+            states.append(s)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+def _gumbel_hard(logits: jax.Array, rng: jax.Array, tau: float) -> jax.Array:
+    """Straight-through Gumbel-softmax rows (GDAS,
+    model_search_gdas.py): hard one-hot forward, soft gradient."""
+    g = jax.random.gumbel(rng, logits.shape)
+    soft = jax.nn.softmax((logits + g) / tau, axis=-1)
+    hard = jax.nn.one_hot(jnp.argmax(soft, -1), logits.shape[-1],
+                          dtype=soft.dtype)
+    return hard + soft - jax.lax.stop_gradient(soft)
+
+
+class DartsSearchNet(nn.Module):
+    """The over-parameterized search supernet (model_search.py:171-246).
+
+    ``gumbel=True`` switches the edge mixture from softmax to
+    straight-through Gumbel-softmax (GDAS) using the ``gumbel`` RNG
+    stream and temperature ``tau``.
+    """
+
+    c: int = 16
+    num_classes: int = 10
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+    gumbel: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, tau: float = 1.0):
+        k = num_edges(self.steps)
+        init = nn.initializers.normal(stddev=1e-3)
+        alphas_normal = self.param("alphas_normal", init,
+                                   (k, len(PRIMITIVES)))
+        alphas_reduce = self.param("alphas_reduce", init,
+                                   (k, len(PRIMITIVES)))
+        if self.gumbel and not train:
+            # deterministic GDAS eval: noise-free argmax one-hot selection
+            w_normal = jax.nn.one_hot(jnp.argmax(alphas_normal, -1),
+                                      len(PRIMITIVES))
+            w_reduce = jax.nn.one_hot(jnp.argmax(alphas_reduce, -1),
+                                      len(PRIMITIVES))
+        elif self.gumbel:
+            rng = self.make_rng("gumbel")
+            rn, rr = jax.random.split(rng)
+            w_normal = _gumbel_hard(alphas_normal, rn, tau)
+            w_reduce = _gumbel_hard(alphas_reduce, rr, tau)
+        else:
+            w_normal = jax.nn.softmax(alphas_normal, axis=-1)
+            w_reduce = jax.nn.softmax(alphas_reduce, axis=-1)
+
+        c_curr = self.stem_multiplier * self.c
+        s = nn.Conv(c_curr, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False,
+                    dtype=self.dtype)(x)
+        s0 = s1 = _BN(True, False, self.dtype)(s, train)
+
+        c_curr = self.c
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                c_curr *= 2
+            cell = SearchCell(c_curr, self.steps, self.multiplier, reduction,
+                              reduction_prev, dtype=self.dtype)
+            s0, s1 = s1, cell(s0, s1,
+                              w_reduce if reduction else w_normal, train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(out)
+
+
+def derive_genotype(alphas_normal, alphas_reduce, steps: int = 4,
+                    multiplier: int = 4) -> Genotype:
+    """Discrete architecture from arch logits (model_search.py:258-291):
+    per node keep the 2 incoming edges with the highest best-non-'none'
+    weight; per kept edge the best non-'none' op."""
+
+    def _parse(alphas):
+        w = np.asarray(jax.nn.softmax(jnp.asarray(alphas), axis=-1))
+        none_idx = PRIMITIVES.index("none")
+        gene, start = [], 0
+        for i in range(steps):
+            n = i + 2
+            rows = w[start:start + n]
+            best = [max(rows[j][k] for k in range(len(PRIMITIVES))
+                        if k != none_idx) for j in range(n)]
+            edges = sorted(range(n), key=lambda j: -best[j])[:2]
+            for j in sorted(edges):
+                ks = [k for k in range(len(PRIMITIVES)) if k != none_idx]
+                k_best = max(ks, key=lambda k: rows[j][k])
+                gene.append((PRIMITIVES[k_best], j))
+            start += n
+        return gene
+
+    concat = list(range(2 + steps - multiplier, steps + 2))
+    return Genotype(normal=_parse(alphas_normal), normal_concat=concat,
+                    reduce=_parse(alphas_reduce), reduce_concat=concat)
+
+
+# ---------------------------------------------------------------------------
+# fixed-genotype evaluation network (model.py)
+# ---------------------------------------------------------------------------
+
+
+def _drop_path(x: jax.Array, rng: jax.Array, prob: float) -> jax.Array:
+    keep = 1.0 - prob
+    mask = jax.random.bernoulli(rng, keep, (x.shape[0],) + (1,) * (x.ndim - 1))
+    return x * mask.astype(x.dtype) / keep
+
+
+class FixedCell(nn.Module):
+    """Cell compiled from a genotype (model.py:9-61): per node exactly two
+    incoming edges with fixed ops, drop-path on non-identity edges."""
+
+    genotype: Genotype
+    c: int
+    reduction: bool
+    reduction_prev: bool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, s0, s1, train: bool = True, drop_prob: float = 0.0):
+        kw = dict(affine=True, track=True, dtype=self.dtype)
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.c, **kw)(s0, train)
+        else:
+            s0 = ReLUConvBN(self.c, 1, 1, **kw)(s0, train)
+        s1 = ReLUConvBN(self.c, 1, 1, **kw)(s1, train)
+
+        gene = self.genotype.reduce if self.reduction else self.genotype.normal
+        concat = (self.genotype.reduce_concat if self.reduction
+                  else self.genotype.normal_concat)
+        names, indices = zip(*gene)
+        steps = len(names) // 2
+
+        states = [s0, s1]
+        for i in range(steps):
+            hs = []
+            for slot in (2 * i, 2 * i + 1):
+                name, idx = names[slot], indices[slot]
+                stride = 2 if self.reduction and idx < 2 else 1
+                h = _Op(name, self.c, stride, **kw)(states[idx], train)
+                # drop-path exempts only true Identity edges (model.py:52-57)
+                # — a stride-2 skip_connect is a FactorizedReduce and IS
+                # drop-pathed by the reference
+                is_identity = name == "skip_connect" and stride == 1
+                if train and drop_prob > 0 and not is_identity:
+                    h = _drop_path(h, self.make_rng("droppath"), drop_prob)
+                hs.append(h)
+            states.append(hs[0] + hs[1])
+        return jnp.concatenate([states[i] for i in concat], axis=-1)
+
+
+class AuxiliaryHead(nn.Module):
+    """CIFAR auxiliary classifier, assumes 8x8 input (model.py:64-83)."""
+
+    num_classes: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.relu(x)
+        x = nn.pooling.pool(x, 0.0, jax.lax.add, (5, 5), (3, 3),
+                            "VALID") / 25.0
+        x = nn.Conv(128, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = _BN(True, True, self.dtype)(x, train)
+        x = nn.relu(x)
+        x = nn.Conv(768, (2, 2), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = _BN(True, True, self.dtype)(x, train)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(
+            x.reshape(x.shape[0], -1))
+
+
+class DartsNetwork(nn.Module):
+    """Evaluation network from a fixed genotype (NetworkCIFAR,
+    model.py:113-160). Returns ``(logits, logits_aux)`` like the
+    reference (logits_aux is None unless ``auxiliary`` and training)."""
+
+    genotype: Genotype = DARTS_V2
+    c: int = 36
+    num_classes: int = 10
+    layers: int = 20
+    auxiliary: bool = False
+    stem_multiplier: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, drop_path_prob: float = 0.0):
+        c_curr = self.stem_multiplier * self.c
+        s = nn.Conv(c_curr, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False,
+                    dtype=self.dtype)(x)
+        s0 = s1 = _BN(True, True, self.dtype)(s, train)
+
+        c_curr = self.c
+        reduction_prev = False
+        logits_aux = None
+        aux_layer = 2 * self.layers // 3
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                c_curr *= 2
+            cell = FixedCell(self.genotype, c_curr, reduction,
+                             reduction_prev, dtype=self.dtype)
+            s0, s1 = s1, cell(s0, s1, train, drop_path_prob)
+            reduction_prev = reduction
+            if i == aux_layer and self.auxiliary:
+                # params exist regardless of mode (torch builds the head in
+                # __init__); the unused eval-mode branch is DCE'd by XLA
+                aux = AuxiliaryHead(self.num_classes, self.dtype)(s1, train)
+                logits_aux = aux if train else None
+        out = jnp.mean(s1, axis=(1, 2))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(out)
+        return logits, logits_aux
+
+
+# ---------------------------------------------------------------------------
+# bilevel architect (architect.py) — exact unrolled gradient via autodiff
+# ---------------------------------------------------------------------------
+
+ARCH_KEYS = ("alphas_normal", "alphas_reduce")
+
+
+def split_arch(params: dict) -> tuple[dict, dict]:
+    """(arch, weights) partition of a search-net param tree by name."""
+    arch = {k: params[k] for k in ARCH_KEYS}
+    weights = {k: v for k, v in params.items() if k not in ARCH_KEYS}
+    return arch, weights
+
+
+def merge_arch(arch: dict, weights: dict) -> dict:
+    return {**weights, **arch}
+
+
+def arch_grad_unrolled(loss_fn, params: dict, train_batch, val_batch,
+                       eta: float, momentum: float = 0.9,
+                       weight_decay: float = 3e-4,
+                       momentum_buf: dict | None = None) -> dict:
+    """Exact gradient of the unrolled objective
+    ``L_val(w - eta*(mu*buf + dL_train/dw + wd*w), alpha)`` w.r.t. alpha.
+
+    ``loss_fn(params, batch) -> scalar``. The torch architect builds an
+    unrolled model by hand and finite-differences the second-order term
+    (architect.py:121-180); autodiff through the inner step gives the
+    exact quantity in one compiled program.
+    """
+    arch, weights = split_arch(params)
+    if momentum_buf is None:
+        momentum_buf = jax.tree.map(jnp.zeros_like, weights)
+
+    def val_after_inner(a):
+        g_w = jax.grad(
+            lambda w: loss_fn(merge_arch(a, w), train_batch))(weights)
+        w2 = jax.tree.map(
+            lambda w, g, m: w - eta * (momentum * m + g + weight_decay * w),
+            weights, g_w, momentum_buf)
+        return loss_fn(merge_arch(a, w2), val_batch)
+
+    return jax.grad(val_after_inner)(arch)
+
+
+def arch_grad_regularized(loss_fn, params: dict, train_batch, val_batch,
+                          lambda_train: float = 1.0,
+                          lambda_valid: float = 1.0) -> dict:
+    """FedNAS ``step_v2`` (architect.py:57-104): first-order arch gradient
+    ``lambda_valid * dL_val/da + lambda_train * dL_train/da``."""
+    arch, weights = split_arch(params)
+
+    def at(a, batch):
+        return loss_fn(merge_arch(a, weights), batch)
+
+    g_tr = jax.grad(lambda a: at(a, train_batch))(arch)
+    g_val = jax.grad(lambda a: at(a, val_batch))(arch)
+    return jax.tree.map(lambda gv, gt: lambda_valid * gv + lambda_train * gt,
+                        g_val, g_tr)
+
+
+class DartsSearch:
+    """Compact bilevel search driver (train_search.py:240-284 semantics):
+    per batch, one architect Adam step on (alphas | val batch) then one
+    clipped-SGD-momentum step on (weights | train batch).
+
+    Reference hyperparameters preserved as defaults: weight SGD lr 0.025
+    cosine-annealed to 0.001, momentum 0.9, wd 3e-4, grad clip 5; arch
+    Adam lr 3e-4, betas (0.5, 0.999), wd 1e-3 (train_search.py:24-45).
+    """
+
+    def __init__(self, net: DartsSearchNet, num_classes: int,
+                 lr: float = 0.025, lr_min: float = 0.001,
+                 momentum: float = 0.9, weight_decay: float = 3e-4,
+                 grad_clip: float = 5.0, arch_lr: float = 3e-4,
+                 arch_weight_decay: float = 1e-3, unrolled: bool = False,
+                 total_steps: int = 1000):
+        import optax
+
+        if net.gumbel:
+            raise ValueError(
+                "DartsSearch drives the softmax supernet; for GDAS apply "
+                "the gumbel=True net directly with a 'gumbel' RNG stream")
+        self.net = net
+        self.unrolled = unrolled
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.lr_sched = optax.cosine_decay_schedule(
+            lr, total_steps, alpha=lr_min / lr)
+        self.w_opt = optax.chain(
+            optax.clip_by_global_norm(grad_clip),
+            optax.add_decayed_weights(weight_decay),
+            optax.trace(decay=momentum, nesterov=False),
+            optax.scale_by_schedule(lambda s: -self.lr_sched(s)))
+        self.a_opt = optax.chain(
+            optax.add_decayed_weights(arch_weight_decay),
+            optax.scale_by_adam(b1=0.5, b2=0.999),
+            optax.scale(-arch_lr))
+        self.num_classes = num_classes
+        self._step = jax.jit(self._step_impl)
+
+    def loss_fn(self, params, batch):
+        from neuroimagedisttraining_tpu.core.losses import softmax_ce
+
+        x, y = batch
+        logits = self.net.apply({"params": params}, x, train=True)
+        return softmax_ce(logits, y)
+
+    def init(self, rng, sample_input):
+        params = self.net.init(rng, sample_input, train=False)["params"]
+        arch, weights = split_arch(params)
+        return {"params": params, "w_opt": self.w_opt.init(weights),
+                "a_opt": self.a_opt.init(arch), "step": jnp.zeros((), jnp.int32)}
+
+    def _step_impl(self, state, train_batch, val_batch):
+        params = state["params"]
+        arch, weights = split_arch(params)
+        eta = self.lr_sched(state["step"])
+
+        if self.unrolled:
+            # momentum buffer lives in optax.trace state (index 2 in chain)
+            buf = state["w_opt"][2].trace
+            g_a = arch_grad_unrolled(self.loss_fn, params, train_batch,
+                                     val_batch, eta, self.momentum,
+                                     self.weight_decay, buf)
+        else:
+            g_a = jax.grad(lambda a: self.loss_fn(
+                merge_arch(a, weights), val_batch))(arch)
+        a_up, a_opt = self.a_opt.update(g_a, state["a_opt"], arch)
+        arch = jax.tree.map(lambda p, u: p + u, arch, a_up)
+
+        loss, g_w = jax.value_and_grad(lambda w: self.loss_fn(
+            merge_arch(arch, w), train_batch))(weights)
+        w_up, w_opt = self.w_opt.update(g_w, state["w_opt"], weights)
+        weights = jax.tree.map(lambda p, u: p + u, weights, w_up)
+
+        return {"params": merge_arch(arch, weights), "w_opt": w_opt,
+                "a_opt": a_opt, "step": state["step"] + 1}, loss
+
+    def step(self, state, train_batch, val_batch):
+        """One jitted bilevel update; returns (new_state, train_loss)."""
+        return self._step(state, train_batch, val_batch)
+
+    def genotype(self, state) -> Genotype:
+        arch, _ = split_arch(state["params"])
+        return derive_genotype(arch["alphas_normal"], arch["alphas_reduce"],
+                               self.net.steps, self.net.multiplier)

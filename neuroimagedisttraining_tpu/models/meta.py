@@ -1,0 +1,96 @@
+"""Mask-parameterized "meta" models for structured-pruning experiments.
+
+Reference: cnn_meta.py:17-176 (``cnn_cifar10_meta``: a bias-free CIFAR CNN
+whose two convs + fc carry external binary masks, plus random mask init
+utilities) and ``Meta_net`` (cnn_meta.py:146-176: a hypernetwork MLP that
+maps a flattened mask to a conv weight tensor of the same shape). The
+reference wires these only into legacy ``set_client.py`` experiments; they
+are provided here for zoo parity.
+
+TPU re-design notes: masks are pytree inputs (not monkey-patched module
+attributes); the mask-to-weight hypernetwork is a plain Flax MLP applied
+per-tensor. The torch mask init draws ``randperm``-without-replacement over
+flat indices; here the same marginal density uses a uniform top-k draw
+(exact nnz like the reference, cnn_meta.py:58-67).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class CNNCifarMeta(nn.Module):
+    """Bias-free masked CNN (cnn_meta.py:83-145): conv5x5(64) -> pool3/2 ->
+    conv5x5(64) -> pool3/2 -> fc(64*4*4 -> classes). ``masks`` (optional)
+    holds {"meta_conv1", "meta_conv2"} kernels' binary masks, applied as
+    ``w * mask`` — the masked-forward semantics the torch version gets by
+    multiplying ``module.weight`` in place."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, masks: dict | None = None, train: bool = True):
+        def conv_block(name, x):
+            kernel = self.param(f"{name}_kernel", nn.initializers.he_uniform(),
+                                (5, 5, x.shape[-1], 64), self.dtype)
+            if masks is not None and name in masks:
+                kernel = kernel * masks[name].astype(kernel.dtype)
+            y = jax.lax.conv_general_dilated(
+                x.astype(self.dtype), kernel, (1, 1), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            y = nn.relu(y)
+            return nn.max_pool(y, (3, 3), (2, 2))
+
+        x = conv_block("meta_conv1", x)
+        x = conv_block("meta_conv2", x)
+        x = x.reshape(x.shape[0], -1)
+        w = self.param("meta_fc1_kernel", nn.initializers.he_uniform(),
+                       (x.shape[-1], self.num_classes), self.dtype)
+        if masks is not None and "meta_fc1" in masks:
+            w = w * masks["meta_fc1"].astype(w.dtype)
+        return x @ w
+
+    @staticmethod
+    def init_masks(rng: jax.Array, params: dict,
+                   dense_ratio: float = 0.2) -> dict:
+        """Random binary masks at exact per-tensor density for every
+        ``meta_*`` tensor (parity with init_masks/init_conv_masks,
+        cnn_meta.py:47-67: randperm keeps exactly
+        ``int(dense_ratio * numel)`` ones)."""
+        masks = {}
+        for name, w in params.items():
+            if not name.endswith("_kernel"):
+                continue
+            rng, sub = jax.random.split(rng)
+            n = w.size
+            nnz = int(dense_ratio * n)
+            scores = jax.random.uniform(sub, (n,))
+            thr = jnp.sort(scores)[n - nnz] if nnz > 0 else jnp.inf
+            masks[name.removesuffix("_kernel")] = (
+                (scores >= thr).astype(jnp.float32).reshape(w.shape))
+        return masks
+
+
+class MetaNet(nn.Module):
+    """Hypernetwork mask -> conv-weight (Meta_net, cnn_meta.py:146-166):
+    flatten -> fc(50) -> relu -> fc(50) -> relu -> fc(size) -> reshape."""
+
+    hidden: int = 50
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, mask: jax.Array) -> jax.Array:
+        size = mask.size
+        x = mask.reshape(-1).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype,
+                             kernel_init=nn.initializers.he_uniform())(x))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype,
+                             kernel_init=nn.initializers.he_uniform())(x))
+        w = nn.Dense(size, dtype=self.dtype,
+                     kernel_init=nn.initializers.he_uniform())(x)
+        return w.reshape(mask.shape)

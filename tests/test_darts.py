@@ -1,0 +1,155 @@
+"""DARTS suite: search supernet, bilevel step, genotype derivation,
+fixed-genotype network, GDAS gumbel path, meta models.
+
+Shapes are kept tiny (C=4, 2-3 cells, 8x8 or 16x16 inputs) — the point is
+semantics, not capacity: reference model_search.py / model.py / architect.py
+/ cnn_meta.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.models.darts import (
+    DARTS_V2,
+    DartsNetwork,
+    DartsSearch,
+    DartsSearchNet,
+    PRIMITIVES,
+    arch_grad_regularized,
+    arch_grad_unrolled,
+    derive_genotype,
+    num_edges,
+    split_arch,
+)
+from neuroimagedisttraining_tpu.models.meta import CNNCifarMeta, MetaNet
+
+
+def _tiny_net(**kw):
+    return DartsSearchNet(c=4, num_classes=10, layers=3, steps=2,
+                          multiplier=2, **kw)
+
+
+@pytest.fixture(scope="module")
+def search_setup():
+    net = _tiny_net()
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16, 3))
+    params = net.init(jax.random.key(1), x, train=False)["params"]
+    return net, x, params
+
+
+def test_search_net_forward_and_alpha_shapes(search_setup):
+    net, x, params = search_setup
+    k = num_edges(2)
+    assert params["alphas_normal"].shape == (k, len(PRIMITIVES))
+    assert params["alphas_reduce"].shape == (k, len(PRIMITIVES))
+    logits = net.apply({"params": params}, x, train=True)
+    assert logits.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_bilevel_search_step_moves_alphas_and_weights(search_setup):
+    net, x, _ = search_setup
+    y = jnp.array([1, 3])
+    search = DartsSearch(net, num_classes=10, total_steps=4)
+    state = search.init(jax.random.key(2), x)
+    a0, w0 = split_arch(state["params"])
+    state, loss = search.step(state, (x, y), (x, y))
+    a1, w1 = split_arch(state["params"])
+    assert np.isfinite(float(loss))
+    # arch Adam step moved alphas; weight SGD step moved weights
+    assert not np.allclose(np.asarray(a0["alphas_normal"]),
+                           np.asarray(a1["alphas_normal"]))
+    moved = jax.tree.leaves(jax.tree.map(
+        lambda p, q: float(jnp.max(jnp.abs(p - q))), w0, w1))
+    assert max(moved) > 0
+
+
+def test_arch_grads_unrolled_vs_regularized(search_setup):
+    net, x, params = search_setup
+    y = jnp.array([0, 2])
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        logits = net.apply({"params": p}, bx, train=True)
+        lab = jax.nn.one_hot(by, 10)
+        return jnp.mean(-jnp.sum(lab * jax.nn.log_softmax(logits), -1))
+
+    g_u = arch_grad_unrolled(loss_fn, params, (x, y), (x, y), eta=0.025)
+    g_r = arch_grad_regularized(loss_fn, params, (x, y), (x, y))
+    for g in (g_u, g_r):
+        assert set(g) == {"alphas_normal", "alphas_reduce"}
+        assert all(np.all(np.isfinite(np.asarray(v))) for v in g.values())
+    # the unrolled (2nd-order) gradient differs from the 1st-order one
+    assert not np.allclose(np.asarray(g_u["alphas_normal"]),
+                           np.asarray(g_r["alphas_normal"]))
+
+
+def test_derive_genotype_semantics(search_setup):
+    _, _, params = search_setup
+    geno = derive_genotype(params["alphas_normal"], params["alphas_reduce"],
+                           steps=2, multiplier=2)
+    # 2 edges per node x 2 nodes, never 'none', indices point at valid
+    # predecessor states (model_search.py:266-283)
+    for gene in (geno.normal, geno.reduce):
+        assert len(gene) == 4
+        for pos, (op, idx) in enumerate(gene):
+            assert op in PRIMITIVES and op != "none"
+            assert 0 <= idx < 2 + pos // 2
+    assert list(geno.normal_concat) == [2, 3]
+
+
+def test_gdas_gumbel_hard_mixture(search_setup):
+    _, x, _ = search_setup
+    net = _tiny_net(gumbel=True)
+    params = net.init({"params": jax.random.key(3),
+                       "gumbel": jax.random.key(4)}, x, train=False)["params"]
+    logits = net.apply({"params": params}, x, train=True, tau=0.5,
+                       rngs={"gumbel": jax.random.key(5)})
+    assert logits.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
+    # eval path is deterministic (argmax one-hot, no rng needed)
+    e1 = net.apply({"params": params}, x, train=False)
+    e2 = net.apply({"params": params}, x, train=False)
+    assert np.allclose(np.asarray(e1), np.asarray(e2))
+
+
+def test_fixed_network_from_genotype_with_aux():
+    net = DartsNetwork(genotype=DARTS_V2, c=4, num_classes=10, layers=3,
+                       auxiliary=True)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    variables = net.init({"params": jax.random.key(1),
+                          "droppath": jax.random.key(2)}, x, train=False)
+    logits, aux = net.apply(variables, x, train=True, drop_path_prob=0.2,
+                            rngs={"droppath": jax.random.key(3)},
+                            mutable=["batch_stats"])[0]
+    assert logits.shape == (2, 10)
+    assert aux is not None and aux.shape == (2, 10)
+    # eval mode: running stats consumed, no aux head
+    logits_e, aux_e = net.apply(variables, x, train=False)
+    assert logits_e.shape == (2, 10) and aux_e is None
+
+
+def test_meta_models():
+    model = CNNCifarMeta(num_classes=10)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    params = model.init(jax.random.key(1), x)["params"]
+    masks = CNNCifarMeta.init_masks(jax.random.key(2), params,
+                                    dense_ratio=0.2)
+    assert set(masks) == {"meta_conv1", "meta_conv2", "meta_fc1"}
+    for name, m in masks.items():
+        n = m.size
+        assert int(np.asarray(m).sum()) == int(0.2 * n)  # exact density
+    dense = model.apply({"params": params}, x)
+    sparse = model.apply({"params": params}, x, masks=masks)
+    assert dense.shape == sparse.shape == (2, 10)
+    assert not np.allclose(np.asarray(dense), np.asarray(sparse))
+
+    # hypernetwork: mask -> weight tensor of the same shape
+    hyper = MetaNet()
+    m = masks["meta_conv1"]
+    hp = hyper.init(jax.random.key(3), m)
+    w = hyper.apply(hp, m)
+    assert w.shape == m.shape
+    assert np.all(np.isfinite(np.asarray(w)))
