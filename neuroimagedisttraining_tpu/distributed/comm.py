@@ -65,7 +65,42 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
     return buf
 
 
-class SocketCommManager(BaseCommManager):
+class QueueDispatchMixin:
+    """Shared receive-side machinery for every transport: observer list,
+    blocking message queue, sentinel shutdown. Subclasses feed the queue
+    from their listener thread via ``_enqueue`` and call ``_stop_dispatch``
+    on teardown."""
+
+    _STOP = object()
+
+    def _init_dispatch(self) -> None:
+        self._observers: list[Observer] = []
+        self._q: queue.Queue = queue.Queue()
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def _enqueue(self, msg: Message) -> None:
+        self._q.put(msg)
+
+    def handle_receive_message(self) -> None:
+        """Blocking dispatch loop (the reference polls with a 0.3 s sleep,
+        mpi/com_manager.py:71-79; a blocking queue needs no sleep)."""
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+            for obs in list(self._observers):
+                obs.receive_message(item.msg_type, item)
+
+    def _stop_dispatch(self) -> None:
+        self._q.put(self._STOP)
+
+
+class SocketCommManager(QueueDispatchMixin, BaseCommManager):
     """Point-to-point TCP manager for one rank.
 
     Every rank listens on ``base_port + rank``; ``send_message`` opens a
@@ -73,8 +108,6 @@ class SocketCommManager(BaseCommManager):
     length-prefixed frame. ``handle_receive_message`` blocks dispatching
     queued messages to observers until ``stop_receive_message``.
     """
-
-    _STOP = object()
 
     def __init__(self, rank: int, world_size: int,
                  host_map: dict[int, str] | None = None,
@@ -84,8 +117,7 @@ class SocketCommManager(BaseCommManager):
         self.base_port = base_port
         self.host_map = host_map or {r: "127.0.0.1"
                                      for r in range(world_size)}
-        self._observers: list[Observer] = []
-        self._q: queue.Queue = queue.Queue()
+        self._init_dispatch()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind(("0.0.0.0", base_port + rank))
@@ -117,32 +149,16 @@ class SocketCommManager(BaseCommManager):
                     raw = _recv_exact(conn, length)
                     if raw is None:
                         continue
-                self._q.put(Message.from_bytes(raw))
+                self._enqueue(Message.from_bytes(raw))
             except Exception as e:  # noqa: BLE001 — any bad peer data
                 # (wrong schema -> TypeError/KeyError, msgpack OutOfData,
                 # RST -> OSError) must not kill the only listener thread
                 log.warning("rank %d: dropped malformed/aborted frame: %s",
                             self.rank, e)
 
-    def add_observer(self, observer: Observer) -> None:
-        self._observers.append(observer)
-
-    def remove_observer(self, observer: Observer) -> None:
-        self._observers.remove(observer)
-
-    def handle_receive_message(self) -> None:
-        """Blocking dispatch loop (the reference polls with a 0.3 s sleep,
-        mpi/com_manager.py:71-79; a blocking queue needs no sleep)."""
-        while True:
-            item = self._q.get()
-            if item is self._STOP:
-                return
-            for obs in list(self._observers):
-                obs.receive_message(item.msg_type, item)
-
     def stop_receive_message(self) -> None:
         self._running = False
-        self._q.put(self._STOP)
+        self._stop_dispatch()
         try:
             self._server.close()
         except OSError:

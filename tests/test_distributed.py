@@ -231,3 +231,90 @@ def test_cross_silo_multiprocess_smoke():
     np.testing.assert_allclose(server.params["w"],
                                np.full(2, 5.0 / 3.0, np.float32), rtol=1e-6)
     time.sleep(0.1)
+
+
+def test_broker_pubsub_transport():
+    """Broker pub/sub transport with the reference's MQTT topic scheme
+    (mqtt_comm_manager.py:47-117): server(0) <-> 2 clients through one
+    fan-out broker; tensors survive the round trip."""
+    from neuroimagedisttraining_tpu.distributed.broker import (
+        BrokerCommManager, MessageBroker,
+    )
+
+    broker = MessageBroker()
+    mgrs = {cid: BrokerCommManager("127.0.0.1", broker.port,
+                                   client_id=cid, client_num=2)
+            for cid in (0, 1, 2)}
+    got: dict[int, list] = {0: [], 1: [], 2: []}
+
+    class Rec:
+        def __init__(self, cid):
+            self.cid = cid
+
+        def receive_message(self, msg_type, msg):
+            got[self.cid].append((msg_type, msg))
+            mgrs[self.cid].stop_receive_message()
+
+    threads = {}
+    for cid, mgr in mgrs.items():
+        mgr.add_observer(Rec(cid))
+        threads[cid] = threading.Thread(target=mgr.handle_receive_message,
+                                        daemon=True)
+        threads[cid].start()
+    time.sleep(0.2)  # let SUB frames land before publishing
+
+    # server -> each client; clients -> server
+    for cid in (1, 2):
+        msg = M.Message(M.MSG_TYPE_S2C_SYNC_MODEL, 0, cid)
+        msg.add(M.ARG_MODEL_PARAMS, {"w": np.full((3,), cid, np.float32)})
+        mgrs[0].send_message(msg)
+    up = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    up.add(M.ARG_MODEL_PARAMS, {"w": np.ones((3,), np.float32)})
+    mgrs[1].send_message(up)
+
+    deadline = time.time() + 20
+    while time.time() < deadline and not (got[0] and got[1] and got[2]):
+        time.sleep(0.05)
+    assert got[1] and got[2] and got[0], got
+    t, m = got[2][0]
+    assert t == M.MSG_TYPE_S2C_SYNC_MODEL
+    np.testing.assert_array_equal(m.get(M.ARG_MODEL_PARAMS)["w"],
+                                  np.full((3,), 2, np.float32))
+    assert got[0][0][0] == M.MSG_TYPE_C2S_SEND_MODEL
+    for mgr in mgrs.values():
+        mgr.stop_receive_message()
+    broker.stop()
+
+
+def test_broker_retains_for_late_subscriber():
+    """MQTT-retain semantics: a PUB that lands before the receiver's SUB is
+    delivered at subscribe time instead of being lost (otherwise a blind
+    broadcast races the SUB frame and deadlocks the protocol)."""
+    from neuroimagedisttraining_tpu.distributed.broker import (
+        BrokerCommManager, MessageBroker,
+    )
+
+    broker = MessageBroker()
+    srv = BrokerCommManager("127.0.0.1", broker.port, client_id=0,
+                            client_num=1)
+    msg = M.Message(M.MSG_TYPE_S2C_SYNC_MODEL, 0, 1)
+    msg.add(M.ARG_ROUND_IDX, 42)
+    srv.send_message(msg)  # published before client exists
+    time.sleep(0.2)
+
+    got = []
+    cli = BrokerCommManager("127.0.0.1", broker.port, client_id=1,
+                            client_num=1)
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+            cli.stop_receive_message()
+
+    cli.add_observer(Obs())
+    t = threading.Thread(target=cli.handle_receive_message, daemon=True)
+    t.start()
+    t.join(timeout=20)
+    assert got and got[0].get(M.ARG_ROUND_IDX) == 42
+    srv.stop_receive_message()
+    broker.stop()
