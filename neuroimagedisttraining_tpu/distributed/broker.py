@@ -185,6 +185,9 @@ class BrokerCommManager(QueueDispatchMixin, BaseCommManager):
         self._topic = topic
         self._init_dispatch()
         self._conn = socket.create_connection((host, port), timeout=30.0)
+        # the 30 s budget is for CONNECT only — an idle subscription must
+        # block in recv indefinitely, not time out and kill the reader
+        self._conn.settimeout(None)
         self._send_lock = threading.Lock()
         if client_id == 0:  # server: one inbound topic per client
             for cid in range(1, client_num + 1):

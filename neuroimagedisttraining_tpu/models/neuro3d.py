@@ -17,6 +17,7 @@ floor_mode MaxPool3d/AvgPool3d.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Sequence
 
 import flax.linen as nn
@@ -30,8 +31,38 @@ def _pool(x, kind: str, k: int, s: int, pad: int = 0):
     strides = (1, s, s, s, 1)
     padding = [(0, 0)] + [(pad, pad)] * 3 + [(0, 0)]
     if kind == "max":
+        if s == k and pad == 0 and os.environ.get("NIDT_FAST_POOL") == "1":
+            # opt-in scatter-free backward for the reference's
+            # non-overlapping pools: ~4% faster step but carries extra
+            # residual memory — see ops/pooling.py for the measured
+            # trade-off and why it is not the default
+            from neuroimagedisttraining_tpu.ops.pooling import (
+                max_pool_3d_nonoverlap,
+            )
+
+            return max_pool_3d_nonoverlap(x, k)
         return nn.max_pool(x, dims[1:4], strides=strides[1:4], padding=padding[1:4])
     return nn.avg_pool(x, dims[1:4], strides=strides[1:4], padding=padding[1:4])
+
+
+class _StemConv(nn.Module):
+    """Drop-in for the stem ``nn.Conv`` (same "conv" param tree: kernel +
+    bias) routing through ``ops.stemconv.stem_conv3d`` — the custom
+    weight-gradient path. Constructed only when ``NIDT_FAST_STEM=1``."""
+
+    features: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from neuroimagedisttraining_tpu.ops.stemconv import stem_conv3d
+
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (5, 5, 5, 1, self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,),
+                          jnp.float32)
+        y = stem_conv3d(x.astype(self.dtype), kernel.astype(self.dtype))
+        return y + bias.astype(self.dtype)
 
 
 class ConvBNReLU3D(nn.Module):
@@ -50,9 +81,18 @@ class ConvBNReLU3D(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(self.features, (self.kernel,) * 3, strides=(self.stride,) * 3,
-                    padding=[(self.pad, self.pad)] * 3, dtype=self.dtype,
-                    name="conv")(x)
+        fast_stem = (os.environ.get("NIDT_FAST_STEM") == "1"
+                     and self.kernel == 5 and self.stride == 2
+                     and self.pad == 0 and x.shape[-1] == 1)
+        if fast_stem:
+            # opt-in Pallas weight-gradient for the C_in=1 stride-2 stem
+            # (ops/stemconv.py); same param tree as nn.Conv ("conv")
+            x = _StemConv(self.features, dtype=self.dtype, name="conv")(x)
+        else:
+            x = nn.Conv(self.features, (self.kernel,) * 3,
+                        strides=(self.stride,) * 3,
+                        padding=[(self.pad, self.pad)] * 3, dtype=self.dtype,
+                        name="conv")(x)
         if self.norm == "group":
             x = nn.GroupNorm(num_groups=min(32, self.features),
                              dtype=self.dtype, name="gn")(x)

@@ -1,0 +1,83 @@
+"""Scatter-free non-overlapping 3D max pool (opt-in).
+
+The reference's 3D CNNs pool with kernel 3 / stride 3 — NON-overlapping
+windows (salient_models.py:150-168 ``nn.MaxPool3d(kernel_size=3,
+stride=3)``). XLA's generic max-pool gradient is ``SelectAndScatter``
+(a serial scatter on TPU). For disjoint windows the gradient has a
+closed form with no scatter:
+
+    dx = (x == upsample(max)) * upsample(g)
+
+Measured on the harness TPU (PROFILE.md round 2): ~4% faster full train
+step (41.7 -> 39.9 ms at b16) — but it carries the pooled outputs as
+VJP residuals plus an upsample temporary, and the flagship 4-client b16
+no-remat federation packs HBM to within ~50 MB of capacity, where that
+overhead tips it OOM. The model zoo therefore keeps XLA's max-pool by
+DEFAULT; enable this op per-process via ``NIDT_FAST_POOL=1`` for
+layouts with headroom (1-client-per-core mesh layout, smaller batch, or
+remat="stem").
+
+Tie semantics: the window's gradient is split EQUALLY across all
+elements tied at the max (torch routes it all to the first argmax; XLA's
+SelectAndScatter to one winner). Ties are common here — these pools
+consume post-ReLU bf16 activations where whole windows of 0.0 tie — so
+the equal split conserves the window's gradient mass exactly instead of
+inflating it up to k^3-fold; on tie-free inputs all three rules agree
+(pinned by tests/test_ops.py against the XLA reference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def max_pool_3d_nonoverlap(x: jax.Array, k: int) -> jax.Array:
+    """kernel=k, stride=k, VALID — torch ``MaxPool3d(k, stride=k)``."""
+    return jax.lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min,
+        jax.lax.max, (1, k, k, k, 1), (1, k, k, k, 1), "VALID")
+
+
+def _fwd(x, k):
+    y = max_pool_3d_nonoverlap(x, k)
+    return y, (x, y)
+
+
+def _upsample_nn(y: jax.Array, k: int, out_spatial: tuple[int, int, int]
+                 ) -> jax.Array:
+    """Nearest-neighbor upsample of NDHWC ``y`` by factor ``k``,
+    zero-padded to ``out_spatial`` (tail voxels beyond the last full
+    window belong to no window)."""
+    n, d, h, w, c = y.shape
+    y = jnp.broadcast_to(y[:, :, None, :, None, :, None, :],
+                         (n, d, k, h, k, w, k, c))
+    y = y.reshape(n, d * k, h * k, w * k, c)
+    pd, ph, pw = (out_spatial[0] - d * k, out_spatial[1] - h * k,
+                  out_spatial[2] - w * k)
+    if pd or ph or pw:
+        y = jnp.pad(y, [(0, 0), (0, pd), (0, ph), (0, pw), (0, 0)])
+    return y
+
+
+def _bwd(k, res, g):
+    x, y = res
+    spatial = x.shape[1:4]
+    yb = _upsample_nn(y, k, spatial)
+    mask = (x == yb).astype(g.dtype)
+    # equal-split across ties: post-ReLU bf16 activations tie at the max
+    # routinely (whole windows of 0.0), where routing the FULL gradient
+    # to every tie would inflate dx up to k^3-fold vs the reference's
+    # single-argmax routing — dividing by the tie count conserves the
+    # window's gradient mass exactly
+    cnt = jax.lax.reduce_window(mask, 0.0, jax.lax.add,
+                                (1, k, k, k, 1), (1, k, k, k, 1), "VALID")
+    gb = _upsample_nn(g / jnp.maximum(cnt, 1.0), k, spatial)
+    return (mask * gb,)
+
+
+max_pool_3d_nonoverlap.defvjp(_fwd, _bwd)

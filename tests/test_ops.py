@@ -182,3 +182,92 @@ def test_kth_largest_rejects_bad_nbins():
     x = jnp.arange(512, dtype=jnp.float32)
     with pytest.raises(AssertionError):
         kth_largest(x, 5, nbins=100)
+
+
+def test_fast_maxpool_matches_xla_fwd_and_bwd():
+    """ops/pooling.py scatter-free non-overlapping max-pool backward ==
+    XLA SelectAndScatter reference, fwd bitwise + bwd to f32 tolerance
+    (ties are measure-zero on continuous inputs; see module docstring)."""
+    import flax.linen as nn
+
+    from neuroimagedisttraining_tpu.ops.pooling import max_pool_3d_nonoverlap
+
+    x = jax.random.normal(jax.random.key(7), (2, 7, 9, 7, 3))
+    np.testing.assert_array_equal(
+        np.asarray(max_pool_3d_nonoverlap(x, 3)),
+        np.asarray(nn.max_pool(x, (3, 3, 3), (3, 3, 3), "VALID")))
+
+    def loss(pool):
+        return lambda x: jnp.sum(pool(x) ** 2)
+
+    g_fast = jax.grad(loss(lambda x: max_pool_3d_nonoverlap(x, 3)))(x)
+    g_ref = jax.grad(loss(
+        lambda x: nn.max_pool(x, (3, 3, 3), (3, 3, 3), "VALID")))(x)
+    np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_ref),
+                               atol=1e-6)
+
+
+def test_stemconv_pallas_dw_matches_xla():
+    """ops/stemconv.py split-K weight-gradient == XLA kernel-grad
+    (interpret mode exercises the real kernel grid incl. the ragged-K
+    tail; shapes sized so R > one 8192 block)."""
+    from neuroimagedisttraining_tpu.ops import stemconv as SC
+
+    kx, kg = jax.random.split(jax.random.key(3))
+    x = jax.random.normal(kx, (4, 29, 31, 29, 1), jnp.float32)
+    w = jax.random.normal(kg, (5, 5, 5, 1, 64), jnp.float32)
+    g = jax.random.normal(jax.random.key(4), SC._conv(x, w).shape,
+                          jnp.float32)
+    dw_ref = np.asarray(SC._dw_reference(x, g))
+    dw_pal = np.asarray(SC._dw_pallas(x, g, interpret=True))
+    err = np.max(np.abs(dw_pal - dw_ref)) / np.max(np.abs(dw_ref))
+    assert err < 2e-2, err  # bf16 products, f32 accumulation
+
+
+def test_stemconv_custom_vjp_grads(monkeypatch):
+    """stem_conv3d's custom VJP returns the same (dx, dw) as plain XLA
+    autodiff (the CPU fallback path IS autodiff for dw; dx always the
+    transposed conv), and the NIDT_FAST_STEM=1 module keeps the nn.Conv
+    param tree."""
+    from neuroimagedisttraining_tpu.models.neuro3d import ConvBNReLU3D
+    from neuroimagedisttraining_tpu.ops import stemconv as SC
+
+    kx, kw = jax.random.split(jax.random.key(5))
+    x = jax.random.normal(kx, (2, 13, 15, 13, 1), jnp.float32)
+    w = jax.random.normal(kw, (5, 5, 5, 1, 8), jnp.float32)
+
+    def loss(f):
+        return lambda x, w: jnp.sum(f(x, w) ** 2)
+
+    gx, gw = jax.grad(loss(SC.stem_conv3d), argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss(SC._conv), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), atol=1e-4)
+
+    blk = ConvBNReLU3D(features=8, kernel=5, stride=2, pad=0)
+    monkeypatch.setenv("NIDT_FAST_STEM", "1")
+    params = blk.init(jax.random.key(6), x, train=False)
+    assert set(params["params"]["conv"]) == {"kernel", "bias"}
+    out_fast = blk.apply(params, x, train=False)  # env read at apply time
+    monkeypatch.delenv("NIDT_FAST_STEM")
+    out_ref = blk.apply(params, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_fast), np.asarray(out_ref),
+                               atol=1e-5)
+
+
+def test_fast_maxpool_tie_gradient_is_conserved():
+    """Equal-split tie rule: a window of identical values (the post-ReLU
+    all-zeros case) distributes the window's gradient, conserving total
+    mass — sum(dx) == sum(g) regardless of tie count."""
+    from neuroimagedisttraining_tpu.ops.pooling import max_pool_3d_nonoverlap
+
+    x = jnp.zeros((1, 6, 6, 6, 2))  # every 3x3x3 window fully tied
+    g = jax.grad(lambda x: jnp.sum(max_pool_3d_nonoverlap(x, 3) *
+                                   jnp.arange(16.0).reshape(1, 2, 2, 2, 2)))(x)
+    np.testing.assert_allclose(float(jnp.sum(g)), float(jnp.sum(jnp.arange(16.0))),
+                               rtol=1e-6)
+    # each element of a fully-tied window gets 1/27 of that window's grad
+    np.testing.assert_allclose(np.asarray(g[0, :3, :3, :3, 0]),
+                               np.full((3, 3, 3), 0.0), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(g[0, :3, :3, :3, 1]),
+                               np.full((3, 3, 3), 1.0 / 27), rtol=1e-6)
