@@ -185,9 +185,13 @@ class BrokerCommManager(QueueDispatchMixin, BaseCommManager):
         self._topic = topic
         self._init_dispatch()
         self._conn = socket.create_connection((host, port), timeout=30.0)
-        # the 30 s budget is for CONNECT only — an idle subscription must
-        # block in recv indefinitely, not time out and kill the reader
+        # receives must block indefinitely (an idle subscription is normal
+        # — clearing the connect timeout keeps the reader alive), but sends
+        # stay bounded via SO_SNDTIMEO so a wedged broker (full TCP buffer)
+        # surfaces an error instead of deadlocking publishers on _send_lock
         self._conn.settimeout(None)
+        self._conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                              struct.pack("ll", 30, 0))
         self._send_lock = threading.Lock()
         if client_id == 0:  # server: one inbound topic per client
             for cid in range(1, client_num + 1):

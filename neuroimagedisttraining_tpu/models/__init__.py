@@ -51,6 +51,7 @@ from neuroimagedisttraining_tpu.models.darts import (  # noqa: F401
     DartsNetwork,
     DartsSearch,
     DartsSearchNet,
+    DartsTrainer,
     FedNAS_V1,
     Genotype,
     PRIMITIVES,

@@ -496,9 +496,14 @@ class FixedCell(nn.Module):
                 h = _Op(name, self.c, stride, **kw)(states[idx], train)
                 # drop-path exempts only true Identity edges (model.py:52-57)
                 # — a stride-2 skip_connect is a FactorizedReduce and IS
-                # drop-pathed by the reference
+                # drop-pathed by the reference. ``drop_prob`` may be a traced
+                # scalar (the per-epoch schedule runs inside jit): gate on
+                # static facts only, but a STATIC 0.0 skips the rng entirely
+                # so plain train-mode applies need no "droppath" stream.
                 is_identity = name == "skip_connect" and stride == 1
-                if train and drop_prob > 0 and not is_identity:
+                static_zero = isinstance(drop_prob, (int, float)) \
+                    and drop_prob == 0.0
+                if train and not is_identity and not static_zero:
                     h = _drop_path(h, self.make_rng("droppath"), drop_prob)
                 hs.append(h)
             states.append(hs[0] + hs[1])
@@ -630,6 +635,21 @@ def arch_grad_regularized(loss_fn, params: dict, train_batch, val_batch,
                         g_val, g_tr)
 
 
+def _sgd_momentum_chain(lr: float, total_steps: int, momentum: float,
+                        weight_decay: float, grad_clip: float,
+                        alpha: float = 0.0):
+    """The reference's weight optimizer (train_search.py:24-45 /
+    train.py): clip -> L2 -> momentum -> cosine-annealed SGD scale."""
+    import optax
+
+    sched = optax.cosine_decay_schedule(lr, total_steps, alpha=alpha)
+    return sched, optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.add_decayed_weights(weight_decay),
+        optax.trace(decay=momentum, nesterov=False),
+        optax.scale_by_schedule(lambda s: -sched(s)))
+
+
 class DartsSearch:
     """Compact bilevel search driver (train_search.py:240-284 semantics):
     per batch, one architect Adam step on (alphas | val batch) then one
@@ -656,13 +676,9 @@ class DartsSearch:
         self.unrolled = unrolled
         self.momentum = momentum
         self.weight_decay = weight_decay
-        self.lr_sched = optax.cosine_decay_schedule(
-            lr, total_steps, alpha=lr_min / lr)
-        self.w_opt = optax.chain(
-            optax.clip_by_global_norm(grad_clip),
-            optax.add_decayed_weights(weight_decay),
-            optax.trace(decay=momentum, nesterov=False),
-            optax.scale_by_schedule(lambda s: -self.lr_sched(s)))
+        self.lr_sched, self.w_opt = _sgd_momentum_chain(
+            lr, total_steps, momentum, weight_decay, grad_clip,
+            alpha=lr_min / lr)
         self.a_opt = optax.chain(
             optax.add_decayed_weights(arch_weight_decay),
             optax.scale_by_adam(b1=0.5, b2=0.999),
@@ -716,3 +732,69 @@ class DartsSearch:
         arch, _ = split_arch(state["params"])
         return derive_genotype(arch["alphas_normal"], arch["alphas_reduce"],
                                self.net.steps, self.net.multiplier)
+
+
+class DartsTrainer:
+    """Evaluation-phase trainer for a fixed-genotype ``DartsNetwork``
+    (train.py:80-238 semantics): cross-entropy + ``aux_weight`` x auxiliary
+    loss (0.4, train.py:196), global-norm grad clip 5, SGD momentum 0.9
+    wd 3e-4, cosine-annealed lr, and drop-path probability scaled linearly
+    over training (train.py:180: ``drop_path_prob * epoch / epochs``)."""
+
+    def __init__(self, net: DartsNetwork, num_classes: int,
+                 lr: float = 0.025, momentum: float = 0.9,
+                 weight_decay: float = 3e-4, grad_clip: float = 5.0,
+                 aux_weight: float = 0.4, drop_path_prob: float = 0.2,
+                 total_steps: int = 1000):
+        import optax
+
+        self.net = net
+        self.num_classes = num_classes
+        self.aux_weight = aux_weight
+        self.drop_path_prob = drop_path_prob
+        self.total_steps = total_steps
+        _, self.opt = _sgd_momentum_chain(lr, total_steps, momentum,
+                                          weight_decay, grad_clip)
+        self._step = jax.jit(self._step_impl)
+
+    def init(self, rng, sample_input):
+        variables = self.net.init(
+            {"params": rng, "droppath": jax.random.fold_in(rng, 1)},
+            sample_input, train=False)
+        return {"variables": variables,
+                "opt": self.opt.init(variables["params"]),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _step_impl(self, state, batch, rng):
+        from neuroimagedisttraining_tpu.core.losses import softmax_ce
+
+        x, y = batch
+        variables = state["variables"]
+        # linear schedule, clamped: stepping past total_steps must not push
+        # the drop probability beyond the configured max (keep_prob -> 0
+        # would NaN the activations)
+        frac = jnp.minimum(
+            state["step"].astype(jnp.float32) / self.total_steps, 1.0)
+        dpp = self.drop_path_prob * frac
+
+        def loss_fn(params):
+            out, mutated = self.net.apply(
+                {**variables, "params": params}, x, train=True,
+                drop_path_prob=dpp, rngs={"droppath": rng},
+                mutable=["batch_stats"])
+            logits, aux = out
+            loss = softmax_ce(logits, y)
+            if aux is not None:
+                loss = loss + self.aux_weight * softmax_ce(aux, y)
+            return loss, mutated["batch_stats"]
+
+        (loss, bstats), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            variables["params"])
+        up, opt = self.opt.update(g, state["opt"], variables["params"])
+        params = jax.tree.map(lambda p, u: p + u, variables["params"], up)
+        return {"variables": {"params": params, "batch_stats": bstats},
+                "opt": opt, "step": state["step"] + 1}, loss
+
+    def step(self, state, batch, rng):
+        """One jitted training step; returns (new_state, loss)."""
+        return self._step(state, batch, rng)

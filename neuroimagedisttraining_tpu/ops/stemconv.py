@@ -40,7 +40,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 _DN = ("NDHWC", "DHWIO", "NDHWC")

@@ -153,3 +153,23 @@ def test_meta_models():
     w = hyper.apply(hp, m)
     assert w.shape == m.shape
     assert np.all(np.isfinite(np.asarray(w)))
+
+
+def test_darts_trainer_step():
+    """DartsTrainer (train.py semantics): aux-weighted loss, scheduled
+    drop-path inside one jitted step; loss finite, params move, batch
+    stats update."""
+    from neuroimagedisttraining_tpu.models.darts import DartsTrainer
+
+    net = DartsNetwork(genotype=DARTS_V2, c=4, num_classes=10, layers=3,
+                       auxiliary=True)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    y = jnp.array([1, 7])
+    tr = DartsTrainer(net, num_classes=10, total_steps=4)
+    state = tr.init(jax.random.key(1), x)
+    p0 = jax.tree.leaves(state["variables"]["params"])[0]
+    state, loss = tr.step(state, (x, y), jax.random.key(2))
+    assert np.isfinite(float(loss))
+    p1 = jax.tree.leaves(state["variables"]["params"])[0]
+    assert not np.allclose(np.asarray(p0), np.asarray(p1))
+    assert int(state["step"]) == 1
