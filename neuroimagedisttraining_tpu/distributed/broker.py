@@ -233,7 +233,17 @@ class BrokerCommManager(QueueDispatchMixin, BaseCommManager):
         else:
             t = f"{self._topic}{self.client_id}"
         with self._send_lock:
-            _write_frame(self._conn, _OP_PUB, t, msg.to_bytes())
+            try:
+                _write_frame(self._conn, _OP_PUB, t, msg.to_bytes())
+            except OSError:
+                # a failed/timed-out sendall may have written a PARTIAL
+                # frame — the stream is desynced and must not be reused;
+                # closing also stops the reader, which unblocks dispatch
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                raise
 
     def stop_receive_message(self) -> None:
         self._stop_dispatch()

@@ -501,8 +501,10 @@ class FixedCell(nn.Module):
                 # static facts only, but a STATIC 0.0 skips the rng entirely
                 # so plain train-mode applies need no "droppath" stream.
                 is_identity = name == "skip_connect" and stride == 1
-                static_zero = isinstance(drop_prob, (int, float)) \
-                    and drop_prob == 0.0
+                # concrete zero (Python scalar OR un-traced array) skips the
+                # rng; only a genuinely traced schedule pays drop-path at 0
+                static_zero = (not isinstance(drop_prob, jax.core.Tracer)
+                               and float(drop_prob) == 0.0)
                 if train and not is_identity and not static_zero:
                     h = _drop_path(h, self.make_rng("droppath"), drop_prob)
                 hs.append(h)

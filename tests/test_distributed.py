@@ -252,8 +252,9 @@ def test_broker_pubsub_transport():
             self.cid = cid
 
         def receive_message(self, msg_type, msg):
+            # record only — stopping here would close the manager's socket
+            # while the main thread may still be sending through it
             got[self.cid].append((msg_type, msg))
-            mgrs[self.cid].stop_receive_message()
 
     threads = {}
     for cid, mgr in mgrs.items():

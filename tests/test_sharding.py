@@ -80,3 +80,41 @@ def test_trainer_sampling_with_replacement_documented(tmp_path,
     assert len(seen) == my_steps * b
     # (statistical) replacement implies duplicates across an epoch draw
     assert len(set(seen)) < len(seen)
+
+
+def test_two_level_aggregation_matches_flat_and_bounds_byzantine_silo():
+    """parallel/hierarchical.py: silo-local (ICI) then cross-silo (DCN)
+    weighted mean == the flat client mean; with norm_bound, a Byzantine
+    SILO's pull on the global params is bounded as a unit."""
+    from neuroimagedisttraining_tpu.parallel.hierarchical import (
+        make_two_level_mesh, silo_then_global_mean,
+    )
+    from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
+
+    mesh = make_two_level_mesh(2, 4)  # 2 silos x 4 cores on the 8-dev mesh
+    C = 16
+    rng = np.random.default_rng(0)
+    stacked = {"w": jnp.asarray(rng.normal(size=(C, 6, 5)), jnp.float32),
+               "b": jnp.asarray(rng.normal(size=(C, 5)), jnp.float32)}
+    weights = jnp.asarray(rng.uniform(1, 3, size=C), jnp.float32)
+
+    got = silo_then_global_mean(stacked, weights, mesh)
+    want = tree_weighted_mean(stacked, weights)
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-5)
+
+    # Byzantine silo: clients 8..15 (the whole second silo) send 100x
+    # params; silo-granular clipping bounds the silo aggregate
+    glob = {"w": jnp.zeros((6, 5)), "b": jnp.zeros((5,))}
+    poisoned = {k: v.at[8:].set(100.0) for k, v in stacked.items()}
+    clipped = silo_then_global_mean(poisoned, weights, mesh,
+                                    global_params=glob, norm_bound=1.0)
+    unclipped = silo_then_global_mean(poisoned, weights, mesh)
+    # each silo mean is pulled to within norm_bound of glob -> global mean
+    # norm <= 1.0; without clipping the poisoned silo dominates
+    norm_c = float(jnp.sqrt(sum(jnp.sum(v ** 2) for v in clipped.values())))
+    norm_u = float(jnp.sqrt(sum(jnp.sum(v ** 2)
+                                for v in unclipped.values())))
+    assert norm_c <= 1.0 + 1e-5
+    assert norm_u > 50.0
