@@ -112,6 +112,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--virtual_devices", type=int, default=0,
                         help="provision N virtual CPU devices (mesh "
                              "simulation without TPU hardware)")
+    parser.add_argument("--mesh_shape", type=int, nargs="*", default=[],
+                        help="device mesh layout: one value = first-N 1-D "
+                             "clients mesh; two values (silos cores) = "
+                             "two-level cross-silo mesh (silo aggregation "
+                             "on ICI, cross-silo on DCN)")
     parser.add_argument("--profile_dir", type=str, default="",
                         help="capture a jax.profiler trace of training "
                              "into this dir (TensorBoard-loadable)")
@@ -126,6 +131,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
     return ExperimentConfig(
         model=args.model, num_classes=args.num_classes,
         algorithm=args.algorithm, seed=args.seed, tag=args.tag,
+        mesh_shape=tuple(args.mesh_shape),
         data=DataConfig(
             dataset=args.dataset.lower(), data_dir=args.data_dir,
             partition_method=args.partition_method,
@@ -277,7 +283,7 @@ def main(argv: list[str] | None = None) -> int:
     mesh = None
     if not args.streaming:
         from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh()
+        mesh = make_mesh(shape=cfg.mesh_shape)
     engine = build_experiment(cfg, streaming=args.streaming, mesh=mesh)
     from neuroimagedisttraining_tpu.utils.profiling import (
         failure_context, profile_trace,

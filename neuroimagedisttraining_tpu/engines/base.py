@@ -230,6 +230,23 @@ class FederatedEngine:
         n = jnp.asarray(self._n_train_host[np.asarray(sampled)])
         return n.astype(jnp.float32)
 
+    def aggregate(self, stacked, weights: jax.Array):
+        """Weighted mean of a client-stacked pytree. On a two-level
+        (silos, clients) mesh (``--mesh_shape S C``) the reduction is
+        routed silo-first: ICI within each silo, ONE aggregate per silo
+        across DCN (parallel/hierarchical.py) — same result as the flat
+        mean, bandwidth-correct layout. Falls back to the flat mean when
+        the stacked axis doesn't tile the mesh (e.g. frac-sampled subsets
+        smaller than the device grid)."""
+        from neuroimagedisttraining_tpu.parallel.hierarchical import (
+            is_two_level, silo_then_global_mean,
+        )
+
+        n = jax.tree.leaves(stacked)[0].shape[0]
+        if is_two_level(self.mesh) and n % self.mesh.devices.size == 0:
+            return silo_then_global_mean(stacked, weights, self.mesh)
+        return pt.tree_weighted_mean(stacked, weights)
+
     # ---------- streamed evaluation (cohort > HBM) ----------
 
     def _eval_chunk_size(self) -> int:

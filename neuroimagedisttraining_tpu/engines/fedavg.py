@@ -67,8 +67,8 @@ class FedAvgEngine(FederatedEngine):
         client_params = robust.defend_stacked(
             cs.params, params, defense=f.defense_type,
             norm_bound=f.norm_bound, stddev=f.stddev, rngs=cs.rng)
-        new_params = pt.tree_weighted_mean(client_params, w)
-        new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+        new_params = self.aggregate(client_params, w)
+        new_bstats = self.aggregate(cs.batch_stats, w)
         mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
         return new_params, new_bstats, mean_loss
 

@@ -39,23 +39,23 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from neuroimagedisttraining_tpu.core.robust import norm_diff_clip
+from neuroimagedisttraining_tpu.parallel.mesh import CLIENT_AXIS, SILO_AXIS
 
 PyTree = Any
-
-SILO_AXIS = "silos"
-CLIENT_AXIS = "clients"
 
 
 def make_two_level_mesh(num_silos: int, clients_per_silo: int,
                         devices=None) -> Mesh:
     """2-D mesh [silos, clients]; on a real pod pass a devices array whose
     first axis groups devices by host so the silo axis maps onto DCN."""
-    if devices is None:
-        devices = jax.devices()
-    need = num_silos * clients_per_silo
-    assert len(devices) >= need, (len(devices), need)
-    grid = np.asarray(devices[:need]).reshape(num_silos, clients_per_silo)
-    return Mesh(grid, (SILO_AXIS, CLIENT_AXIS))
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=devices,
+                     shape=(num_silos, clients_per_silo))
+
+
+def is_two_level(mesh: Mesh | None) -> bool:
+    return mesh is not None and SILO_AXIS in mesh.axis_names
 
 
 def silo_then_global_mean(stacked: PyTree, weights: jax.Array, mesh: Mesh,

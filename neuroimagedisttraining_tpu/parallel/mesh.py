@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 PyTree = Any
 
 CLIENT_AXIS = "clients"
+SILO_AXIS = "silos"  # outer axis of a two-level (host, core) mesh
 
 
 def provision_virtual_devices(n: int) -> bool:
@@ -51,18 +52,39 @@ def provision_virtual_devices(n: int) -> bool:
 
 
 def make_mesh(num_devices: int | None = None, devices=None,
-              axis_name: str = CLIENT_AXIS) -> Mesh:
-    """1-D mesh over all (or the first N) visible devices."""
+              axis_name: str = CLIENT_AXIS,
+              shape: tuple[int, ...] = ()) -> Mesh:
+    """1-D mesh over all (or the first N) visible devices; a 2-entry
+    ``shape`` (e.g. ``--mesh_shape 2 4``) builds the two-level
+    ``(silos, clients)`` mesh instead — silo reductions ride ICI, cross-
+    silo traffic rides DCN (parallel/hierarchical.py)."""
     if devices is None:
         devices = jax.devices()
+    if shape and (len(shape) > 2 or any(s < 1 for s in shape)):
+        raise ValueError(
+            f"--mesh_shape must be 1 or 2 positive integers, got {shape}")
+    if len(shape) == 2:
+        need = shape[0] * shape[1]
+        if len(devices) < need:
+            raise ValueError(
+                f"--mesh_shape {shape} needs {need} devices, "
+                f"have {len(devices)}")
+        grid = np.asarray(devices[:need]).reshape(shape)
+        return Mesh(grid, (SILO_AXIS, CLIENT_AXIS))
+    if shape:
+        num_devices = shape[0]
     if num_devices is not None:
+        if len(devices) < num_devices:
+            raise ValueError(
+                f"mesh needs {num_devices} devices, have {len(devices)}")
         devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (axis_name,))
 
 
 def client_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading axis sharded over clients, rest replicated."""
-    return NamedSharding(mesh, P(CLIENT_AXIS))
+    """Leading (client) axis sharded over EVERY mesh axis — on a two-level
+    mesh clients split across silos x cores — rest replicated."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

@@ -118,3 +118,67 @@ def test_two_level_aggregation_matches_flat_and_bounds_byzantine_silo():
                                 for v in unclipped.values())))
     assert norm_c <= 1.0 + 1e-5
     assert norm_u > 50.0
+
+
+def test_mesh_shape_two_level_cli_layout():
+    """--mesh_shape 2 4 semantics: make_mesh builds the (silos, clients)
+    mesh and client_sharding splits the leading axis over BOTH axes."""
+    from neuroimagedisttraining_tpu.parallel.mesh import (
+        client_sharding, make_mesh,
+    )
+
+    mesh = make_mesh(shape=(2, 4))
+    assert mesh.axis_names == ("silos", "clients")
+    assert mesh.devices.shape == (2, 4)
+    x = jnp.arange(16 * 3, dtype=jnp.float32).reshape(16, 3)
+    xs = jax.device_put(x, client_sharding(mesh))
+    # 16 clients over 8 devices -> 2 clients per device shard
+    assert xs.sharding.shard_shape(x.shape) == (2, 3)
+
+
+def test_fedavg_round_identical_on_flat_and_two_level_mesh():
+    """--mesh_shape routing: the fedavg round program on a (2,4) silo mesh
+    produces the same aggregate as on the flat 8-device clients mesh."""
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import federate_cohort
+    from neuroimagedisttraining_tpu.data.synthetic import generate_synthetic_abcd
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    cohort = generate_synthetic_abcd(num_subjects=32, shape=(12, 14, 12),
+                                     num_sites=8, seed=0)
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm="fedavg",
+        data=DataConfig(dataset="synthetic", partition_method="site"),
+        optim=OptimConfig(lr=1e-2, batch_size=4, epochs=1),
+        fed=FedConfig(client_num_in_total=8, comm_round=1),
+        log_dir="/tmp/nidt_2l")
+    log = ExperimentLogger("/tmp/nidt_2l", "synthetic", cfg.identity(),
+                           console=False)
+
+    outs = []
+    for shape in ((), (2, 4)):
+        mesh = make_mesh(shape=shape)
+        fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh)
+        trainer = LocalTrainer(create_model("3dcnn_tiny", num_classes=1),
+                               cfg.optim, num_classes=1)
+        eng = create_engine("fedavg", cfg, fed, trainer, mesh=mesh,
+                            logger=log)
+        gs = eng.init_global_state()
+        sampled = eng.client_sampling(0)
+        p, b, loss = eng._round_jit(gs.params, gs.batch_stats, eng.data,
+                                    jnp.asarray(sampled),
+                                    eng.per_client_rngs(0, sampled),
+                                    eng.round_lr(0))
+        outs.append((p, float(loss)))
+    (p_flat, l_flat), (p_two, l_two) = outs
+    np.testing.assert_allclose(l_flat, l_two, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_flat), jax.tree.leaves(p_two)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
