@@ -284,6 +284,11 @@ def main(argv: list[str] | None = None) -> int:
     if not args.streaming:
         from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
         mesh = make_mesh(shape=cfg.mesh_shape)
+    elif cfg.mesh_shape:
+        raise ValueError(
+            "--mesh_shape is not supported with --streaming (the round-"
+            "granular host feed keeps only the sampled clients' shards on "
+            "device; there is no persistent client mesh to lay out)")
     engine = build_experiment(cfg, streaming=args.streaming, mesh=mesh)
     from neuroimagedisttraining_tpu.utils.profiling import (
         failure_context, profile_trace,

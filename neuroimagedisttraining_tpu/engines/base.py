@@ -242,8 +242,11 @@ class FederatedEngine:
             is_two_level, silo_then_global_mean,
         )
 
-        n = jax.tree.leaves(stacked)[0].shape[0]
-        if is_two_level(self.mesh) and n % self.mesh.devices.size == 0:
+        leaves = jax.tree.leaves(stacked)
+        if not leaves:  # e.g. batch_stats of a GroupNorm model
+            return stacked
+        if is_two_level(self.mesh) and leaves[0].shape[0] % \
+                self.mesh.devices.size == 0:
             return silo_then_global_mean(stacked, weights, self.mesh)
         return pt.tree_weighted_mean(stacked, weights)
 

@@ -23,7 +23,8 @@ from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
 from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
 
 
-def _make_engine(tmp_path, cohort, algorithm="fedavg", **fed_kw):
+def _make_engine(tmp_path, cohort, algorithm="fedavg", mesh_shape=(),
+                 **fed_kw):
     cfg = ExperimentConfig(
         model="3dcnn_tiny",  # tiny but real 3D conv net; fast on CPU
         num_classes=1,
@@ -31,11 +32,11 @@ def _make_engine(tmp_path, cohort, algorithm="fedavg", **fed_kw):
         data=DataConfig(dataset="synthetic", partition_method="site"),
         optim=OptimConfig(lr=5e-4, batch_size=8, epochs=2, momentum=0.9,
                           wd=1e-4),
-        fed=FedConfig(client_num_in_total=4, comm_round=4,
-                      frequency_of_the_test=1, **fed_kw),
+        fed=FedConfig(**{"client_num_in_total": 4, "comm_round": 4,
+                         "frequency_of_the_test": 1, **fed_kw}),
         log_dir=str(tmp_path),
     )
-    mesh = make_mesh()
+    mesh = make_mesh(shape=mesh_shape)
     fed, info = federate_cohort(cohort, partition_method="site", mesh=mesh)
     model = create_model(cfg.model, num_classes=1)
     trainer = LocalTrainer(model, cfg.optim, num_classes=1)

@@ -136,41 +136,17 @@ def test_mesh_shape_two_level_cli_layout():
     assert xs.sharding.shard_shape(x.shape) == (2, 3)
 
 
-def test_fedavg_round_identical_on_flat_and_two_level_mesh():
+def test_fedavg_round_identical_on_flat_and_two_level_mesh(tmp_path):
     """--mesh_shape routing: the fedavg round program on a (2,4) silo mesh
     produces the same aggregate as on the flat 8-device clients mesh."""
-    import jax.numpy as jnp
-
-    from neuroimagedisttraining_tpu.config import (
-        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
-    )
-    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
-    from neuroimagedisttraining_tpu.data.federate import federate_cohort
     from neuroimagedisttraining_tpu.data.synthetic import generate_synthetic_abcd
-    from neuroimagedisttraining_tpu.engines import create_engine
-    from neuroimagedisttraining_tpu.models import create_model
-    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
-    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
 
     cohort = generate_synthetic_abcd(num_subjects=32, shape=(12, 14, 12),
                                      num_sites=8, seed=0)
-    cfg = ExperimentConfig(
-        model="3dcnn_tiny", num_classes=1, algorithm="fedavg",
-        data=DataConfig(dataset="synthetic", partition_method="site"),
-        optim=OptimConfig(lr=1e-2, batch_size=4, epochs=1),
-        fed=FedConfig(client_num_in_total=8, comm_round=1),
-        log_dir="/tmp/nidt_2l")
-    log = ExperimentLogger("/tmp/nidt_2l", "synthetic", cfg.identity(),
-                           console=False)
-
     outs = []
     for shape in ((), (2, 4)):
-        mesh = make_mesh(shape=shape)
-        fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh)
-        trainer = LocalTrainer(create_model("3dcnn_tiny", num_classes=1),
-                               cfg.optim, num_classes=1)
-        eng = create_engine("fedavg", cfg, fed, trainer, mesh=mesh,
-                            logger=log)
+        eng = _make_engine(tmp_path, cohort, mesh_shape=shape,
+                           client_num_in_total=8)
         gs = eng.init_global_state()
         sampled = eng.client_sampling(0)
         p, b, loss = eng._round_jit(gs.params, gs.batch_stats, eng.data,

@@ -106,3 +106,72 @@ def test_grad_clip_matches_torch_global_norm():
                     lr=1.0, momentum=0.0, wd=0.0, clip=10.0)
     for w, g in zip(want, got):
         np.testing.assert_allclose(-g, w, rtol=1e-5, atol=1e-6)
+
+
+def _torch_sepconv(c, k, stride, w):
+    """Reference SepConv (operations.py:55-71) rebuilt in torch with the
+    given flax weights: dw-conv(k,s) -> 1x1 -> BN -> relu -> dw-conv(k,1)
+    -> 1x1 -> BN (BNs affine=False, eval-mode identity stats)."""
+    pad = (k - 1) // 2
+    m = torch.nn.Sequential(
+        torch.nn.Conv2d(c, c, k, stride, pad, groups=c, bias=False),
+        torch.nn.Conv2d(c, c, 1, bias=False),
+        torch.nn.BatchNorm2d(c, affine=False),
+        torch.nn.ReLU(),
+        torch.nn.Conv2d(c, c, k, 1, pad, groups=c, bias=False),
+        torch.nn.Conv2d(c, c, 1, bias=False),
+        torch.nn.BatchNorm2d(c, affine=False),
+    )
+    convs = [m[0], m[1], m[4], m[5]]
+    for tconv, fw in zip(convs, w):
+        # flax [kh, kw, in/groups, out] -> torch [out, in/groups, kh, kw]
+        tconv.weight.data = torch.tensor(
+            np.transpose(np.asarray(fw), (3, 2, 0, 1)))
+    return m.eval()
+
+
+def test_darts_sepconv_matches_torch_reference():
+    """DARTS SepConv forward == the reference torch operator with shared
+    weights (BN in batch-stats mode on both sides; relu leading both)."""
+    from neuroimagedisttraining_tpu.models.darts import SepConv
+
+    c, k = 4, 3
+    x = np.random.default_rng(0).normal(size=(2, 8, 8, c)).astype(np.float32)
+    op = SepConv(c_out=c, kernel=k, stride=1, affine=False)
+    params = op.init(jax.random.key(0), jnp.asarray(x), train=True)["params"]
+    ours = np.asarray(op.apply({"params": params}, jnp.asarray(x), train=True))
+
+    w = [params[f"Conv_{i}"]["kernel"] for i in range(4)]
+    tm = _torch_sepconv(c, k, 1, w)
+    xt = torch.tensor(np.transpose(x, (0, 3, 1, 2)))
+    with torch.no_grad():
+        # torch pre-op relu (the reference's op starts with ReLU), and
+        # train-mode BN (batch statistics) to match the search-mode _BN
+        h = torch.relu(xt)
+        h = tm[0](h); h = tm[1](h)
+        h = torch.nn.functional.batch_norm(h, None, None, training=True)
+        h = torch.relu(h)
+        h = tm[4](h); h = tm[5](h)
+        h = torch.nn.functional.batch_norm(h, None, None, training=True)
+    want = np.transpose(h.numpy(), (0, 2, 3, 1))
+    np.testing.assert_allclose(ours, want, atol=2e-5)
+
+
+def test_darts_pools_match_torch_reference():
+    """avg_pool_3x3 replicates torch count_include_pad=False; max_pool_3x3
+    replicates torch MaxPool2d(3, stride, padding=1)."""
+    from neuroimagedisttraining_tpu.models.darts import (
+        avg_pool_3x3, max_pool_3x3,
+    )
+
+    x = np.random.default_rng(1).normal(size=(2, 9, 9, 3)).astype(np.float32)
+    xt = torch.tensor(np.transpose(x, (0, 3, 1, 2)))
+    for stride in (1, 2):
+        got_a = np.asarray(avg_pool_3x3(jnp.asarray(x), stride))
+        want_a = torch.nn.AvgPool2d(3, stride, 1, count_include_pad=False)(xt)
+        np.testing.assert_allclose(
+            got_a, np.transpose(want_a.numpy(), (0, 2, 3, 1)), atol=1e-6)
+        got_m = np.asarray(max_pool_3x3(jnp.asarray(x), stride))
+        want_m = torch.nn.MaxPool2d(3, stride, 1)(xt)
+        np.testing.assert_allclose(
+            got_m, np.transpose(want_m.numpy(), (0, 2, 3, 1)), atol=1e-6)
