@@ -100,3 +100,17 @@ def test_streaming_rejected_for_unsupported_algorithm(tmp_path):
         "--data_dir", path, "--log_dir", str(tmp_path)]))
     with pytest.raises(ValueError, match="streaming"):
         build_experiment(cfg, streaming=True, console=False)
+
+
+def test_mesh_shape_rejected_with_streaming(tmp_path):
+    """--streaming --mesh_shape must error with a usage message rather
+    than silently ignoring the requested mesh layout (checked in main()
+    before any data or device work)."""
+    import pytest
+
+    from neuroimagedisttraining_tpu.__main__ import main
+
+    with pytest.raises(ValueError, match="not supported with --streaming"):
+        main(["--algorithm", "fedavg", "--dataset", "abcd_h5",
+              "--data_dir", str(tmp_path / "c.h5"), "--streaming",
+              "--mesh_shape", "2", "4", "--log_dir", str(tmp_path)])

@@ -6,6 +6,7 @@ rather than silently replicating everything 8x."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tests.test_fedavg import _make_engine
 
@@ -158,3 +159,14 @@ def test_fedavg_round_identical_on_flat_and_two_level_mesh(tmp_path):
     np.testing.assert_allclose(l_flat, l_two, rtol=1e-6)
     for a, b in zip(jax.tree.leaves(p_flat), jax.tree.leaves(p_two)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_make_mesh_usage_errors():
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="1 or 2 positive"):
+        make_mesh(shape=(2, 2, 2))
+    with pytest.raises(ValueError, match="1 or 2 positive"):
+        make_mesh(shape=(0,))
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_mesh(shape=(4, 4), devices=jax.devices()[:8])
