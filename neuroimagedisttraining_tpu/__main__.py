@@ -91,6 +91,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--fomo_m", type=int, default=5)
     parser.add_argument("--val_fraction", type=float, default=0.0)
     # robust aggregation (RobustAggregator args, robust_aggregation.py:32-36)
+    parser.add_argument("--mpc_n_shares", type=int, default=3,
+                        help="TurboAggregate: additive shares per client "
+                             "update")
+    parser.add_argument("--mpc_frac_bits", type=int, default=16,
+                        help="TurboAggregate: fixed-point fraction bits "
+                             "for GF(p) quantization")
     parser.add_argument("--defense_type", type=str, default="none",
                         help="none | norm_diff_clipping | weak_dp")
     parser.add_argument("--norm_bound", type=float, default=5.0)
@@ -106,7 +112,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--log_dir", type=str, default="LOG")
     parser.add_argument("--streaming", action="store_true",
                         help="host-stream the cohort per round instead of "
-                             "keeping it device-resident (cohorts > HBM)")
+                             "keeping it device-resident (cohorts > HBM); "
+                             "supported by fedavg, salientgrads, dispfl, "
+                             "subavg")
+    parser.add_argument("--stream_chunk_clients", type=int, default=0,
+                        help="clients per host-fetched chunk in streaming "
+                             "eval / SNIP scoring / chunked DisPFL rounds "
+                             "(0 = auto)")
     parser.add_argument("--checkpoint_dir", type=str, default="")
     parser.add_argument("--checkpoint_every", type=int, default=0)
     parser.add_argument("--virtual_devices", type=int, default=0,
@@ -147,7 +159,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             client_num_in_total=args.client_num_in_total, frac=args.frac,
             comm_round=args.comm_round, cs=args.cs, active=args.active,
             lamda=args.lamda, local_epochs=args.local_epochs,
-            fomo_m=args.fomo_m, defense_type=args.defense_type,
+            fomo_m=args.fomo_m, mpc_n_shares=args.mpc_n_shares,
+            mpc_frac_bits=args.mpc_frac_bits,
+            defense_type=args.defense_type,
             norm_bound=args.norm_bound, stddev=args.stddev,
             frequency_of_the_test=args.frequency_of_the_test,
             ci=bool(args.ci)),
@@ -165,6 +179,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         remat=args.remat,
+        stream_chunk_clients=args.stream_chunk_clients,
         log_dir=args.log_dir)
 
 

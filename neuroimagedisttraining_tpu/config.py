@@ -95,6 +95,11 @@ class FedConfig:
     defense_type: str = "none"     # none | norm_diff_clipping | weak_dp
     norm_bound: float = 5.0        # clip threshold for the update-norm diff
     stddev: float = 0.05           # weak-DP Gaussian noise stddev
+    # TurboAggregate secure aggregation (additive shares over GF(p))
+    mpc_n_shares: int = 3          # shares per client update (paper: one
+    # per neighbor group)
+    mpc_frac_bits: int = 16        # fixed-point fraction bits for GF(p)
+    # quantization
     # Evaluation cadence
     frequency_of_the_test: int = 1
     ci: bool = False               # CI mode: evaluate client 0 only
@@ -128,6 +133,9 @@ class ExperimentConfig:
     checkpoint_dir: str = ""
     checkpoint_every: int = 0          # rounds; 0 disables
     log_dir: str = "LOG"
+    # streaming mode: clients per host-fetched chunk for streamed eval /
+    # phase-1 scoring / chunked DisPFL rounds; 0 = auto (mesh size or 4)
+    stream_chunk_clients: int = 0
 
     def identity(self) -> str:
         """Experiment-identity string encoding the config, mirroring the

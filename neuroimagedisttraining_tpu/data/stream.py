@@ -20,13 +20,25 @@ tests/test_stream.py).
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator
+from typing import Iterator, NamedTuple
 
 import jax
 import numpy as np
 
 from neuroimagedisttraining_tpu.data.hdf5 import fetch_rows
 from neuroimagedisttraining_tpu.utils import native
+
+
+class EvalChunk(NamedTuple):
+    """One streamed client chunk: ``ids`` are the real client ids,
+    ``padded_ids`` repeat the last id up to the static chunk size (the
+    arrays below are always chunk-sized; pad clients carry n=0)."""
+
+    ids: np.ndarray
+    padded_ids: np.ndarray
+    X: jax.Array
+    y: jax.Array
+    n: jax.Array
 
 
 class StreamingFederation:
@@ -108,20 +120,29 @@ class StreamingFederation:
     # ---------- streamed evaluation ----------
 
     def eval_chunks(self, chunk_clients: int, split: str = "test"
-                    ) -> Iterator[tuple[np.ndarray, object, object, object]]:
-        """Yield (client_ids, X, y, n) device chunks covering the cohort.
+                    ) -> Iterator[EvalChunk]:
+        """Yield ``EvalChunk`` device chunks covering the cohort.
 
         The final chunk is padded with zero-sample clients so every chunk
-        has the same static shape (one compiled eval program)."""
+        has the same static shape (one compiled eval program). Chunk k+1's
+        host read is submitted to the background reader BEFORE chunk k is
+        yielded, so host I/O overlaps the caller's device compute (same
+        double-buffering as the round feed)."""
+        metas = []
         for start in range(0, self.num_clients, chunk_clients):
             ids = np.arange(start, min(start + chunk_clients,
                                        self.num_clients))
             padded = np.concatenate(
                 [ids, np.full(chunk_clients - len(ids), ids[-1])])
-            Xs, ys, ns = self._fetch(padded, split)
+            metas.append((ids, padded))
+        fut = self._pool.submit(self._fetch, metas[0][1], split)
+        for i, (ids, padded) in enumerate(metas):
+            Xs, ys, ns = fut.result()
+            if i + 1 < len(metas):
+                fut = self._pool.submit(self._fetch, metas[i + 1][1], split)
             ns[len(ids):] = 0  # pad clients contribute nothing
-            yield (ids, jax.device_put(Xs), jax.device_put(ys),
-                   jax.device_put(ns))
+            yield EvalChunk(ids, padded, jax.device_put(Xs),
+                            jax.device_put(ys), jax.device_put(ns))
 
     def close(self):
         self._pool.shutdown(wait=False)

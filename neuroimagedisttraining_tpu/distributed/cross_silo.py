@@ -126,6 +126,92 @@ class FedAvgServer(ServerManager):
             self.send_message(M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
 
 
+class SecureFedAvgServer(FedAvgServer):
+    """Secure-aggregation server: clients upload additive SHARE SLOTS of
+    their weight-scaled quantized update instead of plaintext params
+    (engine parity: TurboAggregateEngine.secure_aggregate; ref
+    turboaggregate/mpc_function.py:214-224 Gen_Additive_SS). The round is
+    two-phase: clients first report their sample counts in the clear
+    (metadata the plain protocol exposes anyway); the server replies with
+    each client's NORMALIZED FedAvg weight w_c = n_c / sum n, and clients
+    then share ``quantize(w_c * params)`` — with w_c <= 1 the field values
+    stay within the fixed-point range regardless of cohort size. The
+    server folds each arriving share set into per-slot accumulators
+    (slot-major, mod p) and combines slots only once ALL clients have
+    reported — so no stored server-side intermediate equals an individual
+    client's update.
+
+    Trust model (same as the paper's single-aggregator degenerate case):
+    each client's n_shares slots transit THIS server, which is trusted not
+    to combine one client's slots before folding them into the
+    accumulators; a full deployment would route each slot j to a distinct
+    aggregator node over this same control plane."""
+
+    def __init__(self, init_params, comm_round: int, num_clients: int,
+                 frac_bits: int = 16, **kw):
+        super().__init__(init_params, comm_round, num_clients, **kw)
+        self.frac_bits = frac_bits
+        self._slot_acc: dict | None = None
+        self._n_by_client: dict[int, float] = {}
+        self._n_clients_in = 0
+
+    def register_message_receive_handlers(self) -> None:
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_NUM_SAMPLES, self._on_num_samples)
+
+    # ---- phase A: sample counts -> normalized weights ----
+
+    def _on_num_samples(self, msg: M.Message) -> None:
+        self._n_by_client[msg.sender_id] = float(
+            msg.get(M.ARG_NUM_SAMPLES))
+        if len(self._n_by_client) < self.num_clients:
+            return
+        total = max(sum(self._n_by_client.values()), 1e-12)
+        for c, n in self._n_by_client.items():
+            out = M.Message(M.MSG_TYPE_S2C_AGG_WEIGHTS, 0, c)
+            out.add(M.ARG_AGG_WEIGHT, n / total)
+            out.add(M.ARG_ROUND_IDX, self.round_idx)
+            self.send_message(out)
+        self._n_by_client.clear()
+
+    # ---- phase B: slot-major share accumulation ----
+
+    def _on_model(self, msg: M.Message) -> None:
+        from neuroimagedisttraining_tpu.ops import mpc
+
+        shares_tree = msg.get(M.ARG_MODEL_PARAMS)  # leaves: [n_shares, ...]
+        if self._slot_acc is None:
+            self._slot_acc = jax.tree.map(
+                lambda s: np.asarray(s, np.int64) % mpc.P_DEFAULT,
+                shares_tree)
+        else:
+            self._slot_acc = jax.tree.map(
+                lambda acc, s: (acc + np.asarray(s, np.int64))
+                % mpc.P_DEFAULT, self._slot_acc, shares_tree)
+        self._n_clients_in += 1
+        if self._n_clients_in < self.num_clients:
+            return
+        # weights already sum to 1 client-side, so the slot total IS the
+        # weighted mean
+        self.params = jax.tree.map(
+            lambda slots, old: mpc.dequantize(
+                np.mod(slots.sum(axis=0), mpc.P_DEFAULT),
+                frac_bits=self.frac_bits).astype(np.asarray(old).dtype),
+            self._slot_acc, self.params)
+        self._slot_acc = None
+        self.history.append({"round": self.round_idx,
+                             "clients": self._n_clients_in})
+        self._n_clients_in = 0
+        self.round_idx += 1
+        if self.round_idx >= self.comm_round:
+            self._broadcast_finish()
+            self._done.set()
+            self.finish()
+        else:
+            self._broadcast_sync(M.MSG_TYPE_S2C_SYNC_MODEL)
+
+
 class FedAvgClientProc(ClientManager):
     """Rank >= 1. Trains via the injected ``train_fn`` on every sync."""
 
@@ -161,3 +247,49 @@ class FedAvgClientProc(ClientManager):
     def _on_finish(self, msg: M.Message) -> None:
         self.final_params = None  # server holds the aggregate
         self.finish()
+
+
+class SecureFedAvgClientProc(FedAvgClientProc):
+    """Client for ``SecureFedAvgServer``: after local training it reports
+    ``n_c`` in the clear, waits for its normalized weight w_c, then
+    uploads additive shares of ``quantize(w_c * params)``. w_c <= 1 keeps
+    the fixed-point embedding exact (|x| * 2^frac_bits < p/2) for any
+    cohort size; the server reconstructs only the weighted mean."""
+
+    def __init__(self, rank: int, num_clients: int, train_fn: Callable,
+                 n_shares: int = 3, frac_bits: int = 16, mpc_seed: int = 0,
+                 **kw):
+        super().__init__(rank, num_clients, train_fn, **kw)
+        self.n_shares = n_shares
+        self.frac_bits = frac_bits
+        self._rng = np.random.default_rng(mpc_seed * 7919 + rank)
+        self._trained = None  # params awaiting the weight reply
+
+    def register_message_receive_handlers(self) -> None:
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_AGG_WEIGHTS, self._on_weights)
+
+    def _on_sync(self, msg: M.Message) -> None:
+        params = msg.get(M.ARG_MODEL_PARAMS)
+        round_idx = int(msg.get(M.ARG_ROUND_IDX))
+        new_params, n = self.train_fn(params, round_idx)
+        self._trained = _to_numpy_tree(new_params)
+        out = M.Message(M.MSG_TYPE_C2S_NUM_SAMPLES, self.rank, 0)
+        out.add(M.ARG_NUM_SAMPLES, float(n))
+        self.send_message(out)
+
+    def _on_weights(self, msg: M.Message) -> None:
+        from neuroimagedisttraining_tpu.ops import mpc
+
+        w = float(msg.get(M.ARG_AGG_WEIGHT))
+        shares_tree = jax.tree.map(
+            lambda x: mpc.additive_shares(
+                mpc.quantize(w * np.asarray(x, np.float64),
+                             frac_bits=self.frac_bits),
+                self.n_shares, rng=self._rng),
+            self._trained)
+        self._trained = None
+        out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+        out.add(M.ARG_MODEL_PARAMS, shares_tree)
+        self.send_message(out)

@@ -28,12 +28,16 @@ MSG_TYPE_S2C_INIT_CONFIG = "server_init_config"
 MSG_TYPE_S2C_SYNC_MODEL = "server_sync_model"
 MSG_TYPE_C2S_SEND_MODEL = "client_send_model"
 MSG_TYPE_S2C_FINISH = "server_finish"
+# secure-aggregation weight exchange (cross_silo.SecureFedAvgServer)
+MSG_TYPE_C2S_NUM_SAMPLES = "client_num_samples"
+MSG_TYPE_S2C_AGG_WEIGHTS = "server_agg_weights"
 
 # payload keys (Message.MSG_ARG_KEY_* parity)
 ARG_MODEL_PARAMS = "model_params"
 ARG_NUM_SAMPLES = "num_samples"
 ARG_CLIENT_INDEX = "client_index"
 ARG_ROUND_IDX = "round_idx"
+ARG_AGG_WEIGHT = "agg_weight"
 
 _MAGIC = b"NIDT1"
 

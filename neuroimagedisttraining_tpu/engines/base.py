@@ -52,10 +52,13 @@ class FederatedEngine:
                                               cfg.identity())
         self._console = get_logger()
         if stream is not None and not self.supports_streaming:
+            from neuroimagedisttraining_tpu.engines import ENGINES
+            ok = sorted({c.name for c in ENGINES.values()
+                         if c.supports_streaming})
             raise ValueError(
                 f"algorithm {self.name!r} does not support --streaming "
-                "(needs the whole federation's state device-resident); "
-                "streaming currently supports: fedavg")
+                "(its round needs every client's DATA device-resident, not "
+                f"just its state); streaming currently supports: {ok}")
         if fed_data is not None:
             self.num_clients = int(fed_data.num_clients)  # incl. mesh padding
             self._n_train_host = np.asarray(fed_data.n_train)
@@ -221,6 +224,27 @@ class FederatedEngine:
 
     # ---------- helpers ----------
 
+    def _max_samples(self) -> int:
+        """Static per-client sample-axis pad (same in streamed and
+        resident layouts, so round programs compile once)."""
+        return (self.stream.nmax_train if self.stream is not None
+                else int(self.data.X_train.shape[1]))
+
+    def _eval_g(self, params, bstats) -> dict[str, float]:
+        """Global-model eval, dispatched on the data residency mode."""
+        if self.stream is not None:
+            return self.eval_global_stream(params, bstats)
+        return self.eval_global(params, bstats)
+
+    def _eval_p(self, per_params, per_bstats) -> dict[str, float]:
+        """Personalized eval over stacked per-client state, dispatched on
+        the data residency mode."""
+        if self.stream is not None:
+            return self.eval_personalized_stream(per_params, per_bstats)
+        return self.eval_personalized(ClientState(
+            params=per_params, batch_stats=per_bstats, opt_state=None,
+            rng=None))
+
     def round_lr(self, round_idx: int):
         return round_lr(self.cfg.optim, round_idx)
 
@@ -253,6 +277,8 @@ class FederatedEngine:
     # ---------- streamed evaluation (cohort > HBM) ----------
 
     def _eval_chunk_size(self) -> int:
+        if self.cfg.stream_chunk_clients > 0:
+            return self.cfg.stream_chunk_clients
         return self.mesh.devices.size if self.mesh is not None else 4
 
     def eval_global_stream(self, params, bstats, split: str = "test"
@@ -262,16 +288,39 @@ class FederatedEngine:
         parity by construction."""
         parts: list[tuple] = []
         ns: list[np.ndarray] = []
-        for ids, X, y, n in self.stream.eval_chunks(self._eval_chunk_size(),
-                                                    split):
-            out = self._eval_global_jit(params, bstats, X, y, n)
-            parts.append(tuple(np.asarray(o)[: len(ids)] for o in out))
-            ns.append(np.asarray(jax.device_get(n))[: len(ids)])
+        for ch in self.stream.eval_chunks(self._eval_chunk_size(), split):
+            out = self._eval_global_jit(params, bstats, ch.X, ch.y, ch.n)
+            parts.append(tuple(np.asarray(o)[: len(ch.ids)] for o in out))
+            ns.append(np.asarray(jax.device_get(ch.n))[: len(ch.ids)])
             if self.cfg.fed.ci:
                 break
         cat = [np.concatenate([p[i] for p in parts]) for i in range(4)]
         n_all = np.concatenate(ns)
         if self.cfg.fed.ci:
+            cat = [c[:1] for c in cat]
+            n_all = n_all[:1]
+        return self._summarize(*cat, n=n_all)
+
+    def eval_personalized_stream(self, per_params, per_bstats,
+                                 split: str = "test") -> dict[str, float]:
+        """Personalized eval when only the STATE is device-resident: stream
+        the cohort's eval shards in client chunks and gather each chunk's
+        rows out of the stacked per-client state. Per-client metrics are
+        independent, so chunked results match the resident vmap bitwise."""
+        chunk = self._eval_chunk_size()
+        parts: list[tuple] = []
+        ns: list[np.ndarray] = []
+        for ch in self.stream.eval_chunks(chunk, split):
+            p = pt.tree_stack_index(per_params, ch.padded_ids)
+            b = pt.tree_stack_index(per_bstats, ch.padded_ids)
+            out = self._eval_personal_jit(p, b, ch.X, ch.y, ch.n)
+            parts.append(tuple(np.asarray(o)[: len(ch.ids)] for o in out))
+            ns.append(np.asarray(jax.device_get(ch.n))[: len(ch.ids)])
+            if self.cfg.fed.ci:
+                break
+        cat = [np.concatenate([p[i] for p in parts]) for i in range(4)]
+        n_all = np.concatenate(ns)
+        if self.cfg.fed.ci:  # client 0 only, matching the resident CI path
             cat = [c[:1] for c in cat]
             n_all = n_all[:1]
         return self._summarize(*cat, n=n_all)

@@ -61,6 +61,12 @@ DIFF_SPA_CYCLE = (0.2, 0.4, 0.6, 0.8, 1.0)  # dispfl_api.py:65-66
 
 class DisPFLEngine(FederatedEngine):
     name = "dispfl"
+    # Streaming (cohort > HBM): DisPFL trains EVERY client each round, so
+    # the streamed round runs the state-only neighbor consensus first, then
+    # local-train+mask-evolution over client CHUNKS whose data shards are
+    # host-fetched per chunk — per-client results are independent, so the
+    # chunked composition equals the fused resident program.
+    supports_streaming = True
 
     # ---------- init ----------
 
@@ -149,74 +155,87 @@ class DisPFLEngine(FederatedEngine):
 
     # ---------- the round program ----------
 
-    @functools.cached_property
-    def _round_jit(self):
+    def _consensus(self, per_params, per_bstats, masks_local, masks_shared,
+                   A):
+        """Mask-overlap-weighted neighbor aggregation (state-only).
+
+        counts[c] = sum_j A[c,j] * masks_shared[j]  (overlap count)
+        w_tmp[c]  = (1/counts[c]) * sum_j A[c,j] * w[j], 0 where count=0
+        """
+        mix = lambda t: jax.tree.map(
+            lambda x: jnp.einsum("cj,j...->c...", A,
+                                 x.astype(jnp.float32)).astype(x.dtype),
+            t)
+        counts = mix(masks_shared)
+        sums = mix(per_params)
+        w_tmp = jax.tree.map(
+            lambda sm, ct: jnp.where(ct > 0, sm / jnp.maximum(ct, 1.0),
+                                     0.0),
+            sums, counts)
+        # personal re-mask (dispfl_api.py:238-239)
+        w_local = jax.tree.map(jnp.multiply, w_tmp, masks_local)
+        # batch_stats are not masked; plain neighbor mean
+        deg = jnp.sum(A, axis=1)
+        b_mixed = jax.tree.map(
+            lambda x: jnp.einsum("cj,j...->c...", A,
+                                 x.astype(jnp.float32))
+            / deg.reshape((-1,) + (1,) * (x.ndim - 1)),
+            per_bstats)
+        return w_local, b_mixed
+
+    def _local_and_evolve(self, w_local, b_mixed, masks_local, rngs, X, y,
+                          n, lr, round_idx):
+        """Vmapped local training (post-step re-mask) + fire/regrow mask
+        evolution over a block of clients — per-client independent, so the
+        streamed chunked composition matches the fused resident program."""
         trainer = self.trainer
         o = self.cfg.optim
         s = self.cfg.sparsity
         comm_round = self.cfg.fed.comm_round
-        max_samples = int(self.data.X_train.shape[1])
+        max_samples = self._max_samples()
 
+        def local(p, b, m, rng, Xc, yc, nc):
+            cs_c = ClientState(params=p, batch_stats=b,
+                               opt_state=trainer.opt.init(p), rng=rng)
+            cs_c, loss = trainer.local_train(
+                cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples, mask=m)
+            return cs_c.params, cs_c.batch_stats, loss, cs_c.rng
+
+        new_p, new_b, losses, rngs2 = jax.vmap(local)(
+            w_local, b_mixed, masks_local, rngs, X, y, n)
+
+        # --- mask evolution: screen -> fire -> regrow ---
+        if s.static:
+            new_masks = masks_local
+        else:
+            def evolve(p, b, m, rng, Xc, yc, nc):
+                brng, grng = jax.random.split(rng)
+                idx = jax.random.randint(brng, (o.batch_size,), 0,
+                                         jnp.maximum(nc, 1))
+                grad = trainer.eval_grad(p, b, jnp.take(Xc, idx, axis=0),
+                                         jnp.take(yc, idx, axis=0))
+                fired, num_remove = M.fire_mask(
+                    m, p, round_idx, comm_round,
+                    anneal_factor=s.anneal_factor)
+                return M.regrow_mask(
+                    fired, num_remove,
+                    None if s.dis_gradient_check else grad,
+                    rng=grng, dis_gradient_check=s.dis_gradient_check)
+
+            new_masks = jax.vmap(evolve)(new_p, new_b, masks_local, rngs2,
+                                         X, y, n)
+        return new_p, new_b, new_masks, losses
+
+    @functools.cached_property
+    def _round_jit(self):
         def round_fn(per_params, per_bstats, masks_local, masks_shared,
                      data, A, rngs, lr, round_idx):
-            # --- consensus: mask-overlap-weighted neighbor aggregation ---
-            # counts[c] = sum_j A[c,j] * masks_shared[j]  (overlap count)
-            # w_tmp[c]  = (1/counts[c]) * sum_j A[c,j] * w[j], 0 where count=0
-            mix = lambda t: jax.tree.map(
-                lambda x: jnp.einsum("cj,j...->c...", A,
-                                     x.astype(jnp.float32)).astype(x.dtype),
-                t)
-            counts = mix(masks_shared)
-            sums = mix(per_params)
-            w_tmp = jax.tree.map(
-                lambda sm, ct: jnp.where(ct > 0, sm / jnp.maximum(ct, 1.0),
-                                         0.0),
-                sums, counts)
-            # personal re-mask (dispfl_api.py:238-239)
-            w_local = jax.tree.map(jnp.multiply, w_tmp, masks_local)
-            # batch_stats are not masked; plain neighbor mean
-            deg = jnp.sum(A, axis=1)
-            b_mixed = jax.tree.map(
-                lambda x: jnp.einsum("cj,j...->c...", A,
-                                     x.astype(jnp.float32))
-                / deg.reshape((-1,) + (1,) * (x.ndim - 1)),
-                per_bstats)
-
-            # --- local training with post-step re-mask ---
-            def local(p, b, m, rng, Xc, yc, nc):
-                cs_c = ClientState(params=p, batch_stats=b,
-                                   opt_state=trainer.opt.init(p), rng=rng)
-                cs_c, loss = trainer.local_train(
-                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                    batch_size=o.batch_size, max_samples=max_samples, mask=m)
-                return cs_c.params, cs_c.batch_stats, loss, cs_c.rng
-
-            new_p, new_b, losses, rngs2 = jax.vmap(local)(
+            w_local, b_mixed = self._consensus(
+                per_params, per_bstats, masks_local, masks_shared, A)
+            new_p, new_b, new_masks, losses = self._local_and_evolve(
                 w_local, b_mixed, masks_local, rngs,
-                data.X_train, data.y_train, data.n_train)
-
-            # --- mask evolution: screen -> fire -> regrow ---
-            if s.static:
-                new_masks = masks_local
-            else:
-                def evolve(p, b, m, rng, Xc, yc, nc):
-                    brng, grng = jax.random.split(rng)
-                    idx = jax.random.randint(brng, (o.batch_size,), 0,
-                                             jnp.maximum(nc, 1))
-                    grad = trainer.eval_grad(p, b, jnp.take(Xc, idx, axis=0),
-                                             jnp.take(yc, idx, axis=0))
-                    fired, num_remove = M.fire_mask(
-                        m, p, round_idx, comm_round,
-                        anneal_factor=s.anneal_factor)
-                    return M.regrow_mask(
-                        fired, num_remove,
-                        None if s.dis_gradient_check else grad,
-                        rng=grng, dis_gradient_check=s.dis_gradient_check)
-
-                new_masks = jax.vmap(evolve)(
-                    new_p, new_b, masks_local, rngs2,
-                    data.X_train, data.y_train, data.n_train)
-
+                data.X_train, data.y_train, data.n_train, lr, round_idx)
             # mask change tracking: hamming(shared_lstrd, local) per client
             # (dispfl_api.py:110)
             dist_self = jax.vmap(M.mask_hamming_distance)(masks_shared,
@@ -228,6 +247,58 @@ class DisPFLEngine(FederatedEngine):
             return new_p, new_b, new_masks, masks_local, dist_self, mean_loss
 
         return jax.jit(round_fn)
+
+    # ---------- streamed round (data per chunk, state resident) ----------
+
+    @functools.cached_property
+    def _consensus_jit(self):
+        return jax.jit(self._consensus)
+
+    @functools.cached_property
+    def _local_chunk_jit(self):
+        return jax.jit(self._local_and_evolve)
+
+    @functools.cached_property
+    def _round_tail_jit(self):
+        def tail(masks_shared, masks_local, losses, n_train):
+            dist_self = jax.vmap(M.mask_hamming_distance)(masks_shared,
+                                                          masks_local)
+            real = (n_train > 0).astype(jnp.float32)
+            mean_loss = jnp.sum(losses * real) / jnp.maximum(jnp.sum(real),
+                                                             1.0)
+            return dist_self, mean_loss
+
+        return jax.jit(tail)
+
+    def _round_streaming(self, per_params, per_bstats, masks_local,
+                         masks_shared, A, rngs, lr, round_idx):
+        """Chunked streamed round: consensus on resident state, then each
+        client chunk's data is host-fetched, trained, and evolved; chunk
+        outputs concatenate back into the stacked [C, ...] state."""
+        w_local, b_mixed = self._consensus_jit(
+            per_params, per_bstats, masks_local, masks_shared, A)
+        chunk = self._eval_chunk_size()
+        p_parts, b_parts, m_parts, l_parts = [], [], [], []
+        for ch in self.stream.eval_chunks(chunk, "train"):
+            take = lambda t: pt.tree_stack_index(t, ch.padded_ids)
+            new_p, new_b, new_m, losses = self._local_chunk_jit(
+                take(w_local), take(b_mixed), take(masks_local),
+                rngs[ch.padded_ids], ch.X, ch.y, ch.n, lr, round_idx)
+            keep = len(ch.ids)
+            trim = lambda t: jax.tree.map(lambda x: x[:keep], t)
+            p_parts.append(trim(new_p))
+            b_parts.append(trim(new_b))
+            m_parts.append(trim(new_m))
+            l_parts.append(losses[:keep])
+        cat = lambda parts: jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+        new_p, new_b = cat(p_parts), cat(b_parts)
+        new_masks = cat(m_parts)
+        losses = jnp.concatenate(l_parts)
+        dist_self, mean_loss = self._round_tail_jit(
+            masks_shared, masks_local, losses,
+            jnp.asarray(self._n_train_host))
+        return new_p, new_b, new_masks, masks_local, dist_self, mean_loss
 
     @functools.cached_property
     def _pairwise_hamming_jit(self):
@@ -279,7 +350,7 @@ class DisPFLEngine(FederatedEngine):
                 self.trainer.model, gs.params, sample,
                 mask_density={k: 1.0 - v for k, v in sp.items()},
                 batch_stats=gs.batch_stats)
-        n_train = np.asarray(self.data.n_train)
+        n_train = self._n_train_host
         flops_per_round = sum(
             cfg.optim.epochs * float(n_train[c]) * flops_by_dr[w_spa[c]]
             + cfg.optim.batch_size * full_flops
@@ -301,10 +372,18 @@ class DisPFLEngine(FederatedEngine):
             self.log.info(
                 "################ round %d: active %s", round_idx,
                 np.flatnonzero(active[: self.real_clients]).tolist())
-            (per_params, per_bstats, masks_local, masks_shared, dist_self,
-             loss) = self._round_jit(
-                per_params, per_bstats, masks_local, masks_shared, self.data,
-                A, rngs, self.round_lr(round_idx), jnp.float32(round_idx))
+            if self.stream is not None:
+                (per_params, per_bstats, masks_local, masks_shared,
+                 dist_self, loss) = self._round_streaming(
+                    per_params, per_bstats, masks_local, masks_shared,
+                    A, rngs, self.round_lr(round_idx),
+                    jnp.float32(round_idx))
+            else:
+                (per_params, per_bstats, masks_local, masks_shared,
+                 dist_self, loss) = self._round_jit(
+                    per_params, per_bstats, masks_local, masks_shared,
+                    self.data, A, rngs, self.round_lr(round_idx),
+                    jnp.float32(round_idx))
             real = self.real_clients
             # comm = actual gossip edges: client c receives each neighbor
             # j != c's sparse model (nnz of j's mask + dense leaves)
@@ -315,9 +394,7 @@ class DisPFLEngine(FederatedEngine):
             self.stat_info["sum_training_flops"] += flops_per_round
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
-                mp = self.eval_personalized(ClientState(
-                    params=per_params, batch_stats=per_bstats,
-                    opt_state=None, rng=None))
+                mp = self._eval_p(per_params, per_bstats)
                 self.stat_info["person_test_acc"].append(mp["acc"])
                 self.log.metrics(
                     round_idx, train_loss=loss, personal=mp,
@@ -339,9 +416,7 @@ class DisPFLEngine(FederatedEngine):
         if cfg.sparsity.save_masks:
             self.stat_info["final_masks"] = jax.tree.map(
                 lambda m: np.asarray(m, bool), masks_local)
-        m_person = self.eval_personalized(ClientState(
-            params=per_params, batch_stats=per_bstats, opt_state=None,
-            rng=None))
+        m_person = self._eval_p(per_params, per_bstats)
         self.log.metrics(-1, personal=m_person)
         return {"personal_params": per_params, "masks": masks_local,
                 "w_spa": w_spa, "history": history,

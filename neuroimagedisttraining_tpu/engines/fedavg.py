@@ -31,10 +31,6 @@ class FedAvgEngine(FederatedEngine):
     name = "fedavg"
     supports_streaming = True
 
-    def _max_samples(self) -> int:
-        return (self.stream.nmax_train if self.stream is not None
-                else int(self.data.X_train.shape[1]))
-
     def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr):
         """One FedAvg round over pre-gathered sampled-client shards; shared
         by the device-resident and streaming paths."""
@@ -212,20 +208,19 @@ class FedAvgEngine(FederatedEngine):
         ft_lr = self.round_lr(-1)
         per_parts, per_ns = [], []
         test_iter = self.stream.eval_chunks(chunk, "test")
-        for ids, Xt, yt, nt in self.stream.eval_chunks(chunk, "train"):
+        for ch in self.stream.eval_chunks(chunk, "train"):
             if self.cfg.fed.ci and per_parts:
                 break  # CI escape hatch: first chunk only
-            rngs = self.per_client_rngs(
-                cfg.fed.comm_round,
-                np.concatenate([ids, np.full(chunk - len(ids), ids[-1])]))
-            states = self._finetune_stream_jit(params, bstats, Xt, yt, nt,
-                                               rngs, ft_lr)
-            ids_e, Xe, ye, ne = next(test_iter)
-            assert np.array_equal(ids, ids_e)
+            rngs = self.per_client_rngs(cfg.fed.comm_round, ch.padded_ids)
+            states = self._finetune_stream_jit(params, bstats, ch.X, ch.y,
+                                               ch.n, rngs, ft_lr)
+            che = next(test_iter)
+            assert np.array_equal(ch.ids, che.ids)
             out = self._eval_personal_jit(states.params, states.batch_stats,
-                                          Xe, ye, ne)
-            per_parts.append(tuple(np.asarray(o)[: len(ids)] for o in out))
-            per_ns.append(np.asarray(jax.device_get(ne))[: len(ids)])
+                                          che.X, che.y, che.n)
+            per_parts.append(tuple(np.asarray(o)[: len(ch.ids)]
+                                   for o in out))
+            per_ns.append(np.asarray(jax.device_get(che.n))[: len(ch.ids)])
         cat = [np.concatenate([p[i] for p in per_parts]) for i in range(4)]
         n_cat = np.concatenate(per_ns)
         if self.cfg.fed.ci:  # client 0 only, matching the resident CI path

@@ -39,49 +39,88 @@ from neuroimagedisttraining_tpu.utils import pytree as pt
 
 class SalientGradsEngine(FederatedEngine):
     name = "salientgrads"
+    # Streaming mode (cohort > HBM): per-client DATA streams per round /
+    # per phase-1 chunk; the per-client personal STATE (params + batch
+    # stats) and the global mask stay device-resident — the reference's
+    # per-batch lazy HDF5 fetch (my_model_trainer.py:185-199) done at
+    # round granularity, same as FedAvg's streaming path.
+    supports_streaming = True
 
     # ---------- phase 1: the global mask ----------
 
-    @functools.cached_property
-    def _scores_jit(self):
+    def _scores_body(self, params, bstats, Xs, ys, ns, rngs):
+        """Weighted SNIP-score SUM over a block of clients + the block's
+        client-weight sum — shared by the resident one-shot program and
+        the streamed per-chunk program."""
         trainer = self.trainer
         s = self.cfg.sparsity
         o = self.cfg.optim
-        C = self.num_clients
+        K = Xs.shape[0]
+        cs = ClientState(
+            params=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (K,) + x.shape), params),
+            batch_stats=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (K,) + x.shape), bstats),
+            opt_state=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (K,) + x.shape),
+                trainer.opt.init(params)),
+            rng=rngs,
+        )
 
+        def per_client(cs_c, Xc, yc, nc):
+            sc = snip_ops.iter_snip_scores(
+                trainer, cs_c, Xc, yc, nc,
+                iterations=s.itersnip_iterations, batch_size=o.batch_size,
+                stratified=s.stratified_sampling)
+            # zero-weight padding clients contribute nothing
+            w = (nc > 0).astype(jnp.float32)
+            return jax.tree.map(lambda t: t * w, sc), w
+
+        per, w = jax.vmap(per_client)(cs, Xs, ys, ns)
+        return (jax.tree.map(lambda t: jnp.sum(t, axis=0), per),
+                jnp.sum(w))
+
+    @functools.cached_property
+    def _scores_jit(self):
         def scores_fn(params, bstats, data, rngs):
-            cs = ClientState(
-                params=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (C,) + x.shape), params),
-                batch_stats=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (C,) + x.shape), bstats),
-                opt_state=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (C,) + x.shape),
-                    trainer.opt.init(params)),
-                rng=rngs,
-            )
-
-            def per_client(cs_c, Xc, yc, nc):
-                sc = snip_ops.iter_snip_scores(
-                    trainer, cs_c, Xc, yc, nc,
-                    iterations=s.itersnip_iterations, batch_size=o.batch_size,
-                    stratified=s.stratified_sampling)
-                # zero-weight padding clients contribute nothing
-                w = (nc > 0).astype(jnp.float32)
-                return jax.tree.map(lambda t: t * w, sc), w
-
-            per, w = jax.vmap(per_client)(cs, data.X_train, data.y_train,
-                                          data.n_train)
+            ssum, wsum = self._scores_body(params, bstats, data.X_train,
+                                           data.y_train, data.n_train, rngs)
             # mean over REAL clients (snip.py get_mean_snip_scores)
-            denom = jnp.maximum(jnp.sum(w), 1.0)
-            return jax.tree.map(lambda t: jnp.sum(t, axis=0) / denom, per)
+            denom = jnp.maximum(wsum, 1.0)
+            return jax.tree.map(lambda t: t / denom, ssum)
 
         return jax.jit(scores_fn)
 
+    @functools.cached_property
+    def _chunk_scores_jit(self):
+        return jax.jit(self._scores_body)
+
+    def _scores_streaming(self, params, bstats):
+        """Phase-1 SNIP scores over a >HBM cohort: stream train shards in
+        client chunks; only the (param-sized) score accumulator stays on
+        device. Matches my_model_trainer.py:185-199's lazy per-batch fetch
+        at chunk granularity."""
+        chunk = self._eval_chunk_size()
+        acc, wtot = None, None
+        for ch in self.stream.eval_chunks(chunk, "train"):
+            rngs = self.per_client_rngs(-1, ch.padded_ids)
+            ssum, wsum = self._chunk_scores_jit(params, bstats, ch.X, ch.y,
+                                                ch.n, rngs)
+            if acc is None:
+                acc, wtot = ssum, wsum
+            else:
+                acc = pt.tree_add(acc, ssum)
+                wtot = wtot + wsum
+        denom = jnp.maximum(wtot, 1.0)
+        return jax.tree.map(lambda t: t / denom, acc)
+
     def generate_global_mask(self, params, bstats):
         """Phase-1 pipeline (sailentgrads_api.py:47-66)."""
-        rngs = self.per_client_rngs(-1, np.arange(self.num_clients))
-        scores = self._scores_jit(params, bstats, self.data, rngs)
+        if self.stream is not None:
+            scores = self._scores_streaming(params, bstats)
+        else:
+            rngs = self.per_client_rngs(-1, np.arange(self.num_clients))
+            scores = self._scores_jit(params, bstats, self.data, rngs)
         masks, thr = snip_ops.mask_from_scores(
             scores, keep_ratio=self.cfg.sparsity.dense_ratio)
         if not self.cfg.sparsity.snip_mask:
@@ -90,50 +129,61 @@ class SalientGradsEngine(FederatedEngine):
 
     # ---------- phase 2: masked rounds ----------
 
-    @functools.cached_property
-    def _round_jit(self):
+    def _round_body(self, params, bstats, per_params, per_bstats, Xs, ys,
+                    ns, masks, sampled_idx, rngs, lr):
+        """One masked round over pre-gathered sampled-client shards; shared
+        by the device-resident and streaming paths (sampled_idx only drives
+        the personal-state scatter)."""
         trainer = self.trainer
         o = self.cfg.optim
-        S = min(self.cfg.fed.client_num_per_round, self.real_clients)
-        max_samples = int(self.data.X_train.shape[1])
+        S = Xs.shape[0]
+        max_samples = self._max_samples()
+        cs = ClientState(
+            params=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
+            batch_stats=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape), bstats),
+            opt_state=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape),
+                trainer.opt.init(params)),
+            rng=rngs,
+        )
 
+        def local(cs_c, Xc, yc, nc):
+            return trainer.local_train(
+                cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples,
+                mask=masks)
+
+        cs, losses = jax.vmap(local, in_axes=(0, 0, 0, 0))(cs, Xs, ys, ns)
+        w = ns.astype(jnp.float32)
+        new_params = pt.tree_weighted_mean(cs.params, w)
+        new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+        # personal models <- this round's local results (scatter rows)
+        per_params = jax.tree.map(
+            lambda allp, newp: allp.at[sampled_idx].set(newp),
+            per_params, cs.params)
+        per_bstats = jax.tree.map(
+            lambda allp, newp: allp.at[sampled_idx].set(newp),
+            per_bstats, cs.batch_stats)
+        mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        return new_params, new_bstats, per_params, per_bstats, mean_loss
+
+    @functools.cached_property
+    def _round_jit(self):
         def round_fn(params, bstats, per_params, per_bstats, data, masks,
                      sampled_idx, rngs, lr):
             Xs = jnp.take(data.X_train, sampled_idx, axis=0)
             ys = jnp.take(data.y_train, sampled_idx, axis=0)
             ns = jnp.take(data.n_train, sampled_idx, axis=0)
-            cs = ClientState(
-                params=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
-                batch_stats=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), bstats),
-                opt_state=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape),
-                    trainer.opt.init(params)),
-                rng=rngs,
-            )
-
-            def local(cs_c, Xc, yc, nc):
-                return trainer.local_train(
-                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                    batch_size=o.batch_size, max_samples=max_samples,
-                    mask=masks)
-
-            cs, losses = jax.vmap(local, in_axes=(0, 0, 0, 0))(cs, Xs, ys, ns)
-            w = ns.astype(jnp.float32)
-            new_params = pt.tree_weighted_mean(cs.params, w)
-            new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
-            # personal models <- this round's local results (scatter rows)
-            per_params = jax.tree.map(
-                lambda allp, newp: allp.at[sampled_idx].set(newp),
-                per_params, cs.params)
-            per_bstats = jax.tree.map(
-                lambda allp, newp: allp.at[sampled_idx].set(newp),
-                per_bstats, cs.batch_stats)
-            mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
-            return new_params, new_bstats, per_params, per_bstats, mean_loss
+            return self._round_body(params, bstats, per_params, per_bstats,
+                                    Xs, ys, ns, masks, sampled_idx, rngs, lr)
 
         return jax.jit(round_fn)
+
+    @functools.cached_property
+    def _round_stream_jit(self):
+        return jax.jit(self._round_body)
 
     def train(self):
         cfg = self.cfg
@@ -175,25 +225,39 @@ class SalientGradsEngine(FederatedEngine):
             per_params, per_bstats = (restored["per_params"],
                                       restored["per_bstats"])
             history = restored["history"]
+        if self.stream is not None:
+            self.stream.prefetch_train(self.client_sampling(start))
         for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
             rngs = self.per_client_rngs(round_idx, sampled)
-            params, bstats, per_params, per_bstats, loss = self._round_jit(
-                params, bstats, per_params, per_bstats, self.data, masks,
-                jnp.asarray(sampled), rngs, self.round_lr(round_idx))
-            n_samples = float(np.sum(np.asarray(self.data.n_train)[sampled]))
+            if self.stream is not None:
+                Xs, ys, ns = self.stream.get_train(sampled)
+                if round_idx + 1 < cfg.fed.comm_round:
+                    # overlap next round's host read with this round
+                    self.stream.prefetch_train(
+                        self.client_sampling(round_idx + 1))
+                (params, bstats, per_params, per_bstats,
+                 loss) = self._round_stream_jit(
+                    params, bstats, per_params, per_bstats, Xs, ys, ns,
+                    masks, jnp.asarray(sampled), rngs,
+                    self.round_lr(round_idx))
+            else:
+                (params, bstats, per_params, per_bstats,
+                 loss) = self._round_jit(
+                    params, bstats, per_params, per_bstats, self.data,
+                    masks, jnp.asarray(sampled), rngs,
+                    self.round_lr(round_idx))
+            n_samples = float(np.sum(self._n_train_host[sampled]))
             self.stat_info["sum_training_flops"] += (
                 flops_per_sample * cfg.optim.epochs * n_samples)
             self.stat_info["sum_comm_params"] += (comm_params_per_client
                                                   * len(sampled))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
-                m = self.eval_global(params, bstats)
-                mp = self.eval_personalized(ClientState(
-                    params=per_params, batch_stats=per_bstats,
-                    opt_state=None, rng=None))
+                m = self._eval_g(params, bstats)
+                mp = self._eval_p(per_params, per_bstats)
                 self.stat_info["global_test_acc"].append(m["acc"])
                 self.stat_info["person_test_acc"].append(mp["acc"])
                 self.log.metrics(round_idx, train_loss=loss, **m,
@@ -205,10 +269,8 @@ class SalientGradsEngine(FederatedEngine):
                 "params": params, "batch_stats": bstats,
                 "per_params": per_params, "per_bstats": per_bstats,
                 "masks": masks, "history": history})
-        m_global = self.eval_global(params, bstats)
-        m_person = self.eval_personalized(ClientState(
-            params=per_params, batch_stats=per_bstats, opt_state=None,
-            rng=None))
+        m_global = self._eval_g(params, bstats)
+        m_person = self._eval_p(per_params, per_bstats)
         self.log.metrics(-1, global_=m_global, personal=m_person)
         return {"params": params, "batch_stats": bstats, "masks": masks,
                 "mask_density": density, "history": history,

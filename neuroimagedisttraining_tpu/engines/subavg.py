@@ -51,83 +51,101 @@ from neuroimagedisttraining_tpu.utils import pytree as pt
 
 class SubFedAvgEngine(FederatedEngine):
     name = "subavg"
+    # Streaming (cohort > HBM): the round only consumes the SAMPLED clients'
+    # data shards (same shape as FedAvg's streaming round); per-client masks
+    # and the global model stay device-resident.
+    supports_streaming = True
 
-    @functools.cached_property
-    def _round_jit(self):
+    def _round_body(self, params, bstats, mask_pers, Xs, ys, ns,
+                    sampled_idx, rngs, lr):
+        """One Sub-FedAvg round over pre-gathered sampled-client shards;
+        shared by the device-resident and streaming paths."""
         trainer = self.trainer
         o = self.cfg.optim
         s = self.cfg.sparsity
-        max_samples = int(self.data.X_train.shape[1])
+        max_samples = self._max_samples()
         epochs_tail = max(o.epochs - 1, 0)
+        Ms = pt.tree_stack_index(mask_pers, sampled_idx)
 
+        def per_client(m, rng, Xc, yc, nc):
+            w_per = jax.tree.map(jnp.multiply, params, m)
+            dense = P.density_all_leaves(w_per)
+            cs_c = ClientState(params=w_per, batch_stats=bstats,
+                               opt_state=trainer.opt.init(w_per),
+                               rng=rng)
+            # epoch 1, then fake_prune -> m1
+            cs_c, loss1 = trainer.local_train(
+                cs_c, Xc, yc, nc, lr, epochs=1, batch_size=o.batch_size,
+                max_samples=max_samples, mask=m)
+            m1 = P.fake_prune(s.each_prune_ratio, cs_c.params, m)
+            # remaining epochs, then fake_prune -> m2
+            if epochs_tail:
+                cs_c, loss2 = trainer.local_train(
+                    cs_c, Xc, yc, nc, lr, epochs=epochs_tail,
+                    batch_size=o.batch_size, max_samples=max_samples,
+                    mask=m)
+                loss = (loss1 + epochs_tail * loss2) / o.epochs
+            else:
+                loss = loss1
+            m2 = P.fake_prune(s.each_prune_ratio, cs_c.params, m)
+            dist = P.mask_distance_mean(m1, m2)
+
+            # accept-test: acc of the m2-pruned model on TRAIN data
+            pruned = jax.tree.map(jnp.multiply, cs_c.params, m2)
+            valid = jnp.arange(Xc.shape[0]) < nc
+            metrics = trainer.evaluate(pruned, cs_c.batch_stats, Xc, yc,
+                                       valid)
+            acc = metrics["test_correct"] / jnp.maximum(
+                metrics["test_total"], 1.0)
+            accept = ((dist > s.dist_thresh)
+                      & (dense > s.dense_ratio)
+                      & (acc > s.acc_thresh))
+            sel = lambda a, b: jax.tree.map(
+                lambda x, y: jnp.where(accept, x, y), a, b)
+            new_params = sel(pruned, cs_c.params)
+            new_mask = sel(m2, m)
+            return (new_params, cs_c.batch_stats, new_mask, loss, dist,
+                    accept)
+
+        (new_p, new_b, new_m, losses, dists, accepts) = jax.vmap(
+            per_client)(Ms, rngs, Xs, ys, ns)
+
+        # ---- overlap-count aggregation against the OLD masks ----
+        count = jax.tree.map(lambda m: jnp.sum(m, axis=0), Ms)
+        summed = jax.tree.map(lambda w: jnp.sum(w.astype(jnp.float32),
+                                                axis=0), new_p)
+        agg = jax.tree.map(
+            lambda sm, ct, old: jnp.where(ct > 0, sm
+                                          / jnp.maximum(ct, 1.0), old),
+            summed, count, params)
+        new_bstats = jax.tree.map(
+            lambda b: jnp.mean(b.astype(jnp.float32), axis=0), new_b)
+        # scatter updated personal masks back
+        mask_pers = jax.tree.map(
+            lambda allm, nm: allm.at[sampled_idx].set(nm), mask_pers,
+            new_m)
+        mean_loss = jnp.mean(losses)
+        # per-sampled-client nnz of the NEW masks: the true uplink volume
+        # (reference nonzero-comm metric, model_trainer.py:49-53)
+        up_nnz = jax.vmap(lambda m: sum(
+            jnp.sum(x) for x in jax.tree.leaves(m)))(new_m)
+        return (agg, new_bstats, mask_pers, mean_loss,
+                jnp.mean(dists), jnp.sum(accepts), jnp.sum(up_nnz))
+
+    @functools.cached_property
+    def _round_jit(self):
         def round_fn(params, bstats, mask_pers, data, sampled_idx, rngs, lr):
             Xs = jnp.take(data.X_train, sampled_idx, axis=0)
             ys = jnp.take(data.y_train, sampled_idx, axis=0)
             ns = jnp.take(data.n_train, sampled_idx, axis=0)
-            Ms = pt.tree_stack_index(mask_pers, sampled_idx)
-
-            def per_client(m, rng, Xc, yc, nc):
-                w_per = jax.tree.map(jnp.multiply, params, m)
-                dense = P.density_all_leaves(w_per)
-                cs_c = ClientState(params=w_per, batch_stats=bstats,
-                                   opt_state=trainer.opt.init(w_per),
-                                   rng=rng)
-                # epoch 1, then fake_prune -> m1
-                cs_c, loss1 = trainer.local_train(
-                    cs_c, Xc, yc, nc, lr, epochs=1, batch_size=o.batch_size,
-                    max_samples=max_samples, mask=m)
-                m1 = P.fake_prune(s.each_prune_ratio, cs_c.params, m)
-                # remaining epochs, then fake_prune -> m2
-                if epochs_tail:
-                    cs_c, loss2 = trainer.local_train(
-                        cs_c, Xc, yc, nc, lr, epochs=epochs_tail,
-                        batch_size=o.batch_size, max_samples=max_samples,
-                        mask=m)
-                    loss = (loss1 + epochs_tail * loss2) / o.epochs
-                else:
-                    loss = loss1
-                m2 = P.fake_prune(s.each_prune_ratio, cs_c.params, m)
-                dist = P.mask_distance_mean(m1, m2)
-
-                # accept-test: acc of the m2-pruned model on TRAIN data
-                pruned = jax.tree.map(jnp.multiply, cs_c.params, m2)
-                valid = jnp.arange(Xc.shape[0]) < nc
-                metrics = trainer.evaluate(pruned, cs_c.batch_stats, Xc, yc,
-                                           valid)
-                acc = metrics["test_correct"] / jnp.maximum(
-                    metrics["test_total"], 1.0)
-                accept = ((dist > s.dist_thresh)
-                          & (dense > s.dense_ratio)
-                          & (acc > s.acc_thresh))
-                sel = lambda a, b: jax.tree.map(
-                    lambda x, y: jnp.where(accept, x, y), a, b)
-                new_params = sel(pruned, cs_c.params)
-                new_mask = sel(m2, m)
-                return (new_params, cs_c.batch_stats, new_mask, loss, dist,
-                        accept)
-
-            (new_p, new_b, new_m, losses, dists, accepts) = jax.vmap(
-                per_client)(Ms, rngs, Xs, ys, ns)
-
-            # ---- overlap-count aggregation against the OLD masks ----
-            count = jax.tree.map(lambda m: jnp.sum(m, axis=0), Ms)
-            summed = jax.tree.map(lambda w: jnp.sum(w.astype(jnp.float32),
-                                                    axis=0), new_p)
-            agg = jax.tree.map(
-                lambda sm, ct, old: jnp.where(ct > 0, sm
-                                              / jnp.maximum(ct, 1.0), old),
-                summed, count, params)
-            new_bstats = jax.tree.map(
-                lambda b: jnp.mean(b.astype(jnp.float32), axis=0), new_b)
-            # scatter updated personal masks back
-            mask_pers = jax.tree.map(
-                lambda allm, nm: allm.at[sampled_idx].set(nm), mask_pers,
-                new_m)
-            mean_loss = jnp.mean(losses)
-            return (agg, new_bstats, mask_pers, mean_loss,
-                    jnp.mean(dists), jnp.sum(accepts))
+            return self._round_body(params, bstats, mask_pers, Xs, ys, ns,
+                                    sampled_idx, rngs, lr)
 
         return jax.jit(round_fn)
+
+    @functools.cached_property
+    def _round_stream_jit(self):
+        return jax.jit(self._round_body)
 
     @functools.cached_property
     def _eval_masked_global_jit(self):
@@ -148,12 +166,33 @@ class SubFedAvgEngine(FederatedEngine):
         return jax.jit(eval_all)
 
     def eval_masked_global(self, params, bstats, mask_pers) -> dict:
+        if self.stream is not None:
+            return self.eval_masked_global_stream(params, bstats, mask_pers)
         X, y, n = self.data.X_test, self.data.y_test, self.data.n_test
         if self.cfg.fed.ci:
             X, y, n = X[:1], y[:1], n[:1]
             mask_pers = pt.tree_stack_index(mask_pers, slice(0, 1))
         out = self._eval_masked_global_jit(params, bstats, mask_pers, X, y, n)
         return self._summarize(*out, n=n)
+
+    def eval_masked_global_stream(self, params, bstats, mask_pers) -> dict:
+        """Streamed variant: test shards arrive in client chunks; each
+        chunk's personal masks are gathered from the resident stack."""
+        chunk = self._eval_chunk_size()
+        parts, ns = [], []
+        for ch in self.stream.eval_chunks(chunk, "test"):
+            m = pt.tree_stack_index(mask_pers, ch.padded_ids)
+            out = self._eval_masked_global_jit(params, bstats, m, ch.X,
+                                               ch.y, ch.n)
+            parts.append(tuple(np.asarray(o)[: len(ch.ids)] for o in out))
+            ns.append(np.asarray(jax.device_get(ch.n))[: len(ch.ids)])
+            if self.cfg.fed.ci:
+                break
+        cat = [np.concatenate([p[i] for p in parts]) for i in range(4)]
+        n_all = np.concatenate(ns)
+        if self.cfg.fed.ci:
+            cat, n_all = [c[:1] for c in cat], n_all[:1]
+        return self._summarize(*cat, n=n_all)
 
     def train(self):
         cfg = self.cfg
@@ -171,21 +210,36 @@ class SubFedAvgEngine(FederatedEngine):
         if restored is not None:
             params, bstats = restored["params"], restored["batch_stats"]
             mask_pers, history = restored["mask_pers"], restored["history"]
+        if self.stream is not None:
+            self.stream.prefetch_train(self.client_sampling(start))
         for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
             rngs = self.per_client_rngs(round_idx, sampled)
-            (params, bstats, mask_pers, loss, mean_dist, n_accept) = \
-                self._round_jit(params, bstats, mask_pers, self.data,
-                                jnp.asarray(sampled), rngs,
-                                self.round_lr(round_idx))
-            n_samples = float(np.sum(np.asarray(self.data.n_train)[sampled]))
+            if self.stream is not None:
+                Xs, ys, ns = self.stream.get_train(sampled)
+                if round_idx + 1 < cfg.fed.comm_round:
+                    self.stream.prefetch_train(
+                        self.client_sampling(round_idx + 1))
+                (params, bstats, mask_pers, loss, mean_dist, n_accept,
+                 up_nnz) = self._round_stream_jit(
+                    params, bstats, mask_pers, Xs, ys, ns,
+                    jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+            else:
+                (params, bstats, mask_pers, loss, mean_dist, n_accept,
+                 up_nnz) = self._round_jit(
+                    params, bstats, mask_pers, self.data,
+                    jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+            n_samples = float(np.sum(self._n_train_host[sampled]))
             self.stat_info["sum_training_flops"] += (
                 flops_per_sample * cfg.optim.epochs * n_samples)
-            # down: dense w_global; up: pruned client models (bounded by
-            # dense count; we log the bound — exact nnz needs a device pull)
-            self.stat_info["sum_comm_params"] += 2.0 * n_params * len(sampled)
+            # down: the dense w_global per sampled client; up: the pruned
+            # client models' TRUE nonzero count (reference nonzero-comm
+            # metric, model_trainer.py:49-53) — computed inside the round
+            # program, so the "device pull" is one scalar
+            self.stat_info["sum_comm_params"] += (
+                n_params * len(sampled) + float(up_nnz))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 mp = self.eval_masked_global(params, bstats, mask_pers)

@@ -5,9 +5,11 @@ The reference's TurboAggregate is a vanilla-FedAvg scaffold
 standalone finite-field MPC toolkit (mpc_function.py:4-275). Here the
 toolkit (ops/mpc.py) is actually WIRED into the round: each sampled client's
 weighted model is fixed-point-quantized into GF(p), split into additive
-secret shares (Gen_Additive_SS semantics), the server sums only the share
-sums, and the aggregate is dequantized — the server never sees an individual
-client's update in the clear. Exactness: the share sum equals the plain
+secret shares (Gen_Additive_SS semantics), the server accumulates each share
+SLOT across all clients and only combines slots at the very end, and the
+aggregate is dequantized — the server never sees an individual client's
+update in the clear (every pre-final intermediate is uniformly-random
+masked; tests/test_mpc.py asserts it). Exactness: the share sum equals the plain
 weighted sum mod p, so the only deviation from FedAvg is fixed-point
 rounding (2^-frac_bits per parameter, default 2^-16).
 
@@ -29,9 +31,6 @@ from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.fedavg import FedAvgEngine
 from neuroimagedisttraining_tpu.ops import mpc
 from neuroimagedisttraining_tpu.utils import pytree as pt
-
-FRAC_BITS = 16
-N_SHARES = 3  # shares per client update (paper: one per neighbor group)
 
 
 class TurboAggregateEngine(FedAvgEngine):
@@ -90,25 +89,24 @@ class TurboAggregateEngine(FedAvgEngine):
 
     def secure_aggregate(self, weighted_stacked, call_idx: int):
         """Additive-share aggregation over GF(p): quantize each client's
-        weighted update, share it N_SHARES ways, sum shares, reconstruct.
+        weighted update, share it ``mpc_n_shares`` ways, accumulate
+        slot-major (share slot j across ALL clients before combining any
+        slots — ops/mpc.py secure_sum), reconstruct. No server-side
+        intermediate equals an individual client's quantized update
+        (tested in tests/test_mpc.py).
 
         The share randomness cancels EXACTLY in the sum (additive shares by
         construction), so the aggregate is independent of ``call_idx``/rng —
         the seed only decorrelates the masking material across calls."""
+        f = self.cfg.fed
         rng = np.random.default_rng(self.cfg.seed * 7919 + call_idx)
         leaves, treedef = jax.tree.flatten(weighted_stacked)
         out = []
         for leaf in leaves:
             arr = np.asarray(jax.device_get(leaf))  # [S, ...]
-            acc = np.zeros(arr.shape[1:], np.int64)
-            for c in range(arr.shape[0]):
-                q = mpc.quantize(arr[c], frac_bits=FRAC_BITS)
-                shares = mpc.additive_shares(q, N_SHARES, rng=rng)
-                # server only ever sums shares; the per-client update is
-                # never reconstructed individually
-                acc = (acc + shares.sum(axis=0)) % mpc.P_DEFAULT
-            out.append(jnp.asarray(
-                mpc.dequantize(acc, frac_bits=FRAC_BITS), jnp.float32))
+            agg = mpc.secure_sum(arr, n_shares=f.mpc_n_shares,
+                                 frac_bits=f.mpc_frac_bits, rng=rng)
+            out.append(jnp.asarray(agg, jnp.float32))
         return jax.tree.unflatten(treedef, out)
 
     @functools.cached_property

@@ -25,6 +25,8 @@ def test_flag_mapping_reference_names():
         "--comm_round", "200", "--dense_ratio", "0.2",
         "--itersnip_iteration", "20", "--stratified_sampling",
         "--each_prune_ratio", "0.2", "--lamda", "0.75", "--seed", "7",
+        "--mpc_n_shares", "5", "--mpc_frac_bits", "20",
+        "--stream_chunk_clients", "2",
     ])
     cfg = config_from_args(args)
     assert cfg.algorithm == "salientgrads"
@@ -37,6 +39,8 @@ def test_flag_mapping_reference_names():
     assert cfg.sparsity.stratified_sampling is True
     assert cfg.sparsity.each_prune_ratio == 0.2
     assert cfg.fed.lamda == 0.75
+    assert cfg.fed.mpc_n_shares == 5 and cfg.fed.mpc_frac_bits == 20
+    assert cfg.stream_chunk_clients == 2
     assert cfg.seed == 7
     assert "salientgrads" in cfg.identity() and "seed7" in cfg.identity()
 
@@ -96,7 +100,7 @@ def test_streaming_rejected_for_unsupported_algorithm(tmp_path):
     write_synthetic_hdf5(path, num_subjects=16, shape=(8, 8, 8),
                          num_sites=2, seed=0)
     cfg = config_from_args(_parse([
-        "--algorithm", "salientgrads", "--dataset", "abcd_h5",
+        "--algorithm", "fedfomo", "--dataset", "abcd_h5",
         "--data_dir", path, "--log_dir", str(tmp_path)]))
     with pytest.raises(ValueError, match="streaming"):
         build_experiment(cfg, streaming=True, console=False)

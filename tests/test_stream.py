@@ -4,6 +4,7 @@ The streaming feed must be an exact drop-in: a streamed FedAvg run sees
 bitwise-identical inputs to the device-resident run, so its metrics are
 identical (VERDICT r1 missing #2 acceptance)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -63,27 +64,32 @@ def test_fetch_rows_unsorted_and_duplicate_indices(h5_cohort):
     lazy["file"].close()
 
 
-def _run_fedavg(cohort_or_stream, streaming: bool, tmp_path, tag):
+def _run_algo(algo, cohort_or_stream, streaming: bool, tmp_path, tag,
+              **cfg_extra):
     cfg = ExperimentConfig(
-        model="3dcnn_tiny", num_classes=1, algorithm="fedavg",
+        model="3dcnn_tiny", num_classes=1, algorithm=algo,
         data=DataConfig(dataset="synthetic", partition_method="site"),
         optim=OptimConfig(lr=1e-2, batch_size=4, epochs=1),
         fed=FedConfig(client_num_in_total=4, comm_round=3, frac=0.5,
                       frequency_of_the_test=1),
-        log_dir=str(tmp_path), tag=tag)
+        log_dir=str(tmp_path), tag=tag, **cfg_extra)
     trainer = LocalTrainer(create_model(cfg.model, num_classes=1), cfg.optim,
                            num_classes=1)
     log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
                            console=False)
     if streaming:
-        engine = create_engine("fedavg", cfg, None, trainer, mesh=None,
+        engine = create_engine(algo, cfg, None, trainer, mesh=None,
                                logger=log, stream=cohort_or_stream)
     else:
         fed, _ = federate_cohort(cohort_or_stream, partition_method="site",
                                  mesh=None)
-        engine = create_engine("fedavg", cfg, fed, trainer, mesh=None,
+        engine = create_engine(algo, cfg, fed, trainer, mesh=None,
                                logger=log)
     return engine.train()
+
+
+def _run_fedavg(cohort_or_stream, streaming: bool, tmp_path, tag):
+    return _run_algo("fedavg", cohort_or_stream, streaming, tmp_path, tag)
 
 
 def test_streaming_fedavg_identical_to_resident(h5_cohort, tmp_path):
@@ -108,6 +114,132 @@ def test_streaming_fedavg_identical_to_resident(h5_cohort, tmp_path):
         assert r_res["auc"] == r_st["auc"]
     assert res["final_global"] == st["final_global"]
     assert res["final_personal"]["acc"] == st["final_personal"]["acc"]
+
+
+def test_streaming_salientgrads_identical_to_resident(h5_cohort, tmp_path):
+    """The FLAGSHIP algorithm streams: phase-1 SNIP scores accumulate over
+    streamed client chunks, phase-2 masked rounds stream the sampled
+    clients' shards — bitwise equal to the device-resident run
+    (VERDICT r2 next-step #1 acceptance)."""
+    path, data = h5_cohort
+    res = _run_algo("salientgrads", data, streaming=False,
+                    tmp_path=tmp_path, tag="sgres")
+    lazy = load_abcd_hdf5(path, lazy=True)
+    train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
+    stream = StreamingFederation(lazy["X"], lazy["y"], train_map, test_map)
+    try:
+        st = _run_algo("salientgrads", stream, streaming=True,
+                       tmp_path=tmp_path, tag="sgst")
+    finally:
+        stream.close()
+        lazy["file"].close()
+
+    # identical mask...
+    assert st["mask_density"] == res["mask_density"]
+    for a, b in zip(jax.tree.leaves(res["masks"]),
+                    jax.tree.leaves(st["masks"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ...identical rounds, metrics, and personal models
+    for r_res, r_st in zip(res["history"], st["history"]):
+        assert r_res["train_loss"] == r_st["train_loss"], (r_res, r_st)
+        assert r_res["acc"] == r_st["acc"]
+        assert r_res["auc"] == r_st["auc"]
+        assert r_res["personal_acc"] == r_st["personal_acc"]
+    assert res["final_global"] == st["final_global"]
+    assert res["final_personal"] == st["final_personal"]
+
+
+def _open_stream(path):
+    lazy = load_abcd_hdf5(path, lazy=True)
+    train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
+    return lazy, StreamingFederation(lazy["X"], lazy["y"], train_map,
+                                     test_map)
+
+
+def test_streaming_subavg_identical_to_resident(h5_cohort, tmp_path):
+    """Sub-FedAvg streams its sampled clients' shards per round; personal
+    masks stay resident. Streamed == resident bitwise."""
+    path, data = h5_cohort
+    res = _run_algo("subavg", data, streaming=False, tmp_path=tmp_path,
+                    tag="sares")
+    lazy, stream = _open_stream(path)
+    try:
+        st = _run_algo("subavg", stream, streaming=True, tmp_path=tmp_path,
+                       tag="sast")
+    finally:
+        stream.close()
+        lazy["file"].close()
+    for r_res, r_st in zip(res["history"], st["history"]):
+        assert r_res["train_loss"] == r_st["train_loss"], (r_res, r_st)
+        assert r_res["personal_acc"] == r_st["personal_acc"]
+    assert res["final_personal"] == st["final_personal"]
+    np.testing.assert_array_equal(res["client_densities"],
+                                  st["client_densities"])
+
+
+def test_streaming_dispfl_identical_to_resident(h5_cohort, tmp_path):
+    """DisPFL trains every client per round, so the streamed round chunks
+    local training (chunk=2 < 4 clients exercises real chunking); the
+    consensus einsum runs on resident state. Streamed == resident."""
+    path, data = h5_cohort
+    res = _run_algo("dispfl", data, streaming=False, tmp_path=tmp_path,
+                    tag="dpres")
+    lazy, stream = _open_stream(path)
+    try:
+        st = _run_algo("dispfl", stream, streaming=True, tmp_path=tmp_path,
+                       tag="dpst", stream_chunk_clients=2)
+    finally:
+        stream.close()
+        lazy["file"].close()
+    for r_res, r_st in zip(res["history"], st["history"]):
+        # the scalar loss DIAGNOSTIC is reduced inside the fused resident
+        # program but in a separate program when chunked — XLA may
+        # reassociate that one reduce, so allow ulp-level slack there; the
+        # STATE comparisons below stay exact
+        np.testing.assert_allclose(r_st["train_loss"], r_res["train_loss"],
+                                   rtol=1e-6)
+        assert r_res["personal_acc"] == r_st["personal_acc"]
+        assert r_res["mask_change"] == r_st["mask_change"]
+    assert res["final_personal"] == st["final_personal"]
+    np.testing.assert_array_equal(res["mask_dis_matrix"],
+                                  st["mask_dis_matrix"])
+
+
+def test_streaming_salientgrads_chunked_phase1(h5_cohort, tmp_path):
+    """Phase-1 SNIP accumulation over chunk=2 < 4 clients (two chunks)
+    still reproduces the resident global mask and rounds."""
+    path, data = h5_cohort
+    res = _run_algo("salientgrads", data, streaming=False,
+                    tmp_path=tmp_path, tag="sgres2")
+    lazy, stream = _open_stream(path)
+    try:
+        st = _run_algo("salientgrads", stream, streaming=True,
+                       tmp_path=tmp_path, tag="sgst2",
+                       stream_chunk_clients=2)
+    finally:
+        stream.close()
+        lazy["file"].close()
+    assert st["mask_density"] == res["mask_density"]
+    for a, b in zip(jax.tree.leaves(res["masks"]),
+                    jax.tree.leaves(st["masks"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for r_res, r_st in zip(res["history"], st["history"]):
+        assert r_res["train_loss"] == r_st["train_loss"], (r_res, r_st)
+    assert res["final_global"] == st["final_global"]
+
+
+def test_streaming_rejects_unsupported_engine(h5_cohort, tmp_path):
+    path, data = h5_cohort
+    lazy = load_abcd_hdf5(path, lazy=True)
+    train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
+    stream = StreamingFederation(lazy["X"], lazy["y"], train_map, test_map)
+    try:
+        with pytest.raises(ValueError, match="does not support --streaming"):
+            _run_algo("fedfomo", stream, streaming=True,
+                      tmp_path=tmp_path, tag="rej")
+    finally:
+        stream.close()
+        lazy["file"].close()
 
 
 def test_streaming_checkpoint_resume(h5_cohort, tmp_path):
