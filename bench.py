@@ -73,8 +73,11 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", 16))
     n_clients = int(os.environ.get("BENCH_CLIENTS", 4))
     n_rounds = int(os.environ.get("BENCH_ROUNDS", 3))
-    n_local = 64
-    shape = (121, 145, 121)
+    n_local = int(os.environ.get("BENCH_LOCAL", 64))
+    # BENCH_SHAPE="12,14,12" shrinks volumes for CPU smoke runs of the
+    # bench harness itself; real numbers use the default ABCD shape
+    shape = tuple(int(s) for s in
+                  os.environ.get("BENCH_SHAPE", "121,145,121").split(","))
     epochs = 1
     steps = -(-n_local // batch)  # ceil: local steps per client per epoch
 
@@ -97,11 +100,10 @@ def main() -> None:
                         X_test=X[:, :8], y_test=y[:, :8],
                         n_test=jnp.full((n_clients,), 8, jnp.int32))
 
-    from neuroimagedisttraining_tpu.models import AlexNet3D_Dropout
-
     remat_env = os.environ.get("BENCH_REMAT", "0")
     remat: bool | str = {"0": False, "1": True}.get(remat_env, remat_env)
-    model = AlexNet3D_Dropout(num_classes=1, dtype=jnp.bfloat16, remat=remat)
+    model = create_model(os.environ.get("BENCH_MODEL", "3DCNN"),
+                         num_classes=1, dtype=jnp.bfloat16, remat=remat)
     trainer = LocalTrainer(model, cfg.optim, num_classes=1)
     log = ExperimentLogger("/tmp/nidt_bench", "synthetic", cfg.identity(),
                            console=False)
@@ -159,6 +161,99 @@ def main() -> None:
     _mask_sync(masks)
     mask_ms = (time.perf_counter() - t0) * 1e3
 
+    # ---- phase 3: one-round TPU timings for the remaining engine
+    # programs (VERDICT r2 next-step #4: einsum-consensus, sort-based
+    # percentile prune, pair-list fomo weights had no recorded numbers).
+    # Best-of-REPS wall time for ONE round at the flagship shape.
+    algo_round_s: dict[str, float] = {}
+    if os.environ.get("BENCH_ALGO_PHASES", "1") != "0":
+        import dataclasses
+
+        from neuroimagedisttraining_tpu.utils import pytree as pt
+
+        def _sync(*arrs):
+            return sum(float(jnp.sum(a.astype(jnp.float32))
+                             if hasattr(a, "astype") else 0.0)
+                       for a in arrs)
+
+        def _bestof(fn):
+            fn()  # compile + warmup
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        C = n_clients
+        rngs_all = engine.per_client_rngs(1, np.arange(C))
+        lr = engine.round_lr(1)
+
+        # DisPFL: masked einsum consensus + local train + fire/regrow
+        dp = create_engine("dispfl", dataclasses.replace(
+            cfg, algorithm="dispfl"), fed, trainer, logger=log)
+        m_local, _ = dp.init_masks_all(params)
+        dper = dp.broadcast_states(
+            gs.__class__(params=params, batch_stats=bstats, opt_state=None,
+                         rng=None), C)
+        dpp = jax.tree.map(jnp.multiply, dper.params, m_local)
+        A_dp = jnp.asarray(dp.adjacency(1, dp.active_draw(1)))
+
+        def dispfl_round():
+            out = dp._round_jit(dpp, dper.batch_stats, m_local, m_local,
+                                fed, A_dp, rngs_all, lr, jnp.float32(1))
+            _sync(out[-1], jax.tree.leaves(out[0])[0])
+
+        algo_round_s["dispfl"] = _bestof(dispfl_round)
+
+        # D-PSGD: gossip mixing-matrix consensus + local train
+        dg = create_engine("dpsgd", dataclasses.replace(
+            cfg, algorithm="dpsgd"), fed, trainer, logger=log)
+        M_mix = jnp.asarray(dg.mixing_matrix(1))
+
+        def dpsgd_round():
+            out = dg._round_jit(dper.params, dper.batch_stats, fed, M_mix,
+                                rngs_all, lr)
+            _sync(out[-1], jax.tree.leaves(out[0])[0])
+
+        algo_round_s["dpsgd"] = _bestof(dpsgd_round)
+
+        # SubAvg: masked train + per-client full-sort percentile prune +
+        # overlap-count aggregation
+        sa = create_engine("subavg", dataclasses.replace(
+            cfg, algorithm="subavg"), fed, trainer, logger=log)
+        from neuroimagedisttraining_tpu.ops.masks import ones_mask
+
+        sa_masks = sa.broadcast_states(ones_mask(params), C)
+
+        def subavg_round():
+            out = sa._round_jit(params, bstats, sa_masks, fed, sampled,
+                                rngs_all[: len(sampled)], lr)
+            _sync(out[3], jax.tree.leaves(out[0])[0])
+
+        algo_round_s["subavg"] = _bestof(subavg_round)
+
+        # FedFomo: local train + pair-list val-loss/distance weights +
+        # delta aggregation (needs a val split)
+        fed_val = dataclasses.replace(
+            fed, X_val=fed.X_test, y_val=fed.y_test, n_val=fed.n_test)
+        fo = create_engine("fedfomo", dataclasses.replace(
+            cfg, algorithm="fedfomo"), fed_val, trainer, logger=log)
+        A_fo = np.zeros((C, C), np.float32)
+        for c in range(fo.real_clients):
+            A_fo[c, np.unique(fo.benefit_choose(1, c, np.ones(C)))] = 1.0
+        pc_, pn_, _np = fo.pairs_from_adjacency(A_fo)
+        W0 = jnp.full((C, C), 1.0 / C, jnp.float32)
+        P0 = jnp.ones((C, C), jnp.float32)
+
+        def fedfomo_round():
+            out = fo._round_jit(dper.params, dper.batch_stats, W0, P0,
+                                jnp.asarray(A_fo), jnp.asarray(pc_),
+                                jnp.asarray(pn_), fed_val, rngs_all, lr)
+            _sync(out[-1], jax.tree.leaves(out[0])[0])
+
+        algo_round_s["fedfomo"] = _bestof(fedfomo_round)
+
     scores = jax.random.uniform(jax.random.key(5), (1 << 22,))
     on_tpu = jax.default_backend() == "tpu"
     thr_pallas = kth_largest(scores, 1 << 21, use_pallas=on_tpu)
@@ -174,7 +269,8 @@ def main() -> None:
     print(json.dumps({
         "metric": "abcd_fedavg_train_samples_per_sec",
         "value": round(sps, 2),
-        "unit": f"samples/s (AlexNet3D 121x145x121, b{batch}, "
+        "unit": f"samples/s ({os.environ.get('BENCH_MODEL', '3DCNN')} "
+                f"{'x'.join(map(str, shape))}, b{batch}, "
                 f"{n_clients} clients, shipped FedAvgEngine round program)",
         "vs_baseline": round(sps / V100_BASELINE_SAMPLES_PER_SEC, 3),
         "gflops_per_sample": round(flops_per_sample / 1e9, 2),
@@ -183,6 +279,11 @@ def main() -> None:
         "peak_tflops_assumed": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "salientgrads_mask_ms": round(mask_ms, 1),
+        "algo_round_s": {k: round(v, 3) for k, v in algo_round_s.items()}
+        or None,
+        "algo_round_samples_per_sec": {
+            k: round(n_clients * epochs * steps * batch / v, 1)
+            for k, v in algo_round_s.items()} or None,
         "pallas_topk_ms_4m": round(topk_ms, 1) if topk_ms else None,
         "pallas_threshold_matches_xla": pallas_ok,
         "timing": f"best of {reps} repeats (shared-chip noise, PROFILE.md)",

@@ -121,6 +121,15 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "(0 = auto)")
     parser.add_argument("--checkpoint_dir", type=str, default="")
     parser.add_argument("--checkpoint_every", type=int, default=0)
+    parser.add_argument("--multihost_coordinator", type=str, default="",
+                        help="host:port of process 0; joins this process "
+                             "to a multi-host JAX runtime (TPU pod) before "
+                             "mesh construction (jax.distributed)")
+    parser.add_argument("--process_id", type=int, default=0,
+                        help="this process's rank in the multi-host "
+                             "runtime")
+    parser.add_argument("--num_processes", type=int, default=1,
+                        help="total processes in the multi-host runtime")
     parser.add_argument("--virtual_devices", type=int, default=0,
                         help="provision N virtual CPU devices (mesh "
                              "simulation without TPU hardware)")
@@ -276,6 +285,16 @@ def main(argv: list[str] | None = None) -> int:
             provision_virtual_devices,
         )
         provision_virtual_devices(args.virtual_devices)
+
+    if args.multihost_coordinator:
+        # join the pod-wide JAX runtime BEFORE any backend touch so the
+        # mesh below spans every host's chips (SURVEY §2.9 DCN row; see
+        # README "Multi-host TPU pods" for the per-host launch recipe)
+        from neuroimagedisttraining_tpu.distributed.cross_silo import (
+            init_multihost,
+        )
+        init_multihost(args.multihost_coordinator, args.num_processes,
+                       args.process_id)
 
     if args.compile_cache_dir:
         import jax

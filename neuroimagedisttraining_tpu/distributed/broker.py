@@ -94,6 +94,13 @@ class MessageBroker:
                 conn, _ = self._server.accept()
             except OSError:
                 return
+            # bounded sends broker-side too: a hung subscriber (stopped
+            # reader, full TCP buffer) must not wedge the serving thread
+            # that is fanning out under that subscriber's write lock —
+            # same rationale as BrokerCommManager's SO_SNDTIMEO. Send-only:
+            # recv must still block indefinitely for idle subscribers.
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                            struct.pack("ll", 30, 0))
             with self._lock:
                 self._conns.add(conn)
                 self._wlocks[conn] = threading.Lock()
@@ -126,7 +133,9 @@ class MessageBroker:
                     # subscription must queue behind the retained frame,
                     # so the newest message is never overtaken by a stale
                     # retained one
-                    wlock = self._wlocks[conn]
+                    wlock = self._wlocks.get(conn)  # stop()/_drop may race
+                    if wlock is None:
+                        break
                     with wlock:
                         with self._lock:
                             self._subs.setdefault(topic, []).append(conn)

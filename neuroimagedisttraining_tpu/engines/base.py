@@ -269,9 +269,19 @@ class FederatedEngine:
         leaves = jax.tree.leaves(stacked)
         if not leaves:  # e.g. batch_stats of a GroupNorm model
             return stacked
-        if is_two_level(self.mesh) and leaves[0].shape[0] % \
-                self.mesh.devices.size == 0:
-            return silo_then_global_mean(stacked, weights, self.mesh)
+        if is_two_level(self.mesh):
+            if leaves[0].shape[0] % self.mesh.devices.size == 0:
+                return silo_then_global_mean(stacked, weights, self.mesh)
+            if not getattr(self, "_warned_flat_fallback", False):
+                self._warned_flat_fallback = True
+                self.log.info(
+                    "two-level mesh: sampled-client axis (%d) does not "
+                    "tile the %d-device grid; falling back to the FLAT "
+                    "weighted mean (same result, but aggregation will NOT "
+                    "be routed silo-first over ICI/DCN). Choose frac so "
+                    "client_num_per_round is a multiple of the device "
+                    "count to keep the two-level routing.",
+                    leaves[0].shape[0], self.mesh.devices.size)
         return pt.tree_weighted_mean(stacked, weights)
 
     # ---------- streamed evaluation (cohort > HBM) ----------

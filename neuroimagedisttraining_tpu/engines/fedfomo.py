@@ -26,10 +26,15 @@ Behavior parity:
   in aggregation): all aggregation math here runs in float32 pytrees; there
   are no integer leaves in params by construction.
 
-TPU-native: one jitted round program; the val-loss matrix L[c, n] (loss of
-model n on client c's val shard) is computed by a lax.scan over model
-owners n with a vmapped evaluation over val shards c — O(C^2) evals with
-only O(C) model replication; aggregation is two einsums against the
+TPU-native: one jitted round program; the val-loss and parameter-distance
+matrices are computed ONLY at the (client, neighbor) pairs the round's
+adjacency selects — a lax.scan over the padded pair list, each step
+dynamically gathering one owner model — matching the reference's cost of
+evaluating just the RECEIVED models (fedfomo_api.py:147-171): per round
+that is at most real*(fomo_m+1) evaluations instead of C^2 (they coincide
+at full participation, where every client receives every model). Results
+are scattered into [C, C] matrices; non-pair entries are masked out by the
+adjacency before use. Aggregation is two einsums against the
 row-normalized ReLU weight matrix.
 """
 
@@ -87,6 +92,27 @@ class FedFomoEngine(FederatedEngine):
 
     # ---------- the round program ----------
 
+    def pairs_from_adjacency(self, A: np.ndarray):
+        """Static-shape (client, owner) pair list of the round's nonzero
+        adjacency entries. The pad size is fixed by the config (so the
+        round program compiles once): real*(m+1) under partial
+        participation, real^2 at full participation. Pad slots point at
+        (0, 0) — always a real pair (every client is its own neighbor), so
+        duplicate scatters write identical values."""
+        real = self.real_clients
+        per_round = min(self.cfg.fed.client_num_per_round, real)
+        if per_round == real:
+            P = real * real
+        else:
+            P = real * (min(self.cfg.fed.fomo_m, per_round) + 1)
+        cs, ns = np.nonzero(A[:real, :real])
+        assert len(cs) <= P, (len(cs), P)
+        pair_c = np.zeros(P, np.int32)
+        pair_n = np.zeros(P, np.int32)
+        pair_c[: len(cs)] = cs
+        pair_n[: len(ns)] = ns
+        return pair_c, pair_n, len(cs)
+
     @functools.cached_property
     def _round_jit(self):
         trainer = self.trainer
@@ -94,17 +120,8 @@ class FedFomoEngine(FederatedEngine):
         C = self.num_clients
         max_samples = int(self.data.X_train.shape[1])
 
-        def val_losses_of(params_n, bstats_n, data):
-            """Loss of ONE model on every client's val shard -> [C]."""
-            def per_val(Xv, yv, nv):
-                valid = jnp.arange(Xv.shape[0]) < nv
-                m = trainer.evaluate(params_n, bstats_n, Xv, yv, valid)
-                return m["test_loss"] / jnp.maximum(m["test_total"], 1.0)
-
-            return jax.vmap(per_val)(data.X_val, data.y_val, data.n_val)
-
-        def round_fn(per_params, per_bstats, weights, p_choose, A, data,
-                     rngs, lr):
+        def round_fn(per_params, per_bstats, weights, p_choose, A,
+                     pair_c, pair_n, data, rngs, lr):
             lstrd_p, lstrd_b = per_params, per_bstats
 
             # --- 1. local training from own previous model ---
@@ -120,13 +137,29 @@ class FedFomoEngine(FederatedEngine):
                 lstrd_p, lstrd_b, rngs, data.X_train, data.y_train,
                 data.n_train)
 
-            # --- 2. val-loss matrix L[c, n] = loss of model n on val_c ---
-            def scan_owner(_, pn_bn):
-                pn, bn = pn_bn
-                return None, val_losses_of(pn, bn, data)
+            # --- 2+3. val-loss + parameter-distance at NEIGHBOR PAIRS
+            # only (reference evaluates just the received models,
+            # fedfomo_api.py:147-171): scan the pair list, gathering one
+            # owner model per step ---
+            def pair_step(_, cn):
+                c, n = cn
+                pn = pt.tree_stack_index(lstrd_p, n)
+                bn = pt.tree_stack_index(lstrd_b, n)
+                pc = pt.tree_stack_index(lstrd_p, c)
+                Xv = data.X_val[c]
+                yv = data.y_val[c]
+                nv = data.n_val[c]
+                valid = jnp.arange(Xv.shape[0]) < nv
+                m = trainer.evaluate(pn, bn, Xv, yv, valid)
+                lval = m["test_loss"] / jnp.maximum(m["test_total"], 1.0)
+                diff = pt.tree_sub(pn, pc)
+                return None, (lval, pt.tree_dot(diff, diff))
 
-            _, L_cols = jax.lax.scan(scan_owner, None, (lstrd_p, lstrd_b))
-            L = L_cols.T                       # [c, n]
+            _, (Lp, D2p) = jax.lax.scan(pair_step, None, (pair_c, pair_n))
+            L = jnp.zeros((C, C), jnp.float32).at[pair_c, pair_n].set(Lp)
+            D = jnp.sqrt(jnp.maximum(
+                jnp.zeros((C, C), jnp.float32).at[pair_c, pair_n].set(D2p),
+                0.0))
 
             def self_loss(p, b, Xv, yv, nv):
                 valid = jnp.arange(Xv.shape[0]) < nv
@@ -136,15 +169,6 @@ class FedFomoEngine(FederatedEngine):
             L_self = jax.vmap(self_loss)(new_p, new_b, data.X_val,
                                          data.y_val, data.n_val)
             loss_cur = jnp.diagonal(L)             # own lstrd model
-
-            # --- 3. parameter-distance matrix D[c, n] ---
-            def sq_dists_of(pn):
-                return jax.vmap(lambda pc: pt.tree_dot(
-                    pt.tree_sub(pn, pc), pt.tree_sub(pn, pc)))(lstrd_p)
-
-            _, D2_cols = jax.lax.scan(lambda _, pn: (None, sq_dists_of(pn)),
-                                      None, lstrd_p)
-            D = jnp.sqrt(jnp.maximum(D2_cols.T, 0.0))      # [c, n]
             d_self = jax.vmap(lambda a, b: pt.tree_norm(pt.tree_sub(a, b)))(
                 new_p, lstrd_p)
             D = D.at[jnp.arange(C), jnp.arange(C)].set(d_self)
@@ -219,11 +243,15 @@ class FedFomoEngine(FederatedEngine):
                 nei = np.unique(self.benefit_choose(round_idx, c, pch[c]))
                 A[c, nei] = 1.0
                 n_model_transfers += len(nei) - (1 if c in nei else 0)
-            self.log.info("################ round %d", round_idx)
+            pair_c, pair_n, n_pairs = self.pairs_from_adjacency(A)
+            self._last_eval_pairs = n_pairs  # true neighbor-eval count
+            self.log.info("################ round %d (%d neighbor evals)",
+                          round_idx, n_pairs)
             rngs = self.per_client_rngs(round_idx, np.arange(C))
             per_params, per_bstats, weights, p_choose, loss = \
                 self._round_jit(per_params, per_bstats, weights, p_choose,
-                                jnp.asarray(A), self.data, rngs,
+                                jnp.asarray(A), jnp.asarray(pair_c),
+                                jnp.asarray(pair_n), self.data, rngs,
                                 self.round_lr(round_idx))
             n_samples = float(np.sum(np.asarray(self.data.n_train)
                                      [: self.real_clients]))

@@ -60,6 +60,7 @@ from neuroimagedisttraining_tpu.models.darts import (  # noqa: F401
 from neuroimagedisttraining_tpu.models.meta import (  # noqa: F401
     CNNCifarMeta,
     MetaNet,
+    ResNetMeta,
 )
 from neuroimagedisttraining_tpu.models.vision2d import (  # noqa: F401
     VGG,
@@ -127,6 +128,8 @@ def create_model(name: str, num_classes: int = 1, dtype=jnp.float32,
                             dtype=dtype)
     if name in ("cnn_cifar10_meta", "cnn_meta"):
         return CNNCifarMeta(num_classes=num_classes, dtype=dtype)
+    if name in ("resnet_meta", "resnet20_meta"):
+        return ResNetMeta(num_classes=num_classes, dtype=dtype)
     raise ValueError(f"unknown model: {name!r}")
 
 

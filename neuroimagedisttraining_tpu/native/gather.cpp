@@ -26,18 +26,6 @@ void copy_span(const uint8_t* src, const int64_t* idx, int64_t row_bytes,
   }
 }
 
-void dequant_span(const uint8_t* src, const int64_t* idx, int64_t row_elems,
-                  float* dst, float scale, float shift, int64_t begin,
-                  int64_t end) {
-  for (int64_t i = begin; i < end; ++i) {
-    const uint8_t* s = src + idx[i] * row_elems;
-    float* d = dst + i * row_elems;
-    for (int64_t e = 0; e < row_elems; ++e) {
-      d[e] = static_cast<float>(s[e]) * scale + shift;
-    }
-  }
-}
-
 template <typename Fn>
 void parallel_rows(int64_t n_rows, int n_threads, Fn fn) {
   if (n_threads <= 1 || n_rows < 2) {
@@ -65,15 +53,6 @@ void nidt_gather_rows_u8(const uint8_t* src, const int64_t* idx,
                          int n_threads) {
   parallel_rows(n_rows, n_threads, [&](int64_t b, int64_t e) {
     copy_span(src, idx, row_bytes, dst, b, e);
-  });
-}
-
-// dst[i] = float(src[idx[i]]) * scale + shift (fused gather + dequantize).
-void nidt_gather_dequant_u8_f32(const uint8_t* src, const int64_t* idx,
-                                int64_t n_rows, int64_t row_elems, float* dst,
-                                float scale, float shift, int n_threads) {
-  parallel_rows(n_rows, n_threads, [&](int64_t b, int64_t e) {
-    dequant_span(src, idx, row_elems, dst, scale, shift, b, e);
   });
 }
 

@@ -84,10 +84,14 @@ def silo_then_global_mean(stacked: PyTree, weights: jax.Array, mesh: Mesh,
                                      wsum)
             clipped = norm_diff_clip(silo_mean, maybe_global[0], norm_bound)
             wsum = jax.tree.map(lambda c: c * wtot, clipped)
-        # cross-silo (DCN) reduction of one aggregate per silo
+        # cross-silo (DCN) reduction of one aggregate per silo; cast each
+        # leaf back to its input dtype so the two-level path matches the
+        # flat tree_weighted_mean for non-f32 leaves
         gsum = jax.tree.map(lambda s: jax.lax.psum(s, SILO_AXIS), wsum)
         gtot = jax.lax.psum(wtot, SILO_AXIS)
-        return jax.tree.map(lambda s: s / jnp.maximum(gtot, 1e-9), gsum)
+        return jax.tree.map(
+            lambda s, x: (s / jnp.maximum(gtot, 1e-9)).astype(x.dtype),
+            gsum, stacked)
 
     args = (stacked, weights)
     in_specs = (jax.tree.map(lambda _: spec, stacked), spec)

@@ -62,10 +62,6 @@ def load() -> ctypes.CDLL | None:
         lib.nidt_gather_rows_u8.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
-        lib.nidt_gather_dequant_u8_f32.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_void_p, ctypes.c_float,
-            ctypes.c_float, ctypes.c_int]
         _lib = lib
         return lib
 
@@ -93,22 +89,4 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
     lib.nidt_gather_rows_u8(
         src.ctypes.data, idx.ctypes.data, len(idx), row_bytes,
         dst.ctypes.data, n_threads)
-    return out
-
-
-def gather_dequant(src: np.ndarray, idx: np.ndarray, scale: float = 1.0,
-                   shift: float = 0.0,
-                   n_threads: int = DEFAULT_THREADS) -> np.ndarray:
-    """dst[i] = float32(src[idx[i]]) * scale + shift, fused."""
-    idx = np.ascontiguousarray(idx, np.int64)
-    lib = load()
-    if (lib is None or src.dtype != np.uint8
-            or not src.flags["C_CONTIGUOUS"]):
-        return src[idx].astype(np.float32) * scale + shift
-    row_elems = int(np.prod(src.shape[1:], dtype=np.int64))
-    out = np.empty((len(idx),) + src.shape[1:], np.float32)
-    lib.nidt_gather_dequant_u8_f32(
-        src.ctypes.data, idx.ctypes.data, len(idx), row_elems,
-        out.ctypes.data, ctypes.c_float(scale), ctypes.c_float(shift),
-        n_threads)
     return out

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Reproducible perf table (VERDICT r2 next-step #10): bench the flagship
+# configurations as a matrix and collect one JSON artifact per cell, so
+# round-over-round perf claims come from a rerunnable script instead of a
+# hand-run number.
+#
+#   ./scripts/run_bench_matrix.sh [outdir]
+#
+# Cells:
+#   {fedavg fast-path, salientgrads mask} x batch 16 x remat {none, stem}
+#   + per-algorithm round timings (dispfl/dpsgd/subavg/fedfomo, phase 3)
+#   + streaming samples/s on a synthetic larger-than-HBM-budget cohort
+#
+# Each bench.py invocation prints ONE JSON line; cells land in
+# $OUT/bench_<cell>.json and a combined $OUT/BENCH_MATRIX.json.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-bench_matrix}"
+mkdir -p "$OUT"
+
+run_cell() { # name, env...
+    local name="$1"; shift
+    echo "=== cell: $name ($*)" >&2
+    if env "$@" python bench.py > "$OUT/bench_$name.json"; then
+        echo "    -> $(cut -c1-160 "$OUT/bench_$name.json")" >&2
+    else
+        echo "    -> FAILED" >&2
+        echo "{\"metric\": \"$name\", \"error\": \"bench failed\"}" \
+            > "$OUT/bench_$name.json"
+    fi
+}
+
+# main matrix: remat policy sweep at the flagship shape (phase-3
+# per-algorithm timings ride along in the remat-none cell only — they
+# construct their own engines and dominate compile time otherwise)
+run_cell b16_remat_none  BENCH_BATCH=16 BENCH_REMAT=0 BENCH_ALGO_PHASES=1
+run_cell b16_remat_stem  BENCH_BATCH=16 BENCH_REMAT=stem BENCH_ALGO_PHASES=0
+
+# streaming throughput on a synthetic cohort sized beyond the resident
+# budget (round-granular host feed, double-buffered)
+python scripts/bench_streaming.py > "$OUT/bench_streaming.json" \
+    || echo '{"metric": "streaming", "error": "failed"}' \
+        > "$OUT/bench_streaming.json"
+echo "    -> $(cut -c1-160 "$OUT/bench_streaming.json")" >&2
+
+python - "$OUT" <<'EOF'
+import json, sys, glob, os
+out = sys.argv[1]
+combined = {}
+for p in sorted(glob.glob(os.path.join(out, "bench_*.json"))):
+    cell = os.path.basename(p)[len("bench_"):-len(".json")]
+    try:
+        combined[cell] = json.loads(open(p).read().strip().splitlines()[-1])
+    except Exception as e:
+        combined[cell] = {"error": str(e)}
+with open(os.path.join(out, "BENCH_MATRIX.json"), "w") as f:
+    json.dump(combined, f, indent=1)
+print(json.dumps({"cells": list(combined)}, indent=None))
+EOF

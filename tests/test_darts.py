@@ -155,6 +155,46 @@ def test_meta_models():
     assert np.all(np.isfinite(np.asarray(w)))
 
 
+def test_resnet_meta_slimmable_widths():
+    """ResNetMeta (resnet_meta_2.py analog): one parameter set serves every
+    width in CHANNEL_SCALE; kernels are hypernetwork-generated from the
+    scale vector and inactive channels are hard-masked to zero."""
+    from neuroimagedisttraining_tpu.models.meta import CHANNEL_SCALE, ResNetMeta
+
+    assert len(CHANNEL_SCALE) == 31                    # resnet_meta_2.py:8-10
+    assert CHANNEL_SCALE[0] == 0.10 and CHANNEL_SCALE[-1] == 1.00
+
+    model = ResNetMeta(num_classes=10)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    variables = model.init(jax.random.key(1), x)
+    full = model.apply(variables, x, train=False)
+    assert full.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(full)))
+
+    # a narrow width id produces a DIFFERENT function from the same params
+    narrow_ids = jnp.zeros((4,), jnp.int32)      # 0.10 width everywhere
+    narrow_mid = jnp.zeros((3,), jnp.int32)
+    narrow = model.apply(variables, x, stage_ids=narrow_ids,
+                         mid_ids=narrow_mid, train=False)
+    assert narrow.shape == (2, 10)
+    assert not np.allclose(np.asarray(full), np.asarray(narrow))
+
+    # the whole width sweep is ONE jitted program (scale ids are traced)
+    f = jax.jit(lambda sid, mid: model.apply(variables, x, stage_ids=sid,
+                                             mid_ids=mid, train=False))
+    a = f(narrow_ids, narrow_mid)
+    b = f(jnp.full((4,), 30, jnp.int32), jnp.full((3,), 30, jnp.int32))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(narrow),
+                               rtol=2e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(b), np.asarray(full),
+                               rtol=2e-5, atol=1e-5)
+
+    # train mode collects batch stats like the reference's affine-less BNs
+    out, mut = model.apply(variables, x, train=True,
+                           mutable=["batch_stats"])
+    assert "batch_stats" in mut
+
+
 def test_darts_trainer_step():
     """DartsTrainer (train.py semantics): aux-weighted loss, scheduled
     drop-path inside one jitted step; loss finite, params move, batch

@@ -34,15 +34,6 @@ def test_gather_rows_into_preallocated(lib):
     np.testing.assert_array_equal(out[4:], 0)
 
 
-def test_gather_dequant_fused(lib):
-    rng = np.random.default_rng(2)
-    src = rng.integers(0, 256, size=(16, 11), dtype=np.uint8)
-    idx = rng.integers(0, 16, size=8)
-    got = native.gather_dequant(src, idx, scale=1.0 / 255.0, shift=-0.5)
-    want = src[idx].astype(np.float32) / 255.0 - 0.5
-    np.testing.assert_allclose(got, want, rtol=1e-6)
-
-
 def test_numpy_fallback_for_non_u8():
     src = np.random.default_rng(3).normal(size=(8, 4)).astype(np.float32)
     idx = np.asarray([1, 5, 5])
