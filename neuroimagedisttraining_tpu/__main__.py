@@ -257,9 +257,11 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
             val_fraction=d.val_fraction)
         log.info("partition: %s", json.dumps(info.get("train_counts")))
 
-    # remat policy for the 3D family (PROFILE.md): no-remat is ~21% faster
-    # but only ~64 full-size samples fit in flight per chip; above that use
-    # stem remat (f0+f1 — same speed as full remat, less HBM).
+    # remat policy for the 3D family (PROFILE.md): no-remat is faster
+    # (b128 x 1 client/core measured 768 vs 611 samples/s against stem
+    # remat, round 3) and up to ~128 full-size samples fit in flight per
+    # chip without it; above that use stem remat (f0+f1 — same speed as
+    # full remat, less HBM).
     remat: bool | str | None
     if cfg.remat == "auto":
         import jax
@@ -267,7 +269,7 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
         n_dev = max(1, len(jax.devices()) if mesh is None
                     else mesh.devices.size)
         per_dev = -(-cfg.fed.client_num_per_round // n_dev)
-        remat = False if per_dev * cfg.optim.batch_size <= 64 else "stem"
+        remat = False if per_dev * cfg.optim.batch_size <= 128 else "stem"
     else:
         remat = {"none": False, "stem": "stem", "all": True}[cfg.remat]
     model = create_model(cfg.model, num_classes=cfg.num_classes, remat=remat)

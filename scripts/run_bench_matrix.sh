@@ -30,11 +30,15 @@ run_cell() { # name, env...
     fi
 }
 
-# main matrix: remat policy sweep at the flagship shape (phase-3
-# per-algorithm timings ride along in the remat-none cell only — they
-# construct their own engines and dominate compile time otherwise)
-run_cell b16_remat_none  BENCH_BATCH=16 BENCH_REMAT=0 BENCH_ALGO_PHASES=1
-run_cell b16_remat_stem  BENCH_BATCH=16 BENCH_REMAT=stem BENCH_ALGO_PHASES=0
+# main matrix (phase-3 per-algorithm timings ride along in the flagship
+# cell only — they construct their own engines and dominate compile time
+# otherwise):
+#   flagship = 1 client/chip, b128 (the deployment layout; bench default)
+#   parity   = 4 clients x b16 (the reference-canonical configuration)
+run_cell flagship_b128       BENCH_REMAT=0 BENCH_ALGO_PHASES=1
+run_cell flagship_b128_stem  BENCH_REMAT=stem BENCH_ALGO_PHASES=0
+run_cell parity_b16_4c       BENCH_CLIENTS=4 BENCH_BATCH=16 BENCH_LOCAL=64 \
+                             BENCH_REMAT=0 BENCH_ALGO_PHASES=0
 
 # streaming throughput on a synthetic cohort sized beyond the resident
 # budget (round-granular host feed, double-buffered)
