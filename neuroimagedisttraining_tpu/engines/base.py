@@ -311,6 +311,33 @@ class FederatedEngine:
             n_all = n_all[:1]
         return self._summarize(*cat, n=n_all)
 
+    def stream_map_train_chunks(self, block_fn, state_trees: tuple, rngs,
+                                *args):
+        """Run a vmapped per-client block over host-streamed TRAIN chunks
+        and concatenate the per-client outputs back into [C, ...] stacks
+        (the shared chunk loop of DisPFL/D-PSGD/Local streamed rounds).
+
+        ``block_fn(*state_chunks, rng_chunk, X, y, n, *args)`` must return
+        ``(*out_trees, per_client_aux_vector)``; outputs beyond the real
+        clients in the final padded chunk are dropped."""
+        chunk = self._eval_chunk_size()
+        parts: list[list] | None = None
+        aux_parts: list = []
+        for ch in self.stream.eval_chunks(chunk, "train"):
+            take = lambda t: pt.tree_stack_index(t, ch.padded_ids)
+            *trees, aux = block_fn(*(take(t) for t in state_trees),
+                                   rngs[ch.padded_ids], ch.X, ch.y, ch.n,
+                                   *args)
+            keep = len(ch.ids)
+            if parts is None:
+                parts = [[] for _ in trees]
+            for lst, t in zip(parts, trees):
+                lst.append(jax.tree.map(lambda x: x[:keep], t))
+            aux_parts.append(aux[:keep])
+        cat = lambda ps: jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *ps)
+        return tuple(cat(ps) for ps in parts), jnp.concatenate(aux_parts)
+
     def eval_personalized_stream(self, per_params, per_bstats,
                                  split: str = "test") -> dict[str, float]:
         """Personalized eval when only the STATE is device-resident: stream

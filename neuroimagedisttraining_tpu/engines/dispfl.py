@@ -277,24 +277,9 @@ class DisPFLEngine(FederatedEngine):
         outputs concatenate back into the stacked [C, ...] state."""
         w_local, b_mixed = self._consensus_jit(
             per_params, per_bstats, masks_local, masks_shared, A)
-        chunk = self._eval_chunk_size()
-        p_parts, b_parts, m_parts, l_parts = [], [], [], []
-        for ch in self.stream.eval_chunks(chunk, "train"):
-            take = lambda t: pt.tree_stack_index(t, ch.padded_ids)
-            new_p, new_b, new_m, losses = self._local_chunk_jit(
-                take(w_local), take(b_mixed), take(masks_local),
-                rngs[ch.padded_ids], ch.X, ch.y, ch.n, lr, round_idx)
-            keep = len(ch.ids)
-            trim = lambda t: jax.tree.map(lambda x: x[:keep], t)
-            p_parts.append(trim(new_p))
-            b_parts.append(trim(new_b))
-            m_parts.append(trim(new_m))
-            l_parts.append(losses[:keep])
-        cat = lambda parts: jax.tree.map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *parts)
-        new_p, new_b = cat(p_parts), cat(b_parts)
-        new_masks = cat(m_parts)
-        losses = jnp.concatenate(l_parts)
+        (new_p, new_b, new_masks), losses = self.stream_map_train_chunks(
+            self._local_chunk_jit, (w_local, b_mixed, masks_local), rngs,
+            lr, round_idx)
         dist_self, mean_loss = self._round_tail_jit(
             masks_shared, masks_local, losses,
             jnp.asarray(self._n_train_host))

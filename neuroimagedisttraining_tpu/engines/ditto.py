@@ -29,68 +29,80 @@ from neuroimagedisttraining_tpu.utils import pytree as pt
 
 class DittoEngine(FederatedEngine):
     name = "ditto"
+    # Streaming (cohort > HBM): both tracks only consume the SAMPLED
+    # clients' shards, so the streamed round has FedAvg's shape — data per
+    # round on device, persistent personal state resident.
+    supports_streaming = True
 
-    @functools.cached_property
-    def _round_jit(self):
+    def _round_body(self, params, bstats, per_params, per_bstats, Xs, ys,
+                    ns, sampled_idx, rngs, lr):
         trainer = self.trainer
         o = self.cfg.optim
         f = self.cfg.fed
-        S = min(f.client_num_per_round, self.real_clients)
-        max_samples = int(self.data.X_train.shape[1])
+        S = Xs.shape[0]
+        max_samples = self._max_samples()
         lamda = float(f.lamda)
 
+        def bcast(t):
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape), t)
+
+        # -- global track --
+        cs = ClientState(params=bcast(params), batch_stats=bcast(bstats),
+                         opt_state=bcast(trainer.opt.init(params)),
+                         rng=rngs)
+
+        def global_local(cs_c, Xc, yc, nc):
+            return trainer.local_train(
+                cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples)
+
+        cs, losses = jax.vmap(global_local)(cs, Xs, ys, ns)
+        w = ns.astype(jnp.float32)
+        new_params = pt.tree_weighted_mean(cs.params, w)
+        new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+
+        # -- personal track (persistent, proximal to incoming global) --
+        pp = jax.tree.map(lambda t: jnp.take(t, sampled_idx, axis=0),
+                          per_params)
+        pb = jax.tree.map(lambda t: jnp.take(t, sampled_idx, axis=0),
+                          per_bstats)
+        rngs2 = jax.vmap(lambda r: jax.random.fold_in(r, 1))(rngs)
+
+        def personal_local(p, b, rng, Xc, yc, nc):
+            cs_p = ClientState(params=p, batch_stats=b,
+                               opt_state=trainer.opt.init(p), rng=rng)
+            cs_p, _ = trainer.local_train(
+                cs_p, Xc, yc, nc, lr, epochs=f.local_epochs,
+                batch_size=o.batch_size, max_samples=max_samples,
+                prox_lamda=lamda, prox_ref=params)
+            return cs_p.params, cs_p.batch_stats
+
+        new_pp, new_pb = jax.vmap(personal_local)(pp, pb, rngs2, Xs, ys, ns)
+        per_params = jax.tree.map(
+            lambda allp, newp: allp.at[sampled_idx].set(newp),
+            per_params, new_pp)
+        per_bstats = jax.tree.map(
+            lambda allp, newp: allp.at[sampled_idx].set(newp),
+            per_bstats, new_pb)
+        mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        return new_params, new_bstats, per_params, per_bstats, mean_loss
+
+    @functools.cached_property
+    def _round_jit(self):
         def round_fn(params, bstats, per_params, per_bstats, data,
                      sampled_idx, rngs, lr):
             Xs = jnp.take(data.X_train, sampled_idx, axis=0)
             ys = jnp.take(data.y_train, sampled_idx, axis=0)
             ns = jnp.take(data.n_train, sampled_idx, axis=0)
-
-            def bcast(t):
-                return jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), t)
-
-            # -- global track --
-            cs = ClientState(params=bcast(params), batch_stats=bcast(bstats),
-                             opt_state=bcast(trainer.opt.init(params)),
-                             rng=rngs)
-
-            def global_local(cs_c, Xc, yc, nc):
-                return trainer.local_train(
-                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                    batch_size=o.batch_size, max_samples=max_samples)
-
-            cs, losses = jax.vmap(global_local)(cs, Xs, ys, ns)
-            w = ns.astype(jnp.float32)
-            new_params = pt.tree_weighted_mean(cs.params, w)
-            new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
-
-            # -- personal track (persistent, proximal to incoming global) --
-            pp = jax.tree.map(lambda t: jnp.take(t, sampled_idx, axis=0),
-                              per_params)
-            pb = jax.tree.map(lambda t: jnp.take(t, sampled_idx, axis=0),
-                              per_bstats)
-            rngs2 = jax.vmap(lambda r: jax.random.fold_in(r, 1))(rngs)
-
-            def personal_local(p, b, rng, Xc, yc, nc):
-                cs_p = ClientState(params=p, batch_stats=b,
-                                   opt_state=trainer.opt.init(p), rng=rng)
-                cs_p, _ = trainer.local_train(
-                    cs_p, Xc, yc, nc, lr, epochs=f.local_epochs,
-                    batch_size=o.batch_size, max_samples=max_samples,
-                    prox_lamda=lamda, prox_ref=params)
-                return cs_p.params, cs_p.batch_stats
-
-            new_pp, new_pb = jax.vmap(personal_local)(pp, pb, rngs2, Xs, ys, ns)
-            per_params = jax.tree.map(
-                lambda allp, newp: allp.at[sampled_idx].set(newp),
-                per_params, new_pp)
-            per_bstats = jax.tree.map(
-                lambda allp, newp: allp.at[sampled_idx].set(newp),
-                per_bstats, new_pb)
-            mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
-            return new_params, new_bstats, per_params, per_bstats, mean_loss
+            return self._round_body(params, bstats, per_params, per_bstats,
+                                    Xs, ys, ns, sampled_idx, rngs, lr)
 
         return jax.jit(round_fn)
+
+    @functools.cached_property
+    def _round_stream_jit(self):
+        return jax.jit(self._round_body)
 
     def train(self):
         cfg = self.cfg
@@ -107,18 +119,29 @@ class DittoEngine(FederatedEngine):
             per_params, per_bstats = (restored["per_params"],
                                       restored["per_bstats"])
             history = restored["history"]
+        if self.stream is not None:
+            self.stream.prefetch_train(self.client_sampling(start))
         for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             rngs = self.per_client_rngs(round_idx, sampled)
-            params, bstats, per_params, per_bstats, loss = self._round_jit(
-                params, bstats, per_params, per_bstats, self.data,
-                jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+            if self.stream is not None:
+                Xs, ys, ns = self.stream.get_train(sampled)
+                if round_idx + 1 < cfg.fed.comm_round:
+                    self.stream.prefetch_train(
+                        self.client_sampling(round_idx + 1))
+                (params, bstats, per_params, per_bstats,
+                 loss) = self._round_stream_jit(
+                    params, bstats, per_params, per_bstats, Xs, ys, ns,
+                    jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+            else:
+                (params, bstats, per_params, per_bstats,
+                 loss) = self._round_jit(
+                    params, bstats, per_params, per_bstats, self.data,
+                    jnp.asarray(sampled), rngs, self.round_lr(round_idx))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
-                m = self.eval_personalized(ClientState(
-                    params=per_params, batch_stats=per_bstats,
-                    opt_state=None, rng=None))
-                mg = self.eval_global(params, bstats)
+                m = self._eval_p(per_params, per_bstats)
+                mg = self._eval_g(params, bstats)
                 self.stat_info["person_test_acc"].append(m["acc"])
                 self.log.metrics(round_idx, train_loss=loss,
                                  personal=m, global_=mg)
@@ -130,8 +153,6 @@ class DittoEngine(FederatedEngine):
                 "params": params, "batch_stats": bstats,
                 "per_params": per_params, "per_bstats": per_bstats,
                 "history": history})
-        m = self.eval_personalized(ClientState(
-            params=per_params, batch_stats=per_bstats, opt_state=None,
-            rng=None))
+        m = self._eval_p(per_params, per_bstats)
         return {"params": params, "personal_params": per_params,
                 "history": history, "final_personal": m}

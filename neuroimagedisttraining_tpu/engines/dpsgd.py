@@ -71,41 +71,83 @@ class DPSGDEngine(FederatedEngine):
             M[c, c] = 1.0
         return M
 
-    @functools.cached_property
-    def _round_jit(self):
+    # Streaming (cohort > HBM): like DisPFL, every client trains each
+    # round, so the streamed round runs the state-only gossip consensus
+    # first and then local-trains client CHUNKS against host-fetched
+    # shards.
+    supports_streaming = True
+
+    def _consensus(self, per_params, per_bstats, M):
+        """Gossip consensus over last round's models: one all-to-all
+        matmul against the mixing matrix."""
+        mix = lambda t: jax.tree.map(
+            lambda x: jnp.einsum("cj,j...->c...", M, x), t)
+        return mix(per_params), mix(per_bstats)
+
+    def _local_block(self, mixed_p, mixed_b, rngs, X, y, n, lr):
         trainer = self.trainer
         o = self.cfg.optim
-        max_samples = int(self.data.X_train.shape[1])
+        max_samples = self._max_samples()
 
+        def local(p, b, rng, Xc, yc, nc):
+            cs = ClientState(params=p, batch_stats=b,
+                             opt_state=trainer.opt.init(p), rng=rng)
+            cs, loss = trainer.local_train(
+                cs, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples)
+            return cs.params, cs.batch_stats, loss
+
+        return jax.vmap(local)(mixed_p, mixed_b, rngs, X, y, n)
+
+    @staticmethod
+    def _global_mean(new_p, new_b, n_train):
+        real = (n_train > 0).astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(real), 1.0)
+        gmean = lambda t: jax.tree.map(
+            lambda x: jnp.einsum(
+                "c,c...->...", real / denom, x.astype(jnp.float32)
+            ).astype(x.dtype), t)
+        return gmean(new_p), gmean(new_b), real, denom
+
+    @functools.cached_property
+    def _round_jit(self):
         def round_fn(per_params, per_bstats, data, M, rngs, lr):
-            # consensus over last round's models: one all-to-all matmul
-            mix = lambda t: jnp.einsum("cj,j...->c...", M, t)
-            mixed_p = jax.tree.map(mix, per_params)
-            mixed_b = jax.tree.map(mix, per_bstats)
-
-            def local(p, b, rng, Xc, yc, nc):
-                cs = ClientState(params=p, batch_stats=b,
-                                 opt_state=trainer.opt.init(p), rng=rng)
-                cs, loss = trainer.local_train(
-                    cs, Xc, yc, nc, lr, epochs=o.epochs,
-                    batch_size=o.batch_size, max_samples=max_samples)
-                return cs.params, cs.batch_stats, loss
-
-            new_p, new_b, losses = jax.vmap(local)(
+            mixed_p, mixed_b = self._consensus(per_params, per_bstats, M)
+            new_p, new_b, losses = self._local_block(
                 mixed_p, mixed_b, rngs, data.X_train, data.y_train,
-                data.n_train)
-            real = (data.n_train > 0).astype(jnp.float32)
-            denom = jnp.maximum(jnp.sum(real), 1.0)
-            gmean = lambda t: jax.tree.map(
-                lambda x: jnp.einsum(
-                    "c,c...->...", real / denom, x.astype(jnp.float32)
-                ).astype(x.dtype), t)
-            w_global_p = gmean(new_p)
-            w_global_b = gmean(new_b)
+                data.n_train, lr)
+            w_global_p, w_global_b, real, denom = self._global_mean(
+                new_p, new_b, data.n_train)
             mean_loss = jnp.sum(losses * real) / denom
             return new_p, new_b, w_global_p, w_global_b, mean_loss
 
         return jax.jit(round_fn)
+
+    @functools.cached_property
+    def _consensus_jit(self):
+        return jax.jit(self._consensus)
+
+    @functools.cached_property
+    def _block_jit(self):
+        return jax.jit(self._local_block)
+
+    @functools.cached_property
+    def _tail_jit(self):
+        def tail(new_p, new_b, losses, n_train):
+            w_global_p, w_global_b, real, denom = self._global_mean(
+                new_p, new_b, n_train)
+            mean_loss = jnp.sum(losses * real) / denom
+            return w_global_p, w_global_b, mean_loss
+
+        return jax.jit(tail)
+
+    def _round_streaming(self, per_params, per_bstats, M, rngs, lr):
+        mixed_p, mixed_b = self._consensus_jit(per_params, per_bstats, M)
+        (new_p, new_b), losses = self.stream_map_train_chunks(
+            self._block_jit, (mixed_p, mixed_b), rngs, lr)
+        w_global_p, w_global_b, mean_loss = self._tail_jit(
+            new_p, new_b, losses, jnp.asarray(self._n_train_host))
+        return new_p, new_b, w_global_p, w_global_b, mean_loss
 
     @functools.cached_property
     def _finetune_jit(self):
@@ -153,15 +195,18 @@ class DPSGDEngine(FederatedEngine):
             M = jnp.asarray(self.mixing_matrix(round_idx))
             rngs = self.per_client_rngs(round_idx,
                                         np.arange(self.num_clients))
-            per_params, per_bstats, g_params, g_bstats, loss = \
-                self._round_jit(per_params, per_bstats, self.data, M, rngs,
-                                self.round_lr(round_idx))
+            if self.stream is not None:
+                per_params, per_bstats, g_params, g_bstats, loss = \
+                    self._round_streaming(per_params, per_bstats, M, rngs,
+                                          self.round_lr(round_idx))
+            else:
+                per_params, per_bstats, g_params, g_bstats, loss = \
+                    self._round_jit(per_params, per_bstats, self.data, M,
+                                    rngs, self.round_lr(round_idx))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
-                mg = self.eval_global(g_params, g_bstats)
-                mp = self.eval_personalized(ClientState(
-                    params=per_params, batch_stats=per_bstats,
-                    opt_state=None, rng=None))
+                mg = self._eval_g(g_params, g_bstats)
+                mp = self._eval_p(per_params, per_bstats)
                 self.stat_info["global_test_acc"].append(mg["acc"])
                 self.log.metrics(round_idx, train_loss=loss, global_=mg,
                                  personal=mp)
@@ -169,9 +214,20 @@ class DPSGDEngine(FederatedEngine):
                                 "train_loss": float(loss),
                                 "global_acc": mg["acc"],
                                 "personal_acc": mp["acc"]})
-            if round_idx % 100 == 99:
+            if round_idx % 100 == 99 and self.stream is not None \
+                    and not getattr(self, "_warned_ft_skip", False):
+                self._warned_ft_skip = True
+                self.log.info(
+                    "streaming run: skipping the every-100-rounds "
+                    "fine-tune DIAGNOSTIC pass (its models are evaluated "
+                    "then discarded; no training state depends on it)")
+            if round_idx % 100 == 99 and self.stream is None:
                 # fine-tune pass: lr uses round=-1 (client.train(..., -1),
-                # dpsgd_api.py:97 -> lr * decay^-1)
+                # dpsgd_api.py:97 -> lr * decay^-1). Streaming runs skip
+                # this DIAGNOSTIC pass (the fine-tuned models are
+                # evaluated then discarded, dpsgd_api.py:101 w_per_tmp —
+                # no training state depends on it); the per-round metrics
+                # above stream fine.
                 ft_rngs = self.per_client_rngs(-1,
                                                np.arange(self.num_clients))
                 ft_p, ft_b = self._finetune_jit(g_params, g_bstats, self.data,
@@ -186,4 +242,4 @@ class DPSGDEngine(FederatedEngine):
                 "history": history})
         return {"personal_params": per_params, "global_params": g_params,
                 "history": history,
-                "final_global": self.eval_global(g_params, g_bstats)}
+                "final_global": self._eval_g(g_params, g_bstats)}

@@ -19,30 +19,50 @@ from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 
 class LocalEngine(FederatedEngine):
     name = "local"
+    # Streaming (cohort > HBM): clients are fully independent, so the
+    # streamed round trains client CHUNKS against host-fetched shards and
+    # concatenates the resident per-client state back (same chunked shape
+    # as DisPFL's streamed round, minus any consensus).
+    supports_streaming = True
+
+    def _local_block(self, per_params, per_bstats, rngs, X, y, n, lr):
+        """Vmapped local training over a block of clients."""
+        trainer = self.trainer
+        o = self.cfg.optim
+        max_samples = self._max_samples()
+
+        def local(p, b, rng, Xc, yc, nc):
+            cs = ClientState(params=p, batch_stats=b,
+                             opt_state=trainer.opt.init(p), rng=rng)
+            cs, loss = trainer.local_train(
+                cs, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples)
+            return cs.params, cs.batch_stats, loss
+
+        return jax.vmap(local)(per_params, per_bstats, rngs, X, y, n)
 
     @functools.cached_property
     def _round_jit(self):
-        trainer = self.trainer
-        o = self.cfg.optim
-        max_samples = int(self.data.X_train.shape[1])
-
         def round_fn(per_params, per_bstats, data, rngs, lr):
-            def local(p, b, rng, Xc, yc, nc):
-                cs = ClientState(params=p, batch_stats=b,
-                                 opt_state=trainer.opt.init(p), rng=rng)
-                cs, loss = trainer.local_train(
-                    cs, Xc, yc, nc, lr, epochs=o.epochs,
-                    batch_size=o.batch_size, max_samples=max_samples)
-                return cs.params, cs.batch_stats, loss
-
-            new_p, new_b, losses = jax.vmap(local)(
+            new_p, new_b, losses = self._local_block(
                 per_params, per_bstats, rngs, data.X_train, data.y_train,
-                data.n_train)
+                data.n_train, lr)
             w = data.n_train.astype(jnp.float32)
             mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
             return new_p, new_b, mean_loss
 
         return jax.jit(round_fn)
+
+    @functools.cached_property
+    def _block_jit(self):
+        return jax.jit(self._local_block)
+
+    def _round_streaming(self, per_params, per_bstats, rngs, lr):
+        (new_p, new_b), losses = self.stream_map_train_chunks(
+            self._block_jit, (per_params, per_bstats), rngs, lr)
+        w = jnp.asarray(self._n_train_host, jnp.float32)
+        mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        return new_p, new_b, mean_loss
 
     def train(self):
         cfg = self.cfg
@@ -60,14 +80,16 @@ class LocalEngine(FederatedEngine):
         for round_idx in range(start, cfg.fed.comm_round):
             rngs = self.per_client_rngs(round_idx,
                                         np.arange(self.num_clients))
-            per_params, per_bstats, loss = self._round_jit(
-                per_params, per_bstats, self.data, rngs,
-                self.round_lr(round_idx))
+            if self.stream is not None:
+                per_params, per_bstats, loss = self._round_streaming(
+                    per_params, per_bstats, rngs, self.round_lr(round_idx))
+            else:
+                per_params, per_bstats, loss = self._round_jit(
+                    per_params, per_bstats, self.data, rngs,
+                    self.round_lr(round_idx))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
-                m = self.eval_personalized(ClientState(
-                    params=per_params, batch_stats=per_bstats,
-                    opt_state=None, rng=None))
+                m = self._eval_p(per_params, per_bstats)
                 self.stat_info["person_test_acc"].append(m["acc"])
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx,
@@ -75,9 +97,7 @@ class LocalEngine(FederatedEngine):
             self.maybe_checkpoint(round_idx, {
                 "per_params": per_params, "per_bstats": per_bstats,
                 "history": history})
-        m = self.eval_personalized(ClientState(
-            params=per_params, batch_stats=per_bstats, opt_state=None,
-            rng=None))
+        m = self._eval_p(per_params, per_bstats)
         self.log.metrics(-1, personal=m)
         return {"personal_params": per_params,
                 "personal_batch_stats": per_bstats, "history": history,

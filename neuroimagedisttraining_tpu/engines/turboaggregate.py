@@ -35,57 +35,68 @@ from neuroimagedisttraining_tpu.utils import pytree as pt
 
 class TurboAggregateEngine(FedAvgEngine):
     name = "turboaggregate"
-    supports_streaming = False
+    # Streaming (cohort > HBM): the train-only stage consumes just the
+    # sampled clients' shards (FedAvg's streaming shape); the MPC stage is
+    # host-side either way. The streamed round loop itself is inherited
+    # from FedAvgEngine._train_streaming via _round_stream_jit below.
+    supports_streaming = True
 
-    @functools.cached_property
-    def _train_only_jit(self):
+    def _train_only_body(self, params, bstats, Xs, ys, ns, rngs, lr):
         """Local training WITHOUT the in-program aggregation: returns the
         stacked client params (pre-weighted by n_c / sum n) for the MPC
         stage, plus the plain-averaged batch_stats (BN stats are not secret-
         shared — parity with robust aggregation's is_weight_param exclusion)."""
         trainer = self.trainer
         o = self.cfg.optim
-        max_samples = int(self.data.X_train.shape[1])
+        max_samples = self._max_samples()
+        S = Xs.shape[0]
+        cs = ClientState(
+            params=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
+            batch_stats=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape), bstats),
+            opt_state=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (S,) + x.shape),
+                trainer.opt.init(params)),
+            rng=rngs,
+        )
 
+        def local(cs_c, Xc, yc, nc):
+            return trainer.local_train(
+                cs_c, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples)
+
+        cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
+        w = ns.astype(jnp.float32)
+        wn = w / jnp.maximum(jnp.sum(w), 1e-12)
+        # robust defenses apply BEFORE weighting/sharing, same stage as
+        # FedAvgEngine._round_body (clipping composes with secure agg:
+        # each silo clips its own update before secret-sharing it)
+        f = self.cfg.fed
+        client_params = robust.defend_stacked(
+            cs.params, params, defense=f.defense_type,
+            norm_bound=f.norm_bound, stddev=f.stddev, rngs=cs.rng)
+        weighted = jax.tree.map(
+            lambda x: x.astype(jnp.float32)
+            * wn.reshape((-1,) + (1,) * (x.ndim - 1)), client_params)
+        new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+        mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        return weighted, new_bstats, mean_loss
+
+    @functools.cached_property
+    def _train_only_jit(self):
         def round_fn(params, bstats, data, sampled_idx, rngs, lr):
             Xs = jnp.take(data.X_train, sampled_idx, axis=0)
             ys = jnp.take(data.y_train, sampled_idx, axis=0)
             ns = jnp.take(data.n_train, sampled_idx, axis=0)
-            S = Xs.shape[0]
-            cs = ClientState(
-                params=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
-                batch_stats=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape), bstats),
-                opt_state=jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (S,) + x.shape),
-                    trainer.opt.init(params)),
-                rng=rngs,
-            )
-
-            def local(cs_c, Xc, yc, nc):
-                return trainer.local_train(
-                    cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                    batch_size=o.batch_size, max_samples=max_samples)
-
-            cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
-            w = ns.astype(jnp.float32)
-            wn = w / jnp.maximum(jnp.sum(w), 1e-12)
-            # robust defenses apply BEFORE weighting/sharing, same stage as
-            # FedAvgEngine._round_body (clipping composes with secure agg:
-            # each silo clips its own update before secret-sharing it)
-            f = self.cfg.fed
-            client_params = robust.defend_stacked(
-                cs.params, params, defense=f.defense_type,
-                norm_bound=f.norm_bound, stddev=f.stddev, rngs=cs.rng)
-            weighted = jax.tree.map(
-                lambda x: x.astype(jnp.float32)
-                * wn.reshape((-1,) + (1,) * (x.ndim - 1)), client_params)
-            new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
-            mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
-            return weighted, new_bstats, mean_loss
+            return self._train_only_body(params, bstats, Xs, ys, ns, rngs,
+                                         lr)
 
         return jax.jit(round_fn)
+
+    @functools.cached_property
+    def _train_only_stream_jit(self):
+        return jax.jit(self._train_only_body)
 
     def secure_aggregate(self, weighted_stacked, call_idx: int):
         """Additive-share aggregation over GF(p): quantize each client's
@@ -109,14 +120,16 @@ class TurboAggregateEngine(FedAvgEngine):
             out.append(jnp.asarray(agg, jnp.float32))
         return jax.tree.unflatten(treedef, out)
 
+    # mask-material seed counter; the aggregate itself is rng-independent
+    # (see secure_aggregate), so resume determinism of the training result
+    # is unaffected. Instance assignment (+= 1) shadows the class default.
+    _mpc_calls = 0
+
     @functools.cached_property
     def _round_jit(self):
         """FedAvg's round program signature, with the aggregation swapped for
         the MPC path (host callback between two jitted stages)."""
         train_only = self._train_only_jit
-        self._mpc_calls = 0  # mask-material seed counter; the aggregate
-        # itself is rng-independent (see secure_aggregate), so resume
-        # determinism of the training result is unaffected
 
         def round_fn(params, bstats, data, sampled_idx, rngs, lr):
             weighted, new_bstats, loss = train_only(
@@ -126,3 +139,19 @@ class TurboAggregateEngine(FedAvgEngine):
             return new_params, new_bstats, loss
 
         return round_fn  # not jitted end-to-end: MPC stage is host-side
+
+    @functools.cached_property
+    def _round_stream_jit(self):
+        """Streamed counterpart consumed by the inherited
+        FedAvgEngine._train_streaming loop: jitted train-only stage on the
+        host-fetched shards, then the host-side MPC aggregation."""
+        train_only = self._train_only_stream_jit
+
+        def round_fn(params, bstats, Xs, ys, ns, rngs, lr):
+            weighted, new_bstats, loss = train_only(params, bstats, Xs, ys,
+                                                    ns, rngs, lr)
+            new_params = self.secure_aggregate(weighted, self._mpc_calls)
+            self._mpc_calls += 1
+            return new_params, new_bstats, loss
+
+        return round_fn

@@ -228,7 +228,90 @@ def test_streaming_salientgrads_chunked_phase1(h5_cohort, tmp_path):
     assert res["final_global"] == st["final_global"]
 
 
+def test_streaming_ditto_identical_to_resident(h5_cohort, tmp_path):
+    """Ditto's two tracks only consume sampled clients' shards — the
+    streamed round is shape-identical to resident, so bitwise equal."""
+    path, data = h5_cohort
+    res = _run_algo("ditto", data, streaming=False, tmp_path=tmp_path,
+                    tag="dtres")
+    lazy, stream = _open_stream(path)
+    try:
+        st = _run_algo("ditto", stream, streaming=True, tmp_path=tmp_path,
+                       tag="dtst")
+    finally:
+        stream.close()
+        lazy["file"].close()
+    for r_res, r_st in zip(res["history"], st["history"]):
+        assert r_res["train_loss"] == r_st["train_loss"], (r_res, r_st)
+        assert r_res["personal_acc"] == r_st["personal_acc"]
+        assert r_res["global_acc"] == r_st["global_acc"]
+    assert res["final_personal"] == st["final_personal"]
+
+
+def test_streaming_local_identical_to_resident(h5_cohort, tmp_path):
+    """Local-only streams client chunks (chunk=2 < 4 exercises real
+    chunking); per-client training is independent so state is exact."""
+    path, data = h5_cohort
+    res = _run_algo("local", data, streaming=False, tmp_path=tmp_path,
+                    tag="lores")
+    lazy, stream = _open_stream(path)
+    try:
+        st = _run_algo("local", stream, streaming=True, tmp_path=tmp_path,
+                       tag="lost", stream_chunk_clients=2)
+    finally:
+        stream.close()
+        lazy["file"].close()
+    for r_res, r_st in zip(res["history"], st["history"]):
+        np.testing.assert_allclose(r_st["train_loss"], r_res["train_loss"],
+                                   rtol=1e-6)  # chunked scalar reduce
+        assert r_res["acc"] == r_st["acc"]
+    assert res["final_personal"] == st["final_personal"]
+
+
+def test_streaming_dpsgd_identical_to_resident(h5_cohort, tmp_path):
+    """D-PSGD: state-only gossip consensus + chunked local training."""
+    path, data = h5_cohort
+    res = _run_algo("dpsgd", data, streaming=False, tmp_path=tmp_path,
+                    tag="dgres")
+    lazy, stream = _open_stream(path)
+    try:
+        st = _run_algo("dpsgd", stream, streaming=True, tmp_path=tmp_path,
+                       tag="dgst", stream_chunk_clients=2)
+    finally:
+        stream.close()
+        lazy["file"].close()
+    for r_res, r_st in zip(res["history"], st["history"]):
+        np.testing.assert_allclose(r_st["train_loss"], r_res["train_loss"],
+                                   rtol=1e-6)
+        assert r_res["personal_acc"] == r_st["personal_acc"]
+        assert r_res["global_acc"] == r_st["global_acc"]
+    assert res["final_global"] == st["final_global"]
+
+
+def test_streaming_turboaggregate_identical_to_resident(h5_cohort,
+                                                        tmp_path):
+    """TurboAggregate inherits FedAvg's streamed loop; the MPC stage is
+    host-side and rng-independent either way — bitwise equal."""
+    path, data = h5_cohort
+    res = _run_algo("turboaggregate", data, streaming=False,
+                    tmp_path=tmp_path, tag="tares")
+    lazy, stream = _open_stream(path)
+    try:
+        st = _run_algo("turboaggregate", stream, streaming=True,
+                       tmp_path=tmp_path, tag="tast")
+    finally:
+        stream.close()
+        lazy["file"].close()
+    for r_res, r_st in zip(res["history"], st["history"]):
+        assert r_res["train_loss"] == r_st["train_loss"], (r_res, r_st)
+        assert r_res["acc"] == r_st["acc"]
+    assert res["final_global"] == st["final_global"]
+
+
 def test_streaming_rejects_unsupported_engine(h5_cohort, tmp_path):
+    """FedFomo is the one engine whose round genuinely needs every
+    client's VAL shard resident (the pair-list evaluation indexes them on
+    device); it must refuse --streaming with a clear error."""
     path, data = h5_cohort
     lazy = load_abcd_hdf5(path, lazy=True)
     train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
