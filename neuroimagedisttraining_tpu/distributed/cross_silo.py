@@ -232,7 +232,14 @@ class FedAvgClientProc(ClientManager):
     def run(self) -> None:
         self.register_message_receive_handlers()
         reg = M.Message(M.MSG_TYPE_C2S_REGISTER, self.rank, 0)
-        self.send_message(reg)
+        # the server process may still be initializing (model build + jit
+        # compile) when this silo is ready — give the FIRST contact a
+        # generous retry window on transports that support it
+        try:
+            self.com_manager.send_message(reg, retries=1200,
+                                          retry_delay=0.25)
+        except TypeError:  # transport without retry knobs (e.g. broker)
+            self.com_manager.send_message(reg)
         self.com_manager.handle_receive_message()
 
     def _on_sync(self, msg: M.Message) -> None:
