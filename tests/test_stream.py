@@ -368,6 +368,37 @@ def test_streaming_checkpoint_resume(h5_cohort, tmp_path):
     assert len(resumed["history"]) == 2
 
 
+def test_streaming_salientgrads_checkpoint_resume(h5_cohort, tmp_path):
+    """Flagship streaming + checkpoint/resume: kill back to the round-0
+    checkpoint, resume (phase-1 masks restored, NOT recomputed), final
+    metrics equal the uninterrupted run."""
+    import os
+
+    from neuroimagedisttraining_tpu.utils import checkpoint as ckpt
+
+    path, data = h5_cohort
+    ck = str(tmp_path / "sgck")
+
+    def run():
+        lazy, stream = _open_stream(path)
+        try:
+            return _run_algo("salientgrads", stream, streaming=True,
+                             tmp_path=tmp_path, tag="sgck",
+                             checkpoint_dir=ck, checkpoint_every=1)
+        finally:
+            stream.close()
+            lazy["file"].close()
+
+    full = run()
+    assert ckpt.list_checkpoints(ck) == [0, 1, 2]
+    os.unlink(os.path.join(ck, "ckpt_00000002.msgpack"))
+    os.unlink(os.path.join(ck, "ckpt_00000001.msgpack"))  # kill after r0
+    resumed = run()
+    assert resumed["final_global"] == full["final_global"]
+    assert resumed["final_personal"] == full["final_personal"]
+    assert resumed["mask_density"] == full["mask_density"]
+
+
 def test_streaming_double_buffer_prefetch(h5_cohort):
     path, data = h5_cohort
     lazy = load_abcd_hdf5(path, lazy=True)
