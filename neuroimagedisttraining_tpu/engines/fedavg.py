@@ -24,7 +24,6 @@ import numpy as np
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
-from neuroimagedisttraining_tpu.utils import pytree as pt
 
 
 class FedAvgEngine(FederatedEngine):

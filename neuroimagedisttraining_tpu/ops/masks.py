@@ -20,7 +20,6 @@ Ported semantics:
 
 from __future__ import annotations
 
-import math
 from typing import Any
 
 import jax

@@ -22,7 +22,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 _BLOCK_ROWS = 256          # x block = [256, 128] floats = 128 KiB VMEM
 _LANES = 128

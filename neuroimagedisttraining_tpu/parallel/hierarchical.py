@@ -31,7 +31,6 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 try:
     from jax import shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax

@@ -25,7 +25,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
-    import jax
     import jax.numpy as jnp
 
     from neuroimagedisttraining_tpu.config import (
