@@ -247,8 +247,16 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
         if d.partition_method != "site":
             raise ValueError("streaming mode currently partitions by site")
         train_map, test_map, _ = P.site_partition(cohort["site"], seed=42)
+        if mesh is not None and \
+                cfg.fed.client_num_per_round % mesh.devices.size != 0:
+            raise ValueError(
+                f"--streaming over a {mesh.devices.size}-device mesh needs "
+                f"client_num_per_round ({cfg.fed.client_num_per_round}) to "
+                "be a multiple of the device count (choose --frac "
+                "accordingly) so every round's sharded feed tiles the "
+                "client axis")
         stream = StreamingFederation(cohort["X"], cohort["y"], train_map,
-                                     test_map)
+                                     test_map, mesh=mesh)
         fed = None
     else:
         fed, info = federate_cohort(
@@ -321,11 +329,17 @@ def main(argv: list[str] | None = None) -> int:
     if not args.streaming:
         from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
         mesh = make_mesh(shape=cfg.mesh_shape)
-    elif cfg.mesh_shape:
+    elif len(cfg.mesh_shape) > 1:
         raise ValueError(
-            "--mesh_shape is not supported with --streaming (the round-"
-            "granular host feed keeps only the sampled clients' shards on "
-            "device; there is no persistent client mesh to lay out)")
+            "--streaming supports a 1-D client mesh only (--mesh_shape N): "
+            "the round-granular host feed shards each round's sampled "
+            "clients over the client axis; a two-level (silos, clients) "
+            "layout has no persistent all-client placement to stream into")
+    elif cfg.mesh_shape:
+        # sharded streaming: each round's sampled-client buffers are
+        # device_put sharded over the 1-D client mesh
+        from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(shape=cfg.mesh_shape)
     engine = build_experiment(cfg, streaming=args.streaming, mesh=mesh)
     from neuroimagedisttraining_tpu.utils.profiling import (
         failure_context, profile_trace,

@@ -54,8 +54,14 @@ class StreamingFederation:
 
     def __init__(self, X_source, y: np.ndarray,
                  train_map: dict[int, np.ndarray],
-                 test_map: dict[int, np.ndarray]):
+                 test_map: dict[int, np.ndarray], mesh=None):
+        """``mesh``: optional 1-D client mesh — round/eval buffers are then
+        device_put SHARDED over their leading (client) axis, so a streamed
+        round feeds a multi-chip federation directly (one sampled client
+        per core at the flagship layout); requires the sampled-set size to
+        tile the mesh."""
         self.X = X_source
+        self.mesh = mesh
         self.y = np.asarray(y)
         self.train_map = {c: np.asarray(v) for c, v in train_map.items()}
         self.test_map = {c: np.asarray(v) for c, v in test_map.items()}
@@ -72,6 +78,18 @@ class StreamingFederation:
         self.dtype = self.X.dtype
         self._pool = ThreadPoolExecutor(max_workers=1)
         self._pending: tuple[tuple, object] | None = None
+
+    def _put(self, x: np.ndarray):
+        """Host -> device; sharded over the leading client axis when a
+        mesh is attached (the jitted round program then runs SPMD over the
+        client axis with no resharding)."""
+        if self.mesh is None:
+            return jax.device_put(x)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(self.mesh.axis_names[0],
+                             *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
 
     # ---------- raw fetch (host thread) ----------
 
@@ -115,7 +133,7 @@ class StreamingFederation:
             self._pending = None
         else:
             Xs, ys, ns = self._fetch(np.asarray(client_ids), "train")
-        return (jax.device_put(Xs), jax.device_put(ys), jax.device_put(ns))
+        return (self._put(Xs), self._put(ys), self._put(ns))
 
     # ---------- streamed evaluation ----------
 
@@ -141,8 +159,8 @@ class StreamingFederation:
             if i + 1 < len(metas):
                 fut = self._pool.submit(self._fetch, metas[i + 1][1], split)
             ns[len(ids):] = 0  # pad clients contribute nothing
-            yield EvalChunk(ids, padded, jax.device_put(Xs),
-                            jax.device_put(ys), jax.device_put(ns))
+            yield EvalChunk(ids, padded, self._put(Xs), self._put(ys),
+                            self._put(ns))
 
     def close(self):
         self._pool.shutdown(wait=False)

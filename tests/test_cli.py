@@ -106,15 +106,37 @@ def test_streaming_rejected_for_unsupported_algorithm(tmp_path):
         build_experiment(cfg, streaming=True, console=False)
 
 
-def test_mesh_shape_rejected_with_streaming(tmp_path):
-    """--streaming --mesh_shape must error with a usage message rather
-    than silently ignoring the requested mesh layout (checked in main()
-    before any data or device work)."""
+def test_two_level_mesh_shape_rejected_with_streaming(tmp_path):
+    """--streaming supports a 1-D client mesh (sharded streaming) but a
+    two-level (silos, clients) layout must error with a usage message
+    (checked in main() before any data or device work)."""
     import pytest
 
     from neuroimagedisttraining_tpu.__main__ import main
 
-    with pytest.raises(ValueError, match="not supported with --streaming"):
+    with pytest.raises(ValueError, match="1-D client mesh only"):
         main(["--algorithm", "fedavg", "--dataset", "abcd_h5",
               "--data_dir", str(tmp_path / "c.h5"), "--streaming",
               "--mesh_shape", "2", "4", "--log_dir", str(tmp_path)])
+
+
+def test_streaming_mesh_requires_tiling_sample_count(tmp_path):
+    """Sharded streaming needs the per-round sampled-client count to tile
+    the mesh; a non-tiling --frac must error with guidance."""
+    import pytest
+
+    from neuroimagedisttraining_tpu.__main__ import build_experiment
+    from neuroimagedisttraining_tpu.data.synthetic import write_synthetic_hdf5
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+
+    path = str(tmp_path / "c.h5")
+    write_synthetic_hdf5(path, num_subjects=32, shape=(12, 14, 12),
+                         num_sites=4, seed=0)
+    mesh = make_mesh(shape=(2,))
+    cfg = config_from_args(_parse([
+        "--algorithm", "fedavg", "--dataset", "abcd_h5",
+        "--data_dir", path, "--client_num_in_total", "4",
+        "--frac", "0.75",  # 3 sampled clients, 2-device mesh: no tile
+        "--log_dir", str(tmp_path)]))
+    with pytest.raises(ValueError, match="multiple of the device count"):
+        build_experiment(cfg, streaming=True, mesh=mesh, console=False)
