@@ -255,6 +255,13 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
                 "be a multiple of the device count (choose --frac "
                 "accordingly) so every round's sharded feed tiles the "
                 "client axis")
+        if mesh is not None and cfg.stream_chunk_clients > 0 and \
+                cfg.stream_chunk_clients % mesh.devices.size != 0:
+            raise ValueError(
+                f"--stream_chunk_clients ({cfg.stream_chunk_clients}) must "
+                f"be a multiple of the {mesh.devices.size}-device mesh so "
+                "each streamed chunk's NamedSharding device_put tiles the "
+                "client axis (otherwise XLA rejects the put mid-run)")
         stream = StreamingFederation(cohort["X"], cohort["y"], train_map,
                                      test_map, mesh=mesh)
         fed = None

@@ -151,9 +151,12 @@ class MessageBroker:
                         self._retained[topic] = payload
                     for t in targets:
                         if not self._send_to(t, topic, payload):
-                            with self._lock:
-                                if t in self._subs.get(topic, ()):
-                                    self._subs[topic].remove(t)
+                            # a failed send (timeout mid-frame or OSError)
+                            # may leave the subscriber's byte stream torn
+                            # mid-length-prefix — every later frame on ANY
+                            # topic would be misparsed. Tear the whole
+                            # connection down, not just this subscription.
+                            self._drop(t)
         finally:
             self._drop(conn)
 

@@ -132,9 +132,25 @@ def discover_subjects(raw_dir: str, anat_prefix: str = "anat_201",
     return out
 
 
+#: candidate subject-id columns, checked in order (ABCD uses subjectkey /
+#: src_subject_id; the notebook's sheet carries none, hence the fallback)
+_ID_COLUMNS = ("subjectkey", "src_subject_id", "subject_id", "subject", "id")
+
+
+def _codes(vals):
+    """pandas category codes == sorted-unique index (cells 25-28)."""
+    uniq = sorted(set(vals))
+    table = {v: i for i, v in enumerate(uniq)}
+    return np.asarray([table[v] for v in vals])
+
+
 def load_subject_info(path: str):
-    """``female``/``abcd_site`` columns -> (y codes, site codes) in file
-    order (cells 25-28: pandas category codes == sorted-unique index)."""
+    """``female``/``abcd_site`` columns -> (female values, site values,
+    ids, id column name) in file order. Values are RAW strings — category
+    codes must be computed AFTER any join/subset, or a dropped row
+    carrying a novel value would shift every kept subject's code. ``ids``
+    is the subject-id column when one exists (so callers can join rows to
+    discovered volumes by id instead of by position), else ``None``."""
     with open(path, newline="") as f:
         rows = list(csv.DictReader(f))
     if not rows:
@@ -144,13 +160,12 @@ def load_subject_info(path: str):
             raise ValueError(f"{path}: missing column {col!r}")
     female = [r["female"] for r in rows]
     site = [r["abcd_site"] for r in rows]
-
-    def codes(vals):
-        uniq = sorted(set(vals))
-        table = {v: i for i, v in enumerate(uniq)}
-        return np.asarray([table[v] for v in vals])
-
-    return codes(female).astype(np.int8), codes(site).astype(np.int16)
+    ids, id_col = None, None
+    for col in _ID_COLUMNS:
+        if col in rows[0]:
+            ids, id_col = [r[col] for r in rows], col
+            break
+    return female, site, ids, id_col
 
 
 def quantize_subject(vol: np.ndarray) -> np.ndarray:
@@ -172,10 +187,37 @@ def preprocess_cohort(raw_dir: str, subject_info: str, out_path: str,
     subjects = discover_subjects(raw_dir, anat_prefix, volume_name)
     if not subjects:
         raise ValueError(f"no subjects with {volume_name} under {raw_dir}")
-    y, site = load_subject_info(subject_info)
-    if len(y) < len(subjects):
+    female, site_raw, ids, id_col = load_subject_info(subject_info)
+    if ids is not None:
+        # join by subject id: a CSV row whose volume was skipped by
+        # discovery must not shift every later subject's y/site
+        table = {sid: i for i, sid in enumerate(ids)}
+        if len(table) != len(ids):
+            dupes = sorted({s for s in ids if ids.count(s) > 1})
+            raise ValueError(
+                f"subject info column {id_col!r} has duplicate ids "
+                f"{dupes[:5]} — ambiguous join")
+        missing = [sid for sid, _ in subjects if sid not in table]
+        if missing:
+            raise ValueError(
+                f"subject info is missing {id_col!r} rows for discovered "
+                f"volumes: {missing[:5]}"
+                f"{'...' if len(missing) > 5 else ''} (if this column is "
+                "not the directory subject id, rename it to re-enable "
+                "positional pairing)")
+        order = [table[sid] for sid, _ in subjects]
+        female = [female[i] for i in order]
+        site_raw = [site_raw[i] for i in order]
+    elif len(female) != len(subjects):
+        # positional pairing is only sound when the counts agree exactly
         raise ValueError(
-            f"subject info has {len(y)} rows < {len(subjects)} volumes")
+            f"subject info has {len(female)} rows but {len(subjects)} "
+            "volumes were discovered and no subject-id column "
+            f"({'/'.join(_ID_COLUMNS)}) is present to join on — row-order "
+            "pairing would silently misalign labels")
+    # codes AFTER the join: dropped rows must not contribute categories
+    y = _codes(female).astype(np.int8)
+    site = _codes(site_raw).astype(np.int16)
     log(f"{len(subjects)} subjects discovered")
 
     # pass 1: voxelwise mean -> brain mask (cells 7-16)
@@ -201,13 +243,13 @@ def preprocess_cohort(raw_dir: str, subject_info: str, out_path: str,
                     f"{p}: shape {vol.shape} != mask shape {shape}")
             q = quantize_subject(vol * mask)
             X[i] = (q.astype(np.float32) / 255.0) if store_float else q
-        f.create_dataset("y", data=y[: len(subjects)])
-        f.create_dataset("site", data=site[: len(subjects)])
+        f.create_dataset("y", data=y)
+        f.create_dataset("site", data=site)
     log(f"wrote {out_path}: X{(len(subjects),) + shape} "
         f"{'float32' if store_float else 'uint8'}, y, site")
     return {"subjects": len(subjects), "shape": shape,
             "mask_voxels": int(mask.sum()),
-            "sites": int(site[: len(subjects)].max()) + 1}
+            "sites": int(site.max()) + 1}
 
 
 def main(argv=None) -> int:

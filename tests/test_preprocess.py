@@ -100,6 +100,76 @@ def test_preprocess_store_float_matches_notebook_values(raw_cohort,
     np.testing.assert_array_equal(X * 255, np.round(X * 255))
 
 
+def test_preprocess_joins_by_id_when_rows_outnumber_volumes(raw_cohort,
+                                                            tmp_path):
+    """A CSV row whose volume was skipped by discovery (sub_broken, no
+    anat dir) must not shift later subjects' labels (ADVICE r3 #3)."""
+    root, _, _ = raw_cohort
+    info = tmp_path / "info_extra.csv"
+    with open(info, "w", newline="") as f:
+        w = csv.DictWriter(f, ["subject", "female", "abcd_site"])
+        w.writeheader()
+        for i in range(8):
+            w.writerow({"subject": f"sub{i:02d}", "female": i % 2,
+                        "abcd_site": f"site{i % 3:02d}"})
+            if i == 3:  # mid-file row for the discovered-skipped subject,
+                # carrying NOVEL categorical values: codes must be computed
+                # after the join or these would shift every kept code
+                w.writerow({"subject": "sub_broken", "female": "NA",
+                            "abcd_site": "site_zz"})
+    out = str(tmp_path / "joined.h5")
+    PP.preprocess_cohort(str(root), str(info), out, log=lambda *a: None)
+    cohort = load_abcd_hdf5(out, lazy=False)
+    np.testing.assert_array_equal(cohort["y"], [i % 2 for i in range(8)])
+    np.testing.assert_array_equal(cohort["site"],
+                                  [i % 3 for i in range(8)])
+
+
+def test_preprocess_rejects_positional_count_mismatch(raw_cohort, tmp_path):
+    """Without an id column, a row-count mismatch is an error, never a
+    silent truncation (ADVICE r3 #3)."""
+    root, _, _ = raw_cohort
+    info = tmp_path / "info_noid.csv"
+    with open(info, "w", newline="") as f:
+        w = csv.DictWriter(f, ["female", "abcd_site"])
+        w.writeheader()
+        for i in range(9):  # one extra row vs the 8 discovered volumes
+            w.writerow({"female": i % 2, "abcd_site": f"site{i % 3:02d}"})
+    with pytest.raises(ValueError, match="misalign"):
+        PP.preprocess_cohort(str(root), str(info),
+                             str(tmp_path / "bad.h5"), log=lambda *a: None)
+
+
+def test_preprocess_rejects_duplicate_ids(raw_cohort, tmp_path):
+    root, _, _ = raw_cohort
+    info = tmp_path / "info_dupe.csv"
+    with open(info, "w", newline="") as f:
+        w = csv.DictWriter(f, ["subject", "female", "abcd_site"])
+        w.writeheader()
+        for i in range(8):
+            w.writerow({"subject": f"sub{i:02d}", "female": i % 2,
+                        "abcd_site": f"site{i % 3:02d}"})
+        w.writerow({"subject": "sub03", "female": 0,  # conflicting re-row
+                    "abcd_site": "site01"})
+    with pytest.raises(ValueError, match="duplicate ids"):
+        PP.preprocess_cohort(str(root), str(info),
+                             str(tmp_path / "bad3.h5"), log=lambda *a: None)
+
+
+def test_preprocess_errors_on_missing_id_row(raw_cohort, tmp_path):
+    root, _, _ = raw_cohort
+    info = tmp_path / "info_short.csv"
+    with open(info, "w", newline="") as f:
+        w = csv.DictWriter(f, ["subject", "female", "abcd_site"])
+        w.writeheader()
+        for i in range(7):  # sub07's row missing
+            w.writerow({"subject": f"sub{i:02d}", "female": i % 2,
+                        "abcd_site": f"site{i % 3:02d}"})
+    with pytest.raises(ValueError, match="missing 'subject' rows"):
+        PP.preprocess_cohort(str(root), str(info),
+                             str(tmp_path / "bad2.h5"), log=lambda *a: None)
+
+
 def test_preprocess_cli_subprocess(raw_cohort, tmp_path):
     root, _, info = raw_cohort
     out = str(tmp_path / "cli.h5")
