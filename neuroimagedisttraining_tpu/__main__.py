@@ -54,6 +54,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--wd", type=float, default=5e-4)
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch_order", type=str, default="shuffle",
+                        choices=["shuffle", "replacement"],
+                        help="minibatch selection: per-epoch shuffled "
+                             "strides (reference DataLoader semantics) or "
+                             "i.i.d. draws with replacement")
     parser.add_argument("--client_num_in_total", type=int, default=21)
     parser.add_argument("--frac", type=float, default=1.0)
     parser.add_argument("--comm_round", type=int, default=200)
@@ -164,7 +169,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         optim=OptimConfig(
             client_optimizer=args.client_optimizer, lr=args.lr,
             lr_decay=args.lr_decay, wd=args.wd, momentum=args.momentum,
-            batch_size=args.batch_size, epochs=args.epochs),
+            batch_size=args.batch_size, epochs=args.epochs,
+            batch_order=args.batch_order),
         fed=FedConfig(
             client_num_in_total=args.client_num_in_total, frac=args.frac,
             comm_round=args.comm_round, cs=args.cs, active=args.active,
@@ -262,8 +268,16 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
                 f"be a multiple of the {mesh.devices.size}-device mesh so "
                 "each streamed chunk's NamedSharding device_put tiles the "
                 "client axis (otherwise XLA rejects the put mid-run)")
+        val_map = None
+        if d.val_fraction > 0:
+            from neuroimagedisttraining_tpu.data.federate import (
+                carve_val_split,
+            )
+
+            val_map, train_map = carve_val_split(train_map, d.val_fraction,
+                                                 seed=42)
         stream = StreamingFederation(cohort["X"], cohort["y"], train_map,
-                                     test_map, mesh=mesh)
+                                     test_map, mesh=mesh, val_map=val_map)
         fed = None
     else:
         fed, info = federate_cohort(
@@ -332,20 +346,15 @@ def main(argv: list[str] | None = None) -> int:
         args.num_classes = _vision_classes[args.dataset.lower()]
 
     cfg = config_from_args(args)
-    mesh = None
-    if not args.streaming:
-        from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh(shape=cfg.mesh_shape)
-    elif len(cfg.mesh_shape) > 1:
-        raise ValueError(
-            "--streaming supports a 1-D client mesh only (--mesh_shape N): "
-            "the round-granular host feed shards each round's sampled "
-            "clients over the client axis; a two-level (silos, clients) "
-            "layout has no persistent all-client placement to stream into")
-    elif cfg.mesh_shape:
-        # sharded streaming: each round's sampled-client buffers are
-        # device_put sharded over the 1-D client mesh
-        from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+    # mesh applies to both residency modes: under --streaming each round's
+    # sampled-client buffers are device_put sharded over the client axis —
+    # on a two-level (silos, clients) mesh the axis maps over BOTH mesh
+    # axes silo-major (data/stream.py::_put), so the engine's silo-first
+    # aggregation routing is preserved while the cohort streams from host
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+    if args.streaming and not cfg.mesh_shape:
+        mesh = None  # plain single-device streaming feed
+    else:
         mesh = make_mesh(shape=cfg.mesh_shape)
     engine = build_experiment(cfg, streaming=args.streaming, mesh=mesh)
     from neuroimagedisttraining_tpu.utils.profiling import (

@@ -31,6 +31,10 @@ class OptimConfig:
     batch_size: int = 16
     epochs: int = 2                # local epochs per round
     grad_clip: float = 10.0        # torch clip_grad_norm_ parity (my_model_trainer.py:224)
+    # "shuffle": walk a fresh per-epoch permutation in batch_size strides
+    # (reference DataLoader semantics, my_model_trainer.py:213);
+    # "replacement": i.i.d. uniform draws per step (rounds 1-3 behavior)
+    batch_order: str = "shuffle"
 
 
 @dataclass(frozen=True)

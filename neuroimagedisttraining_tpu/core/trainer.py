@@ -38,6 +38,38 @@ from neuroimagedisttraining_tpu.models import primary_logits
 PyTree = Any
 
 
+def epoch_permutations(rng: jax.Array, epochs: int, max_samples: int,
+                       n_valid) -> jax.Array:
+    """[epochs, max_samples] of per-epoch uniform permutations of the
+    VALID rows (indices < ``n_valid``) with every padded row sorted last.
+
+    Static-shape analog of the reference DataLoader's per-epoch shuffle
+    (my_model_trainer.py:213): sort per-row uniforms, with padded rows
+    pinned to a sentinel above the uniform range so positions
+    ``[0, n_valid)`` of each row are a uniform permutation of the valid
+    indices."""
+    keys = jax.random.split(rng, epochs)
+    u = jax.vmap(lambda k: jax.random.uniform(k, (max_samples,)))(keys)
+    u = jnp.where(jnp.arange(max_samples) < n_valid, u, 2.0)
+    return jnp.argsort(u, axis=-1)
+
+
+def shuffle_batch_indices(perms: jax.Array, t, steps_per_epoch: int,
+                          batch_size: int, n_valid):
+    """Row indices + validity weights for scan step ``t`` when walking the
+    per-epoch permutations in ``batch_size`` strides.
+
+    The final batch of an epoch may run past ``n_valid``; those positions
+    wrap to the epoch's start so every gathered row is a real sample, and
+    their weight is 0 so the loss/grad is the mean over the true partial
+    batch — exactly the reference's smaller last DataLoader batch."""
+    e = t // steps_per_epoch
+    pos = (t % steps_per_epoch) * batch_size + jnp.arange(batch_size)
+    idx = perms[e][pos % jnp.maximum(n_valid, 1)]
+    w = (pos < n_valid).astype(jnp.float32)
+    return idx, w
+
+
 @flax.struct.dataclass
 class ClientState:
     """All trainable state of one client; with a leading client axis this is
@@ -123,6 +155,18 @@ class LocalTrainer:
         sample count; steps beyond its per-epoch quota are masked no-ops so
         vmapped clients keep reference-parity update counts.
 
+        Batch selection follows ``optim.batch_order``: ``"shuffle"``
+        (default) walks a fresh per-epoch permutation in ``batch_size``
+        strides with a weighted partial final batch — the reference
+        DataLoader's semantics (my_model_trainer.py:213) under static
+        shapes. Loss and gradients of the partial batch are EXACTLY the
+        reference's smaller-batch mean (torch-pinned in
+        tests/test_torch_parity.py); the one residual deviation is
+        BatchNorm models, whose partial-batch activation statistics see
+        the wrapped filler rows (real samples, zero loss weight) that a
+        genuinely smaller torch batch would not contain.
+        ``"replacement"`` draws i.i.d. uniform batches.
+
         ``prox_lamda``/``prox_ref``: Ditto's personalized proximal pull,
         applied after each optimizer step: ``w -= lr * lamda * (w - ref)``
         (ditto/my_model_trainer.py:63-64).
@@ -130,12 +174,24 @@ class LocalTrainer:
         steps_per_epoch = max(1, math.ceil(max_samples / batch_size))
         my_steps = jnp.ceil(n_valid / batch_size).astype(jnp.int32)
         total = epochs * steps_per_epoch
+        shuffle = self.optim_cfg.batch_order == "shuffle"
+        if shuffle:
+            # reference DataLoader semantics: each epoch walks a fresh
+            # permutation of the client's rows in batch_size strides
+            rng0, prng = jax.random.split(cs.rng)
+            cs = cs.replace(rng=rng0)
+            perms = epoch_permutations(prng, epochs, max_samples, n_valid)
 
         def step(carry, t):
             state = carry
             rng, brng, drng = jax.random.split(state.rng, 3)
-            idx = jax.random.randint(brng, (batch_size,), 0,
-                                     jnp.maximum(n_valid, 1))
+            if shuffle:
+                idx, wb = shuffle_batch_indices(perms, t, steps_per_epoch,
+                                                batch_size, n_valid)
+            else:
+                idx = jax.random.randint(brng, (batch_size,), 0,
+                                         jnp.maximum(n_valid, 1))
+                wb = None
             xb = jnp.take(X, idx, axis=0)
             yb = jnp.take(y, idx, axis=0)
 
@@ -143,7 +199,7 @@ class LocalTrainer:
                 out, bstats = self._apply(params, state.batch_stats,
                                           self._prep(xb), train=True,
                                           dropout_rng=drng)
-                return self.loss(primary_logits(out), yb), bstats
+                return self.loss(primary_logits(out), yb, weights=wb), bstats
 
             (loss, bstats), grads = jax.value_and_grad(f, has_aux=True)(
                 state.params)

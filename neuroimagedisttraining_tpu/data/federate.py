@@ -97,6 +97,22 @@ def build_federated_data(
     return FederatedData(**parts)
 
 
+def carve_val_split(train_map: dict[int, np.ndarray], val_fraction: float,
+                    seed: int) -> tuple[dict, dict]:
+    """Carve a validation split out of each client's train shard (FedFomo
+    9-tuple, cifar10/data_val_loader.py:83-260). Returns (val_map,
+    new_train_map); shared by the resident and streaming data paths so a
+    streamed FedFomo run sees the SAME split as the resident one."""
+    val_map, new_train = {}, {}
+    rs = np.random.RandomState(seed + 1)  # one stream across clients
+    for c, idx in train_map.items():
+        idx = np.array(idx, copy=True)
+        rs.shuffle(idx)
+        nv = max(1, int(len(idx) * val_fraction))
+        val_map[c], new_train[c] = idx[:nv], idx[nv:]
+    return val_map, new_train
+
+
 def federate_cohort(data: dict[str, np.ndarray], partition_method: str = "site",
                     client_number: int | None = None, alpha: float = 0.5,
                     seed: int = 42, mesh=None, val_fraction: float = 0.0
@@ -125,16 +141,7 @@ def federate_cohort(data: dict[str, np.ndarray], partition_method: str = "site",
 
     val_map = None
     if val_fraction > 0:
-        # carve validation out of each client's train shard (FedFomo 9-tuple,
-        # cifar10/data_val_loader.py:83-260)
-        val_map, new_train = {}, {}
-        rs = np.random.RandomState(seed + 1)  # one stream across clients
-        for c, idx in train_map.items():
-            idx = np.array(idx, copy=True)
-            rs.shuffle(idx)
-            nv = max(1, int(len(idx) * val_fraction))
-            val_map[c], new_train[c] = idx[:nv], idx[nv:]
-        train_map = new_train
+        val_map, train_map = carve_val_split(train_map, val_fraction, seed)
     info["client_num"] = len(train_map)
     info["train_counts"] = [int(len(train_map[c])) for c in sorted(train_map)]
     info["stats"] = P.record_data_stats(y, train_map)

@@ -52,6 +52,9 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.parallel.gossip import (
+    circulant_plan, gossip_apply, plan_fits_mesh,
+)
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import masks as M
 from neuroimagedisttraining_tpu.utils import pytree as pt
@@ -156,16 +159,23 @@ class DisPFLEngine(FederatedEngine):
     # ---------- the round program ----------
 
     def _consensus(self, per_params, per_bstats, masks_local, masks_shared,
-                   A):
+                   A, plan=None):
         """Mask-overlap-weighted neighbor aggregation (state-only).
 
         counts[c] = sum_j A[c,j] * masks_shared[j]  (overlap count)
         w_tmp[c]  = (1/counts[c]) * sum_j A[c,j] * w[j], 0 where count=0
+
+        With a circulant ring/k-lattice adjacency tiling the mesh
+        (``plan``), each neighbor sum lowers to ppermute shifts
+        (parallel/gossip.py) instead of the dense all-to-all einsum.
         """
-        mix = lambda t: jax.tree.map(
-            lambda x: jnp.einsum("cj,j...->c...", A,
-                                 x.astype(jnp.float32)).astype(x.dtype),
-            t)
+        if plan is not None:
+            mix = lambda t: gossip_apply(t, plan, self.mesh)
+        else:
+            mix = lambda t: jax.tree.map(
+                lambda x: jnp.einsum("cj,j...->c...", A,
+                                     x.astype(jnp.float32)).astype(x.dtype),
+                t)
         counts = mix(masks_shared)
         sums = mix(per_params)
         w_tmp = jax.tree.map(
@@ -227,12 +237,13 @@ class DisPFLEngine(FederatedEngine):
                                          X, y, n)
         return new_p, new_b, new_masks, losses
 
-    @functools.cached_property
-    def _round_jit(self):
+    @functools.lru_cache(maxsize=4)
+    def _round_jit_for(self, plan):
         def round_fn(per_params, per_bstats, masks_local, masks_shared,
                      data, A, rngs, lr, round_idx):
             w_local, b_mixed = self._consensus(
-                per_params, per_bstats, masks_local, masks_shared, A)
+                per_params, per_bstats, masks_local, masks_shared, A,
+                plan=plan)
             new_p, new_b, new_masks, losses = self._local_and_evolve(
                 w_local, b_mixed, masks_local, rngs,
                 data.X_train, data.y_train, data.n_train, lr, round_idx)
@@ -248,11 +259,27 @@ class DisPFLEngine(FederatedEngine):
 
         return jax.jit(round_fn)
 
+    @property
+    def _round_jit(self):
+        return self._round_jit_for(None)
+
+    def gossip_plan(self, A: np.ndarray):
+        """ppermute plan for this round's adjacency (unit weights: the
+        consensus normalizes by mask-overlap counts afterwards), or None
+        for the dense einsum path."""
+        plan = circulant_plan(A)
+        return plan if plan_fits_mesh(plan, self.mesh,
+                                      self.num_clients) else None
+
     # ---------- streamed round (data per chunk, state resident) ----------
 
-    @functools.cached_property
+    @functools.lru_cache(maxsize=4)
+    def _consensus_jit_for(self, plan):
+        return jax.jit(functools.partial(self._consensus, plan=plan))
+
+    @property
     def _consensus_jit(self):
-        return jax.jit(self._consensus)
+        return self._consensus_jit_for(None)
 
     @functools.cached_property
     def _local_chunk_jit(self):
@@ -271,11 +298,11 @@ class DisPFLEngine(FederatedEngine):
         return jax.jit(tail)
 
     def _round_streaming(self, per_params, per_bstats, masks_local,
-                         masks_shared, A, rngs, lr, round_idx):
+                         masks_shared, A, rngs, lr, round_idx, plan=None):
         """Chunked streamed round: consensus on resident state, then each
         client chunk's data is host-fetched, trained, and evolved; chunk
         outputs concatenate back into the stacked [C, ...] state."""
-        w_local, b_mixed = self._consensus_jit(
+        w_local, b_mixed = self._consensus_jit_for(plan)(
             per_params, per_bstats, masks_local, masks_shared, A)
         (new_p, new_b, new_masks), losses = self.stream_map_train_chunks(
             self._local_chunk_jit, (w_local, b_mixed, masks_local), rngs,
@@ -351,7 +378,9 @@ class DisPFLEngine(FederatedEngine):
             history = restored["history"]
         for round_idx in range(start, cfg.fed.comm_round):
             active = self.active_draw(round_idx)
-            A = jnp.asarray(self.adjacency(round_idx, active))
+            A_np = self.adjacency(round_idx, active)
+            plan = self.gossip_plan(A_np)
+            A = jnp.asarray(A_np)
             rngs = self.per_client_rngs(round_idx,
                                         np.arange(self.num_clients))
             self.log.info(
@@ -362,10 +391,10 @@ class DisPFLEngine(FederatedEngine):
                  dist_self, loss) = self._round_streaming(
                     per_params, per_bstats, masks_local, masks_shared,
                     A, rngs, self.round_lr(round_idx),
-                    jnp.float32(round_idx))
+                    jnp.float32(round_idx), plan=plan)
             else:
                 (per_params, per_bstats, masks_local, masks_shared,
-                 dist_self, loss) = self._round_jit(
+                 dist_self, loss) = self._round_jit_for(plan)(
                     per_params, per_bstats, masks_local, masks_shared,
                     self.data, A, rngs, self.round_lr(round_idx),
                     jnp.float32(round_idx))

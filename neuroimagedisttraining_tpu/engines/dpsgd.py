@@ -13,10 +13,13 @@ Behavior parity (dpsgd_api.py:41-139):
   (dpsgd_api.py:89-101).
 
 TPU-native: neighbor choices become one row-stochastic mixing matrix
-``M[C,C]`` per round; the consensus step for the whole federation is a
-single ``einsum('cj,j...->c...')`` over the client-sharded axis (an
-all-to-all over ICI), followed by the usual vmapped local training — one
-jitted program per round.
+``M[C,C]`` per round. For ``cs="ring"`` at full activity the matrix is
+CIRCULANT and the consensus lowers to ``lax.ppermute`` shifts of 1-row
+slices between neighboring devices (parallel/gossip.py) — per-device
+traffic O(model), independent of C. Otherwise (random draws, padded
+rows) it is a single ``einsum('cj,j...->c...')`` over the client-sharded
+axis (an all-to-all over ICI). Either way, consensus + vmapped local
+training is one jitted program per round.
 """
 
 from __future__ import annotations
@@ -29,6 +32,9 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.parallel.gossip import (
+    circulant_plan, gossip_apply, plan_fits_mesh,
+)
 
 
 def benefit_choose(round_idx: int, cur_clnt: int, total: int,
@@ -77,12 +83,25 @@ class DPSGDEngine(FederatedEngine):
     # shards.
     supports_streaming = True
 
-    def _consensus(self, per_params, per_bstats, M):
-        """Gossip consensus over last round's models: one all-to-all
-        matmul against the mixing matrix."""
+    def _consensus(self, per_params, per_bstats, M, plan=None):
+        """Gossip consensus over last round's models: ppermute ring shifts
+        when the round's matrix is circulant and tiles the mesh (``plan``),
+        else one all-to-all matmul against the mixing matrix."""
+        if plan is not None:
+            return (gossip_apply(per_params, plan, self.mesh),
+                    gossip_apply(per_bstats, plan, self.mesh))
         mix = lambda t: jax.tree.map(
             lambda x: jnp.einsum("cj,j...->c...", M, x), t)
         return mix(per_params), mix(per_bstats)
+
+    def gossip_plan(self, M_np: np.ndarray):
+        """Static ppermute plan for this round's matrix, or None for the
+        dense einsum path. Hashable -> keys the per-plan jit cache (ring
+        topologies reuse one trace; the detection cost is C^2 host
+        compares per round)."""
+        plan = circulant_plan(M_np)
+        return plan if plan_fits_mesh(plan, self.mesh,
+                                      self.num_clients) else None
 
     def _local_block(self, mixed_p, mixed_b, rngs, X, y, n, lr):
         trainer = self.trainer
@@ -109,10 +128,11 @@ class DPSGDEngine(FederatedEngine):
             ).astype(x.dtype), t)
         return gmean(new_p), gmean(new_b), real, denom
 
-    @functools.cached_property
-    def _round_jit(self):
+    @functools.lru_cache(maxsize=4)
+    def _round_jit_for(self, plan):
         def round_fn(per_params, per_bstats, data, M, rngs, lr):
-            mixed_p, mixed_b = self._consensus(per_params, per_bstats, M)
+            mixed_p, mixed_b = self._consensus(per_params, per_bstats, M,
+                                               plan=plan)
             new_p, new_b, losses = self._local_block(
                 mixed_p, mixed_b, rngs, data.X_train, data.y_train,
                 data.n_train, lr)
@@ -123,9 +143,17 @@ class DPSGDEngine(FederatedEngine):
 
         return jax.jit(round_fn)
 
-    @functools.cached_property
+    @property
+    def _round_jit(self):
+        return self._round_jit_for(None)
+
+    @functools.lru_cache(maxsize=4)
+    def _consensus_jit_for(self, plan):
+        return jax.jit(functools.partial(self._consensus, plan=plan))
+
+    @property
     def _consensus_jit(self):
-        return jax.jit(self._consensus)
+        return self._consensus_jit_for(None)
 
     @functools.cached_property
     def _block_jit(self):
@@ -141,8 +169,10 @@ class DPSGDEngine(FederatedEngine):
 
         return jax.jit(tail)
 
-    def _round_streaming(self, per_params, per_bstats, M, rngs, lr):
-        mixed_p, mixed_b = self._consensus_jit(per_params, per_bstats, M)
+    def _round_streaming(self, per_params, per_bstats, M, rngs, lr,
+                         plan=None):
+        mixed_p, mixed_b = self._consensus_jit_for(plan)(
+            per_params, per_bstats, M)
         (new_p, new_b), losses = self.stream_map_train_chunks(
             self._block_jit, (mixed_p, mixed_b), rngs, lr)
         w_global_p, w_global_b, mean_loss = self._tail_jit(
@@ -192,17 +222,21 @@ class DPSGDEngine(FederatedEngine):
                                   restored["g_bstats"])
             history = restored["history"]
         for round_idx in range(start, cfg.fed.comm_round):
-            M = jnp.asarray(self.mixing_matrix(round_idx))
+            M_np = self.mixing_matrix(round_idx)
+            plan = self.gossip_plan(M_np)
+            M = jnp.asarray(M_np)
             rngs = self.per_client_rngs(round_idx,
                                         np.arange(self.num_clients))
             if self.stream is not None:
                 per_params, per_bstats, g_params, g_bstats, loss = \
                     self._round_streaming(per_params, per_bstats, M, rngs,
-                                          self.round_lr(round_idx))
+                                          self.round_lr(round_idx),
+                                          plan=plan)
             else:
                 per_params, per_bstats, g_params, g_bstats, loss = \
-                    self._round_jit(per_params, per_bstats, self.data, M,
-                                    rngs, self.round_lr(round_idx))
+                    self._round_jit_for(plan)(
+                        per_params, per_bstats, self.data, M, rngs,
+                        self.round_lr(round_idx))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 mg = self._eval_g(g_params, g_bstats)

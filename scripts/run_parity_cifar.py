@@ -16,12 +16,14 @@ grad-norm clip 10, per-round lr decay — my_model_trainer.py:209,224-225):
   (no HDF5, no CUDA, argparse-free); file:line citations mark which
   semantics each block mirrors.
 
-The two sides intentionally differ in exactly one place: minibatch
-selection. The framework draws size-B batches with replacement from the
-client shard (jitted scan, core/trainer.py:134-141); torch shuffles the
-shard each epoch and walks it in order (reference DataLoader semantics,
-my_model_trainer.py:213). Everything else being equal, the two runs must
-converge to the same test metric within a small tolerance.
+Both sides walk a fresh per-epoch shuffle of each client shard in
+batch-size strides (reference DataLoader semantics, my_model_trainer.py:213
+— the framework's default batch_order="shuffle" since round 4; the exact
+scan-vs-torch step parity given one permutation is pinned by
+tests/test_torch_parity.py::test_local_train_shuffle_matches_torch_epoch_walk).
+The two runs draw different permutations (independent RNG streams), so the
+comparison is statistical: same semantics, same expected curve, small
+tolerance on the converged level.
 
 CIFAR-10 itself cannot be downloaded in this environment (zero egress), so
 the cohort is the package's class-separable synthetic CIFAR-shaped dataset
@@ -86,7 +88,7 @@ def build_cohort(p):
 
 def run_framework(p, Xtr, ytr, Xte, yte, train_map, test_map, tmp="/tmp"):
     from neuroimagedisttraining_tpu.config import (
-        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig, SparsityConfig,
     )
     from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
     from neuroimagedisttraining_tpu.data.federate import build_federated_data
@@ -94,8 +96,9 @@ def run_framework(p, Xtr, ytr, Xte, yte, train_map, test_map, tmp="/tmp"):
     from neuroimagedisttraining_tpu.models import create_model
     from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
 
+    algo = p.get("algorithm", "fedavg")
     cfg = ExperimentConfig(
-        model="cnn_cifar10", num_classes=10, algorithm="fedavg",
+        model="cnn_cifar10", num_classes=10, algorithm=algo,
         seed=p["seed"], tag="parity",
         data=DataConfig(dataset="synthetic_vision",
                         partition_method=p["partition"],
@@ -105,6 +108,9 @@ def run_framework(p, Xtr, ytr, Xte, yte, train_map, test_map, tmp="/tmp"):
                           batch_size=p["batch_size"], epochs=p["epochs"]),
         fed=FedConfig(client_num_in_total=p["clients"], frac=1.0,
                       comm_round=p["rounds"], frequency_of_the_test=1),
+        sparsity=SparsityConfig(
+            dense_ratio=p.get("dense_ratio", 0.5),
+            itersnip_iterations=p.get("itersnip_iterations", 1)),
         log_dir=tmp)
     fed = build_federated_data(Xtr, ytr, train_map, test_map, mesh=None,
                                X_eval=Xte, y_eval=yte)
@@ -112,7 +118,7 @@ def run_framework(p, Xtr, ytr, Xte, yte, train_map, test_map, tmp="/tmp"):
                            cfg.optim, num_classes=10)
     log = ExperimentLogger(tmp, "synthetic_vision", cfg.identity(),
                            console=False)
-    engine = create_engine("fedavg", cfg, fed, trainer, mesh=None,
+    engine = create_engine(algo, cfg, fed, trainer, mesh=None,
                            logger=log)
     init_params = engine.init_global_state()  # same seed the run re-inits with
     t0 = time.time()
@@ -120,7 +126,7 @@ def run_framework(p, Xtr, ytr, Xte, yte, train_map, test_map, tmp="/tmp"):
     curve = [{"round": h["round"], "acc": h["acc"],
               "acc_pooled": h["acc_pooled"], "loss": h["loss"]}
              for h in res["history"]]
-    return init_params, curve, time.time() - t0
+    return init_params, curve, time.time() - t0, res
 
 
 # ---------------------------------------------------------------- torch side
@@ -163,8 +169,75 @@ def _flax_to_torch_state(params):
             for k, v in sd.items()}
 
 
-def run_torch(p, init_params, Xtr, ytr, Xte, yte, train_map, test_map):
-    """Reference-semantics FedAvg loop in torch (fedavg_api.py:40-117)."""
+_MASKABLE = ("conv1.weight", "conv2.weight", "fc1.weight", "fc2.weight",
+             "fc3.weight")
+
+
+def _torch_fwd_masked(sd, masks, x):
+    """CNNCifar forward from a raw state dict with multiplicative weight
+    masks — the functional equivalent of the reference's monkey-patched
+    ``w * weight_mask`` forwards (snip.py:9-16)."""
+    import torch
+    import torch.nn.functional as F
+
+    h = F.max_pool2d(torch.relu(F.conv2d(
+        x, sd["conv1.weight"] * masks["conv1.weight"], sd["conv1.bias"])), 2, 2)
+    h = F.max_pool2d(torch.relu(F.conv2d(
+        h, sd["conv2.weight"] * masks["conv2.weight"], sd["conv2.bias"])), 2, 2)
+    h = h.reshape(h.shape[0], -1)
+    h = torch.relu(F.linear(
+        h, sd["fc1.weight"] * masks["fc1.weight"], sd["fc1.bias"]))
+    h = torch.relu(F.linear(
+        h, sd["fc2.weight"] * masks["fc2.weight"], sd["fc2.bias"]))
+    return F.linear(h, sd["fc3.weight"] * masks["fc3.weight"], sd["fc3.bias"])
+
+
+def torch_snip_masks(p, init_sd, Xtr, ytr, train_map):
+    """Independent torch SNIP phase 1 (snip.py:21-116 + client.py:30-53):
+    per-client IterSNIP |dL/d weight_mask| at mask=1, client mean, concat +
+    normalize by the global sum, keep the top dense_ratio fraction."""
+    import torch
+    import torch.nn as nn
+
+    X_t = torch.tensor(Xtr.transpose(0, 3, 1, 2))
+    y_t = torch.tensor(ytr.astype(np.int64))
+    loss_fn = nn.CrossEntropyLoss()
+    sd = {k: v.clone() for k, v in init_sd.items()}
+    I = p.get("itersnip_iterations", 1)
+    client_means = []
+    for c in range(p["clients"]):
+        idx = np.asarray(train_map[c])
+        if len(idx) == 0:
+            continue
+        rs = np.random.RandomState(p["seed"] * 977 + c)
+        acc = {k: torch.zeros_like(sd[k]) for k in _MASKABLE}
+        for _ in range(I):
+            # reference IterSNIP draws the first batch of a fresh shuffle
+            # per iteration (client.py:46-49 next(iter(loader)))
+            b = rs.permutation(idx)[: p["batch_size"]]
+            masks = {k: torch.ones_like(sd[k], requires_grad=True)
+                     for k in _MASKABLE}
+            loss = loss_fn(_torch_fwd_masked(sd, masks, X_t[b]), y_t[b])
+            loss.backward()
+            for k in _MASKABLE:
+                acc[k] += masks[k].grad.abs()
+        client_means.append({k: v / I for k, v in acc.items()})
+    # server mean over clients (snip.py:120-140)
+    mean = {k: sum(cm[k] for cm in client_means) / len(client_means)
+            for k in _MASKABLE}
+    # global top-k mask (snip.py:80-116)
+    all_scores = torch.cat([mean[k].flatten() for k in _MASKABLE])
+    norm = torch.sum(all_scores)
+    k_keep = int(len(all_scores) * p.get("dense_ratio", 0.5))
+    thr = torch.topk(all_scores / norm, k_keep, sorted=True)[0][-1]
+    return {k: ((mean[k] / norm) >= thr).float() for k in _MASKABLE}
+
+
+def run_torch(p, init_params, Xtr, ytr, Xte, yte, train_map, test_map,
+              masks=None):
+    """Reference-semantics FedAvg loop in torch (fedavg_api.py:40-117);
+    with ``masks``, the SalientGrads masked variant (post-step
+    ``param *= mask`` per batch, my_model_trainer.py:228-231)."""
     import torch
     import torch.nn as nn
 
@@ -258,6 +331,13 @@ def run_torch(p, init_params, Xtr, ytr, Xte, yte, train_map, test_map):
                     # clip_grad_norm(10) parity, my_model_trainer.py:224
                     torch.nn.utils.clip_grad_norm_(model.parameters(), 10.0)
                     opt.step()
+                    if masks is not None:
+                        # post-step re-mask per batch (my_model_trainer.py
+                        # :228-231 under args.snip_mask)
+                        with torch.no_grad():
+                            for name, param in model.named_parameters():
+                                if name in masks:
+                                    param.data *= masks[name]
             updates.append({k: v.detach().clone()
                             for k, v in model.state_dict().items()})
             weights.append(float(len(idx)))
@@ -271,25 +351,86 @@ def run_torch(p, init_params, Xtr, ytr, Xte, yte, train_map, test_map):
     return curve, time.time() - t0
 
 
+# ---------------------------------------------------------------- masks
+
+def _flax_masks_to_torch(masks):
+    """Framework mask pytree -> torch weight-name dict, with the same layout
+    transposes as ``_flax_to_torch_state`` (HWIO->OIHW; fc1 rows hwc->chw)."""
+    m = {k: np.asarray(masks[k]["kernel"]) for k in
+         ("conv1", "conv2", "fc1", "fc2", "fc3")}
+    fc1 = m["fc1"].reshape(5, 5, 64, 384).transpose(2, 0, 1, 3)
+    return {
+        "conv1.weight": m["conv1"].transpose(3, 2, 0, 1),
+        "conv2.weight": m["conv2"].transpose(3, 2, 0, 1),
+        "fc1.weight": fc1.reshape(5 * 5 * 64, 384).T,
+        "fc2.weight": m["fc2"].T,
+        "fc3.weight": m["fc3"].T,
+    }
+
+
+def compare_masks(fw_masks, th_masks):
+    """Per-layer + overall agreement and densities of the two masks."""
+    per_layer, agree_n, total_n, fw_nnz, th_nnz = {}, 0, 0, 0, 0
+    for k in _MASKABLE:
+        fw = np.asarray(fw_masks[k]) > 0.5
+        th = np.asarray(th_masks[k].numpy()) > 0.5
+        per_layer[k] = {
+            "agreement": float(np.mean(fw == th)),
+            "density_framework": float(fw.mean()),
+            "density_torch": float(th.mean()),
+        }
+        agree_n += int(np.sum(fw == th))
+        total_n += fw.size
+        fw_nnz += int(fw.sum())
+        th_nnz += int(th.sum())
+    return {
+        "overall_agreement": agree_n / total_n,
+        "density_framework": fw_nnz / total_n,
+        "density_torch": th_nnz / total_n,
+        "per_layer": per_layer,
+    }
+
+
 # ---------------------------------------------------------------- main
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=DEF["rounds"])
+    ap.add_argument("--algorithm", type=str, default="fedavg",
+                    choices=["fedavg", "salientgrads"])
+    ap.add_argument("--seed", type=int, default=DEF["seed"])
+    ap.add_argument("--itersnip_iterations", type=int, default=10,
+                    help="SNIP batches per client (salientgrads mode); "
+                         "more batches -> more stable scores -> higher "
+                         "expected cross-implementation mask agreement")
     ap.add_argument("--out", type=str, default="PARITY")
     args = ap.parse_args()
-    p = dict(DEF, rounds=args.rounds)
+    p = dict(DEF, rounds=args.rounds, algorithm=args.algorithm,
+             seed=args.seed, itersnip_iterations=args.itersnip_iterations,
+             dense_ratio=0.5)
 
     Xtr, ytr, Xte, yte, train_map, test_map = build_cohort(p)
     print(f"cohort: {len(ytr)} train / {len(yte)} test, "
-          f"{p['clients']} clients (n_cls alpha={p['alpha']})")
+          f"{p['clients']} clients (n_cls alpha={p['alpha']}), "
+          f"algorithm={p['algorithm']}, seed={p['seed']}")
 
-    init_params, jx_curve, jx_s = run_framework(
+    init_params, jx_curve, jx_s, res = run_framework(
         p, Xtr, ytr, Xte, yte, train_map, test_map)
     print(f"framework run: {jx_s:.1f}s, final acc={jx_curve[-1]['acc']:.4f}")
 
+    mask_report = None
+    th_masks = None
+    if p["algorithm"] == "salientgrads":
+        init_sd = _flax_to_torch_state(init_params.params)
+        th_masks = torch_snip_masks(p, init_sd, Xtr, ytr, train_map)
+        mask_report = compare_masks(_flax_masks_to_torch(res["masks"]),
+                                    th_masks)
+        print(f"mask agreement: {mask_report['overall_agreement']:.4f} "
+              f"(density fw {mask_report['density_framework']:.4f} / "
+              f"torch {mask_report['density_torch']:.4f})")
+
     th_curve, th_s = run_torch(p, init_params, Xtr, ytr, Xte, yte,
-                               train_map, test_map)
+                               train_map, test_map, masks=th_masks)
     print(f"torch run:     {th_s:.1f}s, final acc={th_curve[-1]['acc']:.4f}")
 
     # Verdict metric: TRAILING-5-ROUND mean accuracy. Both learners
@@ -304,7 +445,8 @@ def main():
     delta = abs(trail_fw - trail_th)
     ok = delta <= p["tolerance"]
     result = {
-        "config": p, "framework_curve": jx_curve, "torch_curve": th_curve,
+        "config": p, "mask_report": mask_report,
+        "framework_curve": jx_curve, "torch_curve": th_curve,
         "final_acc_framework": jx_curve[-1]["acc"],
         "final_acc_torch": th_curve[-1]["acc"],
         "final_round_delta": abs(jx_curve[-1]["acc"] - th_curve[-1]["acc"]),

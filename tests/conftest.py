@@ -37,5 +37,17 @@ def synthetic_cohort():
 
 
 @pytest.fixture(scope="session")
+def synthetic_cohort8():
+    """8-site cohort: one real client per device on the 8-device mesh
+    (ring-gossip plans require no padding clients)."""
+    from neuroimagedisttraining_tpu.data.synthetic import (
+        generate_synthetic_abcd,
+    )
+
+    return generate_synthetic_abcd(num_subjects=96, shape=(12, 14, 12),
+                                   num_sites=8, seed=1)
+
+
+@pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

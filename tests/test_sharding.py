@@ -53,34 +53,32 @@ def test_round_program_contains_cross_client_collective(tmp_path,
     assert leaf.sharding.is_fully_replicated
 
 
-def test_trainer_sampling_with_replacement_documented(tmp_path,
+def test_trainer_default_batch_order_is_epoch_shuffle(tmp_path,
                                                       synthetic_cohort):
-    """Pin the documented deviation (VERDICT weak #7): local minibatches are
-    drawn uniformly WITH replacement, so per-epoch sample coverage is
-    statistical, not exact — unlike the reference DataLoader's shuffled
-    partitions. Step counts still match the reference exactly."""
-    import math
+    """The round-3 with-replacement deviation is gone: the default batch
+    order walks a per-epoch permutation covering every valid sample exactly
+    once (reference DataLoader semantics); the old i.i.d. draw survives
+    only behind batch_order='replacement'."""
+    from neuroimagedisttraining_tpu.config import OptimConfig
+    from neuroimagedisttraining_tpu.core.trainer import (
+        epoch_permutations, shuffle_batch_indices,
+    )
 
+    assert OptimConfig().batch_order == "shuffle"
     engine = _make_engine(tmp_path, synthetic_cohort)
-    trainer = engine.trainer
-    n, b = 24, 8
-    X = jnp.zeros((32, 12, 14, 12), jnp.uint8)
-    y = jnp.zeros((32,), jnp.int32)
-    cs = trainer.init_client_state(jax.random.key(0), X[:1].astype(jnp.float32))
-    # per-epoch step quota equals the reference's ceil(n/b)
-    my_steps = int(jnp.ceil(jnp.asarray(n) / b))
-    assert my_steps == math.ceil(n / b)
-    # with-replacement draw: over one epoch some indices can repeat —
-    # simulate the same rng stream the trainer uses and observe repeats
-    rng = jax.random.key(7)
-    seen = []
-    for _ in range(my_steps):
-        rng, brng, _ = jax.random.split(rng, 3)
-        idx = jax.random.randint(brng, (b,), 0, n)
-        seen.extend(np.asarray(idx).tolist())
-    assert len(seen) == my_steps * b
-    # (statistical) replacement implies duplicates across an epoch draw
-    assert len(set(seen)) < len(seen)
+    assert engine.trainer.optim_cfg.batch_order == "shuffle"
+
+    n, b, max_samples, epochs = 21, 8, 32, 2
+    perms = epoch_permutations(jax.random.key(3), epochs, max_samples, n)
+    steps_per_epoch = -(-max_samples // b)
+    for e in range(epochs):
+        seen: list[int] = []
+        for s in range(steps_per_epoch):
+            t = e * steps_per_epoch + s
+            idx, w = shuffle_batch_indices(perms, t, steps_per_epoch, b, n)
+            seen.extend(np.asarray(idx)[np.asarray(w) > 0].tolist())
+        # exactly-once coverage of the n valid rows per epoch
+        assert sorted(seen) == list(range(n))
 
 
 def test_two_level_aggregation_matches_flat_and_bounds_byzantine_silo():

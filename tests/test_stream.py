@@ -65,10 +65,11 @@ def test_fetch_rows_unsorted_and_duplicate_indices(h5_cohort):
 
 
 def _run_algo(algo, cohort_or_stream, streaming: bool, tmp_path, tag,
-              mesh=None, **cfg_extra):
+              mesh=None, val_fraction=0.0, **cfg_extra):
     cfg = ExperimentConfig(
         model="3dcnn_tiny", num_classes=1, algorithm=algo,
-        data=DataConfig(dataset="synthetic", partition_method="site"),
+        data=DataConfig(dataset="synthetic", partition_method="site",
+                        val_fraction=val_fraction),
         optim=OptimConfig(lr=1e-2, batch_size=4, epochs=1),
         fed=FedConfig(client_num_in_total=4, comm_round=3, frac=0.5,
                       frequency_of_the_test=1),
@@ -82,7 +83,7 @@ def _run_algo(algo, cohort_or_stream, streaming: bool, tmp_path, tag,
                                logger=log, stream=cohort_or_stream)
     else:
         fed, _ = federate_cohort(cohort_or_stream, partition_method="site",
-                                 mesh=mesh)
+                                 mesh=mesh, val_fraction=val_fraction)
         engine = create_engine(algo, cfg, fed, trainer, mesh=mesh,
                                logger=log)
     return engine.train()
@@ -308,18 +309,103 @@ def test_streaming_turboaggregate_identical_to_resident(h5_cohort,
     assert res["final_global"] == st["final_global"]
 
 
-def test_streaming_rejects_unsupported_engine(h5_cohort, tmp_path):
-    """FedFomo is the one engine whose round genuinely needs every
-    client's VAL shard resident (the pair-list evaluation indexes them on
-    device); it must refuse --streaming with a clear error."""
+def test_streaming_fedfomo_identical_to_resident(h5_cohort, tmp_path):
+    """FedFomo — the last engine onto the streaming list (VERDICT r3
+    next-step #5): train shards chunk through stream_map_train_chunks
+    (chunk=2 < 4 exercises real chunking), the val_fraction-small val
+    shards are fetched resident once, and the pair-list evaluation gathers
+    from resident per-client models. Streamed == resident."""
+    from neuroimagedisttraining_tpu.data.federate import carve_val_split
+
+    path, data = h5_cohort
+    res = _run_algo("fedfomo", data, streaming=False, tmp_path=tmp_path,
+                    tag="ffres", val_fraction=0.25)
+    lazy = load_abcd_hdf5(path, lazy=True)
+    train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
+    # same carve the resident federate_cohort(val_fraction=0.25) applies
+    val_map, train_map = carve_val_split(train_map, 0.25, seed=42)
+    stream = StreamingFederation(lazy["X"], lazy["y"], train_map, test_map,
+                                 val_map=val_map)
+    try:
+        st = _run_algo("fedfomo", stream, streaming=True, tmp_path=tmp_path,
+                       tag="ffst", val_fraction=0.25,
+                       stream_chunk_clients=2)
+    finally:
+        stream.close()
+        lazy["file"].close()
+    for r_res, r_st in zip(res["history"], st["history"]):
+        # chunked scalar loss reduce may reassociate (same slack as the
+        # dispfl/local streamed tests); state comparisons are exact
+        np.testing.assert_allclose(r_st["train_loss"], r_res["train_loss"],
+                                   rtol=1e-6)
+        assert r_res["personal_acc"] == r_st["personal_acc"]
+    assert res["final_personal"] == st["final_personal"]
+    np.testing.assert_array_equal(np.asarray(res["weights"]),
+                                  np.asarray(st["weights"]))
+    np.testing.assert_array_equal(np.asarray(res["p_choose"]),
+                                  np.asarray(st["p_choose"]))
+
+
+def test_streaming_fedfomo_requires_val_map(h5_cohort, tmp_path):
+    """A StreamingFederation built without a val split must be refused
+    with a clear error (FedFomo's pair evals need val shards)."""
     path, data = h5_cohort
     lazy = load_abcd_hdf5(path, lazy=True)
     train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
     stream = StreamingFederation(lazy["X"], lazy["y"], train_map, test_map)
     try:
-        with pytest.raises(ValueError, match="does not support --streaming"):
+        with pytest.raises(ValueError, match="requires a val split"):
             _run_algo("fedfomo", stream, streaming=True,
                       tmp_path=tmp_path, tag="rej")
+    finally:
+        stream.close()
+        lazy["file"].close()
+
+
+def test_stream_transfer_stats_and_two_level_put(h5_cohort):
+    """The reader thread does fetch AND device_put (VERDICT r3 weak #2):
+    transfer_stats accumulates both stages, prefetched get_train returns
+    already-transferred arrays, and with a two-level (silos, clients) mesh
+    the round buffer shards over BOTH axes silo-major (VERDICT r3
+    next-step #10)."""
+    from neuroimagedisttraining_tpu.parallel.hierarchical import (
+        make_two_level_mesh,
+    )
+
+    path, data = h5_cohort
+    lazy = load_abcd_hdf5(path, lazy=True)
+    train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
+    mesh = make_two_level_mesh(2, 2)  # 4 clients over 2 silos x 2 cores
+    stream = StreamingFederation(lazy["X"], lazy["y"], train_map, test_map,
+                                 mesh=mesh)
+    try:
+        stream.prefetch_train(np.arange(4))
+        Xs, ys, ns = stream.get_train(np.arange(4))
+        assert stream.transfer_stats["fetches"] == 1
+        assert stream.transfer_stats["host_gather_ms"] > 0
+        assert stream.transfer_stats["device_put_ms"] > 0
+        # sharded over all 4 mesh devices, one client per device,
+        # silo-major placement = mesh device order
+        assert len(Xs.sharding.device_set) == 4
+        assert not Xs.sharding.is_fully_replicated
+        assert {s.data.shape[0] for s in Xs.addressable_shards} == {1}
+        mesh_order = [d.id for d in mesh.devices.reshape(-1)]
+        shard_dev = sorted((s.index[0].start, s.device.id)
+                           for s in Xs.addressable_shards)
+        assert [d for _, d in shard_dev] == mesh_order
+        # the silo-first two-level reduction accepts this layout directly
+        from neuroimagedisttraining_tpu.parallel.hierarchical import (
+            silo_then_global_mean,
+        )
+        from neuroimagedisttraining_tpu.utils.pytree import (
+            tree_weighted_mean,
+        )
+
+        w = ns.astype(np.float32)
+        got = silo_then_global_mean({"x": Xs.astype(np.float32)}, w, mesh)
+        want = tree_weighted_mean({"x": Xs.astype(np.float32)}, w)
+        np.testing.assert_allclose(np.asarray(got["x"]),
+                                   np.asarray(want["x"]), rtol=1e-6)
     finally:
         stream.close()
         lazy["file"].close()

@@ -108,6 +108,80 @@ def test_grad_clip_matches_torch_global_norm():
         np.testing.assert_allclose(-g, w, rtol=1e-5, atol=1e-6)
 
 
+def test_local_train_shuffle_matches_torch_epoch_walk():
+    """WHOLE local_train parity in the default shuffle mode: given the
+    same per-epoch permutations, E epochs of the jitted scan == a torch
+    loop walking the shuffled epoch in batch_size strides (reference
+    my_model_trainer.py:213-236), INCLUDING the weighted partial final
+    batch (n % B != 0) and the masked no-op steps beyond the quota."""
+    from neuroimagedisttraining_tpu.core.trainer import (
+        ClientState, LocalTrainer, epoch_permutations, shuffle_batch_indices,
+    )
+    import flax.linen as nn
+
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(10)(x)
+
+    n, b, max_samples, epochs = 20, 8, 32, 2  # last batch = 4 rows
+    lr, momentum, wd, clip = 0.05, 0.9, 5e-4, 10.0
+    rng = np.random.default_rng(11)
+    X = np.zeros((max_samples, 6), np.float32)
+    X[:n] = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.zeros((max_samples,), np.int32)
+    y[:n] = rng.integers(0, 10, n)
+
+    cfg = OptimConfig(lr=lr, momentum=momentum, wd=wd, grad_clip=clip,
+                      batch_size=b, epochs=epochs, batch_order="shuffle")
+    trainer = LocalTrainer(TinyMLP(), cfg, num_classes=10)
+    cs = trainer.init_client_state(jax.random.key(5), jnp.asarray(X[:1]))
+    new_cs, _ = trainer.local_train(cs, jnp.asarray(X), jnp.asarray(y),
+                                    jnp.int32(n), jnp.float32(lr),
+                                    epochs=epochs, batch_size=b,
+                                    max_samples=max_samples)
+
+    # reconstruct the trainer's own permutations from its rng split
+    prng = jax.random.split(cs.rng)[1]
+    perms = epoch_permutations(prng, epochs, max_samples, n)
+    steps_per_epoch = -(-max_samples // b)
+
+    k0 = np.asarray(cs.params["Dense_0"]["kernel"])
+    ps = [torch.nn.Parameter(torch.tensor(np.asarray(v)))
+          for v in (cs.params["Dense_0"]["kernel"],
+                    cs.params["Dense_0"]["bias"],
+                    cs.params["Dense_1"]["kernel"],
+                    cs.params["Dense_1"]["bias"])]
+
+    def fwd(xb):
+        h = torch.relu(xb @ ps[0] + ps[1])
+        return h @ ps[2] + ps[3]
+
+    opt = torch.optim.SGD(ps, lr=lr, momentum=momentum, weight_decay=wd)
+    X_t, y_t = torch.tensor(X), torch.tensor(y.astype(np.int64))
+    for t in range(epochs * steps_per_epoch):
+        idx, w = shuffle_batch_indices(perms, t, steps_per_epoch, b, n)
+        keep = np.asarray(idx)[np.asarray(w) > 0]
+        if len(keep) == 0:  # masked no-op step beyond the quota
+            continue
+        opt.zero_grad()
+        loss = torch.nn.CrossEntropyLoss()(fwd(X_t[keep]), y_t[keep])
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(ps, clip)
+        opt.step()
+
+    got = [np.asarray(v) for v in (new_cs.params["Dense_0"]["kernel"],
+                                   new_cs.params["Dense_0"]["bias"],
+                                   new_cs.params["Dense_1"]["kernel"],
+                                   new_cs.params["Dense_1"]["bias"])]
+    assert not np.allclose(got[0], k0)  # training actually moved params
+    for g, p in zip(got, ps):
+        np.testing.assert_allclose(g, p.detach().numpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def _torch_sepconv(c, k, stride, w):
     """Reference SepConv (operations.py:55-71) rebuilt in torch with the
     given flax weights: dw-conv(k,s) -> 1x1 -> BN -> relu -> dw-conv(k,1)
