@@ -61,8 +61,9 @@ class FedAvgServer(ServerManager):
     """Rank 0. Aggregates client updates sample-weighted per round."""
 
     def __init__(self, init_params, comm_round: int, num_clients: int,
-                 **kw):
-        super().__init__(rank=0, world_size=num_clients + 1, **kw)
+                 world_size: int | None = None, **kw):
+        super().__init__(rank=0, world_size=world_size or num_clients + 1,
+                         **kw)
         self.params = _to_numpy_tree(init_params)
         self.comm_round = comm_round
         self.num_clients = num_clients
@@ -101,8 +102,13 @@ class FedAvgServer(ServerManager):
                     np.asarray(leaves[0]).dtype),
             *trees)
         self._updates.clear()
+        self._complete_round(int(len(ws)))
+
+    def _complete_round(self, n_clients: int) -> None:
+        """Shared end-of-round transition: record history, advance, then
+        either finish the federation or broadcast the next sync."""
         self.history.append({"round": self.round_idx,
-                             "clients": int(len(ws))})
+                             "clients": n_clients})
         self.round_idx += 1
         if self.round_idx >= self.comm_round:
             self._broadcast_finish()
@@ -141,24 +147,39 @@ class SecureFedAvgServer(FedAvgServer):
     reported — so no stored server-side intermediate equals an individual
     client's update.
 
-    Trust model (same as the paper's single-aggregator degenerate case):
-    each client's n_shares slots transit THIS server, which is trusted not
-    to combine one client's slots before folding them into the
-    accumulators; a full deployment would route each slot j to a distinct
-    aggregator node over this same control plane."""
+    Trust model: with ``n_aggregators == 0`` (the paper's single-
+    aggregator degenerate case) each client's n_shares slots transit THIS
+    server, which is trusted not to combine one client's slots before
+    folding them into the accumulators. With ``n_aggregators == K > 0``
+    the grouped deployment the reference's TurboAggregate describes
+    (TA_trainer.py:38-85) runs for real: clients send slot j to
+    aggregator-j's OS process (``SlotAggregatorProc``), each aggregator
+    folds ITS slot across all clients and forwards one cross-client
+    total, and this server only ever sees K totals — no single node holds
+    enough to reconstruct any client (server included)."""
 
     def __init__(self, init_params, comm_round: int, num_clients: int,
-                 frac_bits: int = 16, **kw):
-        super().__init__(init_params, comm_round, num_clients, **kw)
+                 frac_bits: int = 16, n_aggregators: int = 0,
+                 record_trace: bool = False, **kw):
+        super().__init__(init_params, comm_round, num_clients,
+                         world_size=num_clients + 1 + n_aggregators, **kw)
         self.frac_bits = frac_bits
+        self.n_aggregators = n_aggregators
         self._slot_acc: dict | None = None
         self._n_by_client: dict[int, float] = {}
         self._n_clients_in = 0
+        self._slot_totals: dict[int, dict] = {}
+        #: when record_trace, every aggregator total this server saw —
+        #: model-sized per round, so tests-only
+        self.record_trace = record_trace
+        self.received_totals: list = []
 
     def register_message_receive_handlers(self) -> None:
         super().register_message_receive_handlers()
         self.register_message_receive_handler(
             M.MSG_TYPE_C2S_NUM_SAMPLES, self._on_num_samples)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_A2S_SLOT_TOTAL, self._on_slot_total)
 
     # ---- phase A: sample counts -> normalized weights ----
 
@@ -200,9 +221,30 @@ class SecureFedAvgServer(FedAvgServer):
                 frac_bits=self.frac_bits).astype(np.asarray(old).dtype),
             self._slot_acc, self.params)
         self._slot_acc = None
+        n_in, self._n_clients_in = self._n_clients_in, 0
+        self._complete_round(n_in)
+
+    # ---- phase B': aggregator slot totals (n_aggregators > 0) ----
+
+    def _on_slot_total(self, msg: M.Message) -> None:
+        from neuroimagedisttraining_tpu.ops import mpc
+
+        total = msg.get(M.ARG_MODEL_PARAMS)
+        if self.record_trace:
+            self.received_totals.append(total)
+        self._slot_totals[int(msg.get(M.ARG_SLOT_INDEX))] = total
+        if len(self._slot_totals) < self.n_aggregators:
+            return
+        totals = [self._slot_totals[j] for j in sorted(self._slot_totals)]
+        self.params = jax.tree.map(
+            lambda old, *slots: mpc.dequantize(
+                np.mod(sum(np.asarray(s, np.int64) for s in slots),
+                       mpc.P_DEFAULT),
+                frac_bits=self.frac_bits).astype(np.asarray(old).dtype),
+            self.params, *totals)
+        self._slot_totals.clear()
         self.history.append({"round": self.round_idx,
-                             "clients": self._n_clients_in})
-        self._n_clients_in = 0
+                             "clients": self.num_clients})
         self.round_idx += 1
         if self.round_idx >= self.comm_round:
             self._broadcast_finish()
@@ -211,13 +253,79 @@ class SecureFedAvgServer(FedAvgServer):
         else:
             self._broadcast_sync(M.MSG_TYPE_S2C_SYNC_MODEL)
 
+    def _broadcast_finish(self) -> None:
+        super()._broadcast_finish()
+        for j in range(self.n_aggregators):
+            self.send_message(M.Message(M.MSG_TYPE_S2C_FINISH, 0,
+                                        self.num_clients + 1 + j))
+
+
+class SlotAggregatorProc(ClientManager):
+    """Aggregator j (rank ``num_clients + 1 + j``): receives ONLY slot j
+    of every client's additive sharing per round, folds the slots mod p
+    across clients, and forwards the single cross-client total to the
+    server — TurboAggregate's grouped aggregation
+    (turboaggregate/TA_trainer.py:38-85): one share slot reveals nothing
+    about a client (it is uniform in GF(p)), and the forwarded total only
+    reveals the cross-client sum of that slot."""
+
+    def __init__(self, slot_index: int, num_clients: int,
+                 n_aggregators: int, record_trace: bool = False, **kw):
+        super().__init__(rank=num_clients + 1 + slot_index,
+                         world_size=num_clients + 1 + n_aggregators, **kw)
+        self.slot_index = slot_index
+        self.num_clients = num_clients
+        self._acc = None
+        self._clients_in = 0
+        #: when record_trace, every share received keyed by sender rank —
+        #: model-sized per client per round, so tests-only (they assert
+        #: what this process COULD learn); senders are always counted
+        self.record_trace = record_trace
+        self.received: dict[int, list] = {}
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2A_SEND_SLOT, self._on_slot)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_S2C_FINISH, lambda msg: self.finish())
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.com_manager.handle_receive_message()
+
+    def _on_slot(self, msg: M.Message) -> None:
+        from neuroimagedisttraining_tpu.ops import mpc
+
+        slot = msg.get(M.ARG_MODEL_PARAMS)
+        lst = self.received.setdefault(msg.sender_id, [])
+        if self.record_trace:
+            lst.append(slot)
+        if self._acc is None:
+            self._acc = jax.tree.map(
+                lambda s: np.asarray(s, np.int64) % mpc.P_DEFAULT, slot)
+        else:
+            self._acc = jax.tree.map(
+                lambda a, s: (a + np.asarray(s, np.int64)) % mpc.P_DEFAULT,
+                self._acc, slot)
+        self._clients_in += 1
+        if self._clients_in < self.num_clients:
+            return
+        out = M.Message(M.MSG_TYPE_A2S_SLOT_TOTAL, self.rank, 0)
+        out.add(M.ARG_MODEL_PARAMS, self._acc)
+        out.add(M.ARG_SLOT_INDEX, self.slot_index)
+        self.send_message(out)
+        self._acc = None
+        self._clients_in = 0
+
 
 class FedAvgClientProc(ClientManager):
     """Rank >= 1. Trains via the injected ``train_fn`` on every sync."""
 
     def __init__(self, rank: int, num_clients: int,
-                 train_fn: Callable, **kw):
-        super().__init__(rank=rank, world_size=num_clients + 1, **kw)
+                 train_fn: Callable, world_size: int | None = None, **kw):
+        super().__init__(rank=rank, world_size=world_size or num_clients + 1,
+                         **kw)
+        self.num_clients = num_clients
         self.train_fn = train_fn
         self.final_params = None
 
@@ -265,10 +373,16 @@ class SecureFedAvgClientProc(FedAvgClientProc):
 
     def __init__(self, rank: int, num_clients: int, train_fn: Callable,
                  n_shares: int = 3, frac_bits: int = 16, mpc_seed: int = 0,
-                 **kw):
-        super().__init__(rank, num_clients, train_fn, **kw)
+                 n_aggregators: int = 0, **kw):
+        if n_aggregators and n_aggregators != n_shares:
+            raise ValueError(
+                f"n_aggregators ({n_aggregators}) must equal n_shares "
+                f"({n_shares}): slot j routes to aggregator j")
+        super().__init__(rank, num_clients, train_fn,
+                         world_size=num_clients + 1 + n_aggregators, **kw)
         self.n_shares = n_shares
         self.frac_bits = frac_bits
+        self.n_aggregators = n_aggregators
         self._rng = np.random.default_rng(mpc_seed * 7919 + rank)
         self._trained = None  # params awaiting the weight reply
 
@@ -297,6 +411,17 @@ class SecureFedAvgClientProc(FedAvgClientProc):
                 self.n_shares, rng=self._rng),
             self._trained)
         self._trained = None
+        if self.n_aggregators:
+            # slot j -> aggregator j (rank num_clients+1+j): no single
+            # node ever holds two of this client's slots
+            for j in range(self.n_aggregators):
+                out = M.Message(M.MSG_TYPE_C2A_SEND_SLOT, self.rank,
+                                self.num_clients + 1 + j)
+                out.add(M.ARG_MODEL_PARAMS,
+                        jax.tree.map(lambda s: s[j], shares_tree))
+                out.add(M.ARG_SLOT_INDEX, j)
+                self.send_message(out)
+            return
         out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
         out.add(M.ARG_MODEL_PARAMS, shares_tree)
         self.send_message(out)

@@ -31,6 +31,11 @@ MSG_TYPE_S2C_FINISH = "server_finish"
 # secure-aggregation weight exchange (cross_silo.SecureFedAvgServer)
 MSG_TYPE_C2S_NUM_SAMPLES = "client_num_samples"
 MSG_TYPE_S2C_AGG_WEIGHTS = "server_agg_weights"
+# multi-aggregator secure aggregation (cross_silo.SlotAggregatorProc):
+# client -> aggregator j carries ONE share slot; aggregator -> server
+# carries the cross-client slot total
+MSG_TYPE_C2A_SEND_SLOT = "client_send_slot"
+MSG_TYPE_A2S_SLOT_TOTAL = "aggregator_slot_total"
 
 # payload keys (Message.MSG_ARG_KEY_* parity)
 ARG_MODEL_PARAMS = "model_params"
@@ -38,6 +43,7 @@ ARG_NUM_SAMPLES = "num_samples"
 ARG_CLIENT_INDEX = "client_index"
 ARG_ROUND_IDX = "round_idx"
 ARG_AGG_WEIGHT = "agg_weight"
+ARG_SLOT_INDEX = "slot_index"
 
 _MAGIC = b"NIDT1"
 

@@ -18,7 +18,15 @@ Across machines, pass every rank's address once to all processes:
 ``base_port + rank``). ``--secure`` swaps in the TurboAggregate
 additive-share protocol (SecureFedAvgServer/ClientProc): clients upload
 share slots of their weighted quantized updates and the server
-reconstructs only the aggregate.
+reconstructs only the aggregate. Add ``--n_aggregators K`` (= K extra
+processes with ``--role aggregator --slot_index j``, ranks
+num_clients+1+j) for the grouped deployment: slot j rides to aggregator
+j, each aggregator forwards only its cross-client slot total, and no
+single node — server included — can reconstruct any client::
+
+    # grouped secure aggregation: server + N silos + K aggregators
+    python -m ...distributed.run --role aggregator --slot_index 0 \
+        --num_clients 2 --n_aggregators 3 --secure ...
 
 Each client trains its own site shard with the real jitted LocalTrainer
 (silo k holds site ``(k-1) mod num_sites``); the server runs the
@@ -121,9 +129,20 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="neuroimagedisttraining_tpu.distributed.run",
         description=__doc__.split("\n\n")[0])
-    ap.add_argument("--role", required=True, choices=("server", "client"))
+    ap.add_argument("--role", required=True,
+                    choices=("server", "client", "aggregator"))
     ap.add_argument("--rank", type=int, default=0,
-                    help="client rank 1..num_clients (server is 0)")
+                    help="client rank 1..num_clients (server is 0); "
+                         "aggregator j is rank num_clients+1+j")
+    ap.add_argument("--slot_index", type=int, default=0,
+                    help="aggregator role: which share slot this process "
+                         "aggregates (0..n_aggregators-1)")
+    ap.add_argument("--n_aggregators", type=int, default=0,
+                    help="secure mode: route share slot j to a distinct "
+                         "aggregator process instead of the server "
+                         "(TurboAggregate grouped aggregation); must equal "
+                         "--mpc_n_shares; 0 = single-server degenerate "
+                         "mode")
     ap.add_argument("--num_clients", type=int, required=True)
     ap.add_argument("--comm_round", type=int, default=5)
     ap.add_argument("--base_port", type=int, default=29500)
@@ -152,6 +171,15 @@ def main(argv=None) -> int:
                          "processes on one machine sharing a tunneled "
                          "accelerator)")
     args = ap.parse_args(argv)
+    if args.n_aggregators > 0:
+        # fail fast on EVERY rank: mismatched flags would otherwise leave
+        # aggregator processes blocked forever (no slot, no FINISH)
+        if not args.secure:
+            ap.error("--n_aggregators requires --secure")
+        if args.n_aggregators != args.mpc_n_shares:
+            ap.error(f"--n_aggregators ({args.n_aggregators}) must equal "
+                     f"--mpc_n_shares ({args.mpc_n_shares}): slot j "
+                     "routes to aggregator j")
     host_map = _parse_hosts(args.hosts)
     if args.force_cpu:
         from neuroimagedisttraining_tpu.parallel.mesh import (
@@ -161,8 +189,21 @@ def main(argv=None) -> int:
 
     from neuroimagedisttraining_tpu.distributed.cross_silo import (
         FedAvgClientProc, FedAvgServer, SecureFedAvgClientProc,
-        SecureFedAvgServer,
+        SecureFedAvgServer, SlotAggregatorProc,
     )
+
+    if args.role == "aggregator":
+        agg = SlotAggregatorProc(args.slot_index, args.num_clients,
+                                 args.n_aggregators,
+                                 base_port=args.base_port,
+                                 host_map=host_map)
+        print(f"[aggregator {args.slot_index}] rank {agg.rank} "
+              f"aggregating slot {args.slot_index}", flush=True)
+        agg.run()
+        print(json.dumps({"role": "aggregator",
+                          "slot_index": args.slot_index,
+                          "clients_seen": len(agg.received)}), flush=True)
+        return 0
 
     if args.role == "server":
         import jax
@@ -190,7 +231,8 @@ def main(argv=None) -> int:
         init = {"params": jax.tree.map(np.asarray, gs.params),
                 "batch_stats": jax.tree.map(np.asarray, gs.batch_stats)}
         cls = SecureFedAvgServer if args.secure else FedAvgServer
-        kw = ({"frac_bits": args.mpc_frac_bits} if args.secure else {})
+        kw = ({"frac_bits": args.mpc_frac_bits,
+               "n_aggregators": args.n_aggregators} if args.secure else {})
         server = cls(init, args.comm_round, args.num_clients,
                      base_port=args.base_port, host_map=host_map, **kw)
         print(f"[server] listening on port {args.base_port}; waiting for "
@@ -208,7 +250,8 @@ def main(argv=None) -> int:
     train_fn = _make_train_fn(args)
     cls = SecureFedAvgClientProc if args.secure else FedAvgClientProc
     kw = ({"n_shares": args.mpc_n_shares, "frac_bits": args.mpc_frac_bits,
-           "mpc_seed": args.seed} if args.secure else {})
+           "mpc_seed": args.seed,
+           "n_aggregators": args.n_aggregators} if args.secure else {})
     client = cls(args.rank, args.num_clients, train_fn,
                  base_port=args.base_port, host_map=host_map, **kw)
     print(f"[silo {args.rank}] joining server", flush=True)

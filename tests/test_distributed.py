@@ -306,8 +306,93 @@ def test_cross_silo_secure_aggregation_protocol():
                                atol=1e-3)
 
 
-def _run_cross_silo_cli(base_port, extra=(), timeout=420):
-    """Launch 1 server + 2 silo client processes through the CLI runner."""
+def test_cross_silo_multi_aggregator_privacy_and_correctness():
+    """TurboAggregate's grouped aggregation for real (VERDICT r3 next-step
+    #4): 2 clients, 3 slot-aggregator nodes, slot j routed to aggregator
+    j over the socket plane. Trace-style privacy assertion (as
+    test_mpc.py:129): no single process's received data reconstructs any
+    client's quantized update — each aggregator holds ONE uniform share
+    slot per client, the server holds only cross-client totals. The
+    reconstructed aggregate must match the plain protocol."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        SecureFedAvgClientProc, SecureFedAvgServer, SlotAggregatorProc,
+    )
+    from neuroimagedisttraining_tpu.ops import mpc
+
+    num_clients, n_agg, comm_round, lr = 2, 3, 2, 0.5
+    init = {"w": np.zeros((3,), np.float32)}  # _run_protocol's shape
+
+    trained: dict[int, list] = {1: [], 2: []}
+
+    def make_train_fn(c):
+        def train_fn(params, round_idx):
+            p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+            p["w"] = p["w"] + lr * ((c + 1) - p["w"])
+            trained[c + 1].append(p["w"].copy())
+            return p, 10.0 * (c + 1)
+
+        return train_fn
+
+    plain = _run_protocol(num_clients, comm_round, _base_port(), lr=lr)
+
+    bp = _base_port()
+    server = SecureFedAvgServer(init, comm_round, num_clients,
+                                n_aggregators=n_agg, base_port=bp,
+                                record_trace=True)
+    aggs = [SlotAggregatorProc(j, num_clients, n_agg, base_port=bp,
+                               record_trace=True)
+            for j in range(n_agg)]
+    clients = [SecureFedAvgClientProc(c + 1, num_clients, make_train_fn(c),
+                                      n_shares=n_agg, n_aggregators=n_agg,
+                                      mpc_seed=c, base_port=bp)
+               for c in range(num_clients)]
+    threads = [threading.Thread(target=m.run)
+               for m in [server] + aggs + clients]
+    for t in threads:
+        t.start()
+    assert server._done.wait(timeout=60), "multi-agg protocol stalled"
+    for t in threads:
+        t.join(timeout=10)
+
+    assert len(server.history) == comm_round
+    np.testing.assert_allclose(server.params["w"], plain.params["w"],
+                               atol=1e-3)
+
+    # ---- trace-style privacy assertions ----
+    # every client's plaintext-equivalent: quantize(w_c * trained params)
+    n1, n2 = 10.0, 20.0
+    q_updates = []
+    for c, ws in trained.items():
+        w_c = (n1 if c == 1 else n2) / (n1 + n2)
+        for w_arr in ws:
+            q_updates.append(mpc.quantize(w_c * np.asarray(w_arr,
+                                                           np.float64)))
+    # aggregator j saw exactly one slot per client per round, and NONE of
+    # them equals any client's quantized update
+    for j, agg in enumerate(aggs):
+        assert sorted(agg.received) == [1, 2], "wrong senders"
+        for sender, slots in agg.received.items():
+            assert len(slots) == comm_round  # one slot per round
+            for slot in slots:
+                for q in q_updates:
+                    assert not np.array_equal(
+                        np.asarray(slot["w"], np.int64) % mpc.P_DEFAULT,
+                        q % mpc.P_DEFAULT), \
+                        f"aggregator {j} received a plaintext update"
+    # the server saw ONLY cross-client slot totals — none reconstructs a
+    # client either
+    assert len(server.received_totals) == n_agg * comm_round
+    for tot in server.received_totals:
+        for q in q_updates:
+            assert not np.array_equal(
+                np.asarray(tot["w"], np.int64) % mpc.P_DEFAULT,
+                q % mpc.P_DEFAULT), "server received a plaintext update"
+
+
+def _run_cross_silo_cli(base_port, extra=(), timeout=420,
+                        n_aggregators=0):
+    """Launch 1 server + 2 silo client processes through the CLI runner
+    (+ one OS process per slot aggregator when ``n_aggregators``)."""
     import subprocess
     import sys
 
@@ -322,18 +407,38 @@ def _run_cross_silo_cli(base_port, extra=(), timeout=420):
     server = subprocess.Popen(cmd + ["--role", "server"] + common,
                               stdout=subprocess.PIPE, text=True,
                               cwd="/root/repo")
+    aggs = [subprocess.Popen(
+        cmd + ["--role", "aggregator", "--slot_index", str(j)] + common,
+        stdout=subprocess.PIPE, text=True, cwd="/root/repo")
+        for j in range(n_aggregators)]
     clients = [subprocess.Popen(
         cmd + ["--role", "client", "--rank", str(r)] + common,
         stdout=subprocess.PIPE, text=True, cwd="/root/repo")
         for r in (1, 2)]
-    out, _ = server.communicate(timeout=timeout)
-    for c in clients:
-        c.wait(timeout=60)
-    assert server.returncode == 0, out[-500:]
+    try:
+        out, _ = server.communicate(timeout=timeout)
+        for c in clients:
+            c.wait(timeout=60)
+        # a failed server never sends FINISH — surface ITS error, not an
+        # aggregator TimeoutExpired
+        assert server.returncode == 0, out[-500:]
+        agg_outs = []
+        for a in aggs:
+            a_out, _ = a.communicate(timeout=60)
+            agg_outs.append(a_out)
+    finally:
+        for p in [server, *clients, *aggs]:
+            if p.poll() is None:
+                p.kill()
     last = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
     import json
 
-    return json.loads(last)
+    res = json.loads(last)
+    if n_aggregators:
+        res["aggregators"] = [
+            json.loads([ln for ln in a_out.splitlines()
+                        if ln.startswith("{")][-1]) for a_out in agg_outs]
+    return res
 
 
 def test_cross_silo_cli_runner():
@@ -355,6 +460,24 @@ def test_cross_silo_cli_runner_secure():
     assert sec["rounds_completed"] == 2 and sec["secure"] is True
     np.testing.assert_allclose(sec["final_param_norm"],
                                plain["final_param_norm"], rtol=1e-4)
+
+
+def test_cross_silo_cli_runner_secure_multi_aggregator():
+    """Full grouped deployment across SIX OS processes: server + 2 silo
+    trainers + 3 slot aggregators. Slot j rides to aggregator j; the
+    server combines only cross-client totals; the aggregate matches the
+    plain run to fixed-point precision."""
+    plain = _run_cross_silo_cli(_base_port())
+    sec = _run_cross_silo_cli(
+        _base_port(),
+        extra=("--secure", "--n_aggregators", "3", "--mpc_n_shares", "3"),
+        n_aggregators=3)
+    assert sec["rounds_completed"] == 2 and sec["secure"] is True
+    np.testing.assert_allclose(sec["final_param_norm"],
+                               plain["final_param_norm"], rtol=1e-4)
+    assert len(sec["aggregators"]) == 3
+    for a in sec["aggregators"]:
+        assert a["clients_seen"] == 2  # each aggregator heard both silos
 
 
 def test_broker_pubsub_transport():
