@@ -243,15 +243,7 @@ class SecureFedAvgServer(FedAvgServer):
                 frac_bits=self.frac_bits).astype(np.asarray(old).dtype),
             self.params, *totals)
         self._slot_totals.clear()
-        self.history.append({"round": self.round_idx,
-                             "clients": self.num_clients})
-        self.round_idx += 1
-        if self.round_idx >= self.comm_round:
-            self._broadcast_finish()
-            self._done.set()
-            self.finish()
-        else:
-            self._broadcast_sync(M.MSG_TYPE_S2C_SYNC_MODEL)
+        self._complete_round(self.num_clients)
 
     def _broadcast_finish(self) -> None:
         super()._broadcast_finish()
@@ -288,10 +280,6 @@ class SlotAggregatorProc(ClientManager):
             M.MSG_TYPE_C2A_SEND_SLOT, self._on_slot)
         self.register_message_receive_handler(
             M.MSG_TYPE_S2C_FINISH, lambda msg: self.finish())
-
-    def run(self) -> None:
-        self.register_message_receive_handlers()
-        self.com_manager.handle_receive_message()
 
     def _on_slot(self, msg: M.Message) -> None:
         from neuroimagedisttraining_tpu.ops import mpc

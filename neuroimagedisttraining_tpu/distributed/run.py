@@ -171,6 +171,13 @@ def main(argv=None) -> int:
                          "processes on one machine sharing a tunneled "
                          "accelerator)")
     args = ap.parse_args(argv)
+    if args.role == "aggregator":
+        if args.n_aggregators <= 0:
+            ap.error("--role aggregator requires --n_aggregators > 0 "
+                     "(same value on every rank)")
+        if not 0 <= args.slot_index < args.n_aggregators:
+            ap.error(f"--slot_index ({args.slot_index}) must be in "
+                     f"[0, {args.n_aggregators})")
     if args.n_aggregators > 0:
         # fail fast on EVERY rank: mismatched flags would otherwise leave
         # aggregator processes blocked forever (no slot, no FINISH)

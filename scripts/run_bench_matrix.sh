@@ -8,8 +8,11 @@
 #
 # Cells:
 #   {fedavg fast-path, salientgrads mask} x batch 16 x remat {none, stem}
-#   + per-algorithm round timings (dispfl/dpsgd/subavg/fedfomo, phase 3)
+#   + per-algorithm round timings (ALL engines incl. the flagship's
+#     masked round, ditto, local, turboaggregate + MPC stage; phase 3)
 #   + streaming samples/s on a synthetic larger-than-HBM-budget cohort
+#     with host-gather / device-put / wall attribution
+#   + ring-gossip ppermute-vs-einsum lowering & traffic cell
 #
 # Each bench.py invocation prints ONE JSON line; cells land in
 # $OUT/bench_<cell>.json and a combined $OUT/BENCH_MATRIX.json.
@@ -46,6 +49,14 @@ python scripts/bench_streaming.py > "$OUT/bench_streaming.json" \
     || echo '{"metric": "streaming", "error": "failed"}' \
         > "$OUT/bench_streaming.json"
 echo "    -> $(cut -c1-160 "$OUT/bench_streaming.json")" >&2
+
+# ring-gossip consensus: ppermute vs dense einsum (8-virtual-device mesh;
+# lowering + per-device traffic cell — multi-chip collectives don't run
+# on the single real chip)
+python scripts/bench_gossip.py > "$OUT/bench_gossip.json" \
+    || echo '{"metric": "gossip", "error": "failed"}' \
+        > "$OUT/bench_gossip.json"
+echo "    -> $(cut -c1-160 "$OUT/bench_gossip.json")" >&2
 
 python - "$OUT" <<'EOF'
 import json, sys, glob, os
