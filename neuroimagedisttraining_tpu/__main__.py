@@ -118,9 +118,9 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--streaming", action="store_true",
                         help="host-stream the cohort per round instead of "
                              "keeping it device-resident (cohorts > HBM); "
-                             "supported by every algorithm except fedfomo "
-                             "(whose round needs all clients' val shards "
-                             "resident)")
+                             "supported by all nine algorithms (fedfomo "
+                             "additionally needs --val_fraction > 0: its "
+                             "small val shards stay resident)")
     parser.add_argument("--stream_chunk_clients", type=int, default=0,
                         help="clients per host-fetched chunk in streaming "
                              "eval / SNIP scoring / chunked DisPFL rounds "
