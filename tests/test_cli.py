@@ -90,7 +90,11 @@ def test_cli_unknown_dataset_errors(tmp_path):
         build_experiment(cfg, console=False)
 
 
-def test_streaming_rejected_for_unsupported_algorithm(tmp_path):
+def test_streaming_fedfomo_requires_val_split(tmp_path):
+    """All nine algorithms stream; fedfomo's remaining precondition is a
+    val split (its pair-list eval keeps the val_fraction-small shards
+    resident), so --streaming without --val_fraction must fail with the
+    specific guard in engines/fedfomo.py, not a generic streaming error."""
     import pytest
 
     from neuroimagedisttraining_tpu.__main__ import build_experiment
@@ -102,7 +106,8 @@ def test_streaming_rejected_for_unsupported_algorithm(tmp_path):
     cfg = config_from_args(_parse([
         "--algorithm", "fedfomo", "--dataset", "abcd_h5",
         "--data_dir", path, "--log_dir", str(tmp_path)]))
-    with pytest.raises(ValueError, match="streaming"):
+    with pytest.raises(ValueError,
+                       match="streaming requires a val split"):
         build_experiment(cfg, streaming=True, console=False)
 
 
