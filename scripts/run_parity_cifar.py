@@ -444,6 +444,13 @@ def main():
     trail_th = float(np.mean([r["acc"] for r in th_curve[-k:]]))
     delta = abs(trail_fw - trail_th)
     ok = delta <= p["tolerance"]
+    # trailing-10 rides along for noise diagnosis: when both learners
+    # oscillate +-0.1-0.3 mid-convergence (hard partitions), the 5-round
+    # window can catch the two sides at opposite phases; the 10-round
+    # window says whether a trailing-5 excursion is phase noise
+    k10 = min(10, len(jx_curve))
+    trail10_fw = float(np.mean([r["acc"] for r in jx_curve[-k10:]]))
+    trail10_th = float(np.mean([r["acc"] for r in th_curve[-k10:]]))
     result = {
         "config": p, "mask_report": mask_report,
         "framework_curve": jx_curve, "torch_curve": th_curve,
@@ -453,6 +460,9 @@ def main():
         "trailing5_acc_framework": trail_fw,
         "trailing5_acc_torch": trail_th,
         "trailing5_delta": delta,
+        "trailing10_acc_framework": trail10_fw,
+        "trailing10_acc_torch": trail10_th,
+        "trailing10_delta": abs(trail10_fw - trail10_th),
         "tolerance": p["tolerance"], "parity": ok,
         "framework_seconds": jx_s, "torch_seconds": th_s,
     }
