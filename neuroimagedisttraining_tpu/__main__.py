@@ -252,7 +252,12 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
     elif streaming:
         if d.partition_method != "site":
             raise ValueError("streaming mode currently partitions by site")
-        train_map, test_map, _ = P.site_partition(cohort["site"], seed=42)
+        from neuroimagedisttraining_tpu.data.federate import DATA_SPLIT_SEED
+
+        # same split seed as federate_cohort's resident path: a streamed
+        # run must see the SAME train/test/val rows as a resident one
+        train_map, test_map, _ = P.site_partition(cohort["site"],
+                                                  seed=DATA_SPLIT_SEED)
         if mesh is not None and \
                 cfg.fed.client_num_per_round % mesh.devices.size != 0:
             raise ValueError(
@@ -275,7 +280,7 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
             )
 
             val_map, train_map = carve_val_split(train_map, d.val_fraction,
-                                                 seed=42)
+                                                 seed=DATA_SPLIT_SEED)
         stream = StreamingFederation(cohort["X"], cohort["y"], train_map,
                                      test_map, mesh=mesh, val_map=val_map)
         fed = None

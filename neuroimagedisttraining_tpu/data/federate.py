@@ -97,6 +97,13 @@ def build_federated_data(
     return FederatedData(**parts)
 
 
+#: Seed for the site-partition/val-carve data split. The STREAMING branch
+#: of __main__.build_experiment must derive its split from the same seed
+#: as this module's resident path, or a streamed run would train on rows
+#: the resident run holds out — keep both on this one constant.
+DATA_SPLIT_SEED = 42
+
+
 def carve_val_split(train_map: dict[int, np.ndarray], val_fraction: float,
                     seed: int) -> tuple[dict, dict]:
     """Carve a validation split out of each client's train shard (FedFomo
@@ -115,7 +122,8 @@ def carve_val_split(train_map: dict[int, np.ndarray], val_fraction: float,
 
 def federate_cohort(data: dict[str, np.ndarray], partition_method: str = "site",
                     client_number: int | None = None, alpha: float = 0.5,
-                    seed: int = 42, mesh=None, val_fraction: float = 0.0
+                    seed: int = DATA_SPLIT_SEED, mesh=None,
+                    val_fraction: float = 0.0
                     ) -> tuple[FederatedData, dict]:
     """Partition a cohort dict {X, y, site} into a FederatedData using the
     reference's partition modes (SURVEY.md §2.6)."""

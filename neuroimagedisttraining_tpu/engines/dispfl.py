@@ -237,8 +237,14 @@ class DisPFLEngine(FederatedEngine):
                                          X, y, n)
         return new_p, new_b, new_masks, losses
 
-    @functools.lru_cache(maxsize=4)
     def _round_jit_for(self, plan):
+        # per-INSTANCE plan-keyed cache (an lru_cache on the method would
+        # store `self` in a class-level table, pinning discarded engines
+        # and their device-resident data past their lifetime)
+        cache = self.__dict__.setdefault("_round_jit_cache", {})
+        if plan in cache:
+            return cache[plan]
+
         def round_fn(per_params, per_bstats, masks_local, masks_shared,
                      data, A, rngs, lr, round_idx):
             w_local, b_mixed = self._consensus(
@@ -257,7 +263,8 @@ class DisPFLEngine(FederatedEngine):
             # next round's shared masks = this round's PRE-evolution masks
             return new_p, new_b, new_masks, masks_local, dist_self, mean_loss
 
-        return jax.jit(round_fn)
+        cache[plan] = jax.jit(round_fn)
+        return cache[plan]
 
     @property
     def _round_jit(self):
@@ -273,9 +280,12 @@ class DisPFLEngine(FederatedEngine):
 
     # ---------- streamed round (data per chunk, state resident) ----------
 
-    @functools.lru_cache(maxsize=4)
     def _consensus_jit_for(self, plan):
-        return jax.jit(functools.partial(self._consensus, plan=plan))
+        cache = self.__dict__.setdefault("_consensus_jit_cache", {})
+        if plan not in cache:
+            cache[plan] = jax.jit(functools.partial(self._consensus,
+                                                    plan=plan))
+        return cache[plan]
 
     @property
     def _consensus_jit(self):

@@ -128,8 +128,14 @@ class DPSGDEngine(FederatedEngine):
             ).astype(x.dtype), t)
         return gmean(new_p), gmean(new_b), real, denom
 
-    @functools.lru_cache(maxsize=4)
     def _round_jit_for(self, plan):
+        # per-INSTANCE plan-keyed cache (an lru_cache on the method would
+        # store `self` in a class-level table, pinning discarded engines
+        # and their device-resident data past their lifetime)
+        cache = self.__dict__.setdefault("_round_jit_cache", {})
+        if plan in cache:
+            return cache[plan]
+
         def round_fn(per_params, per_bstats, data, M, rngs, lr):
             mixed_p, mixed_b = self._consensus(per_params, per_bstats, M,
                                                plan=plan)
@@ -141,15 +147,19 @@ class DPSGDEngine(FederatedEngine):
             mean_loss = jnp.sum(losses * real) / denom
             return new_p, new_b, w_global_p, w_global_b, mean_loss
 
-        return jax.jit(round_fn)
+        cache[plan] = jax.jit(round_fn)
+        return cache[plan]
 
     @property
     def _round_jit(self):
         return self._round_jit_for(None)
 
-    @functools.lru_cache(maxsize=4)
     def _consensus_jit_for(self, plan):
-        return jax.jit(functools.partial(self._consensus, plan=plan))
+        cache = self.__dict__.setdefault("_consensus_jit_cache", {})
+        if plan not in cache:
+            cache[plan] = jax.jit(functools.partial(self._consensus,
+                                                    plan=plan))
+        return cache[plan]
 
     @property
     def _consensus_jit(self):

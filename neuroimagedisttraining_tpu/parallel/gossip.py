@@ -100,6 +100,11 @@ def gossip_apply(tree, plan: Plan, mesh):
 
     if not jax.tree.leaves(tree):  # e.g. batch_stats of a GroupNorm model
         return tree
+    if not plan:
+        # an all-zero matrix is (trivially) circulant and yields an empty
+        # plan; the consensus it defines is identically zero — match the
+        # einsum path instead of tripping over an empty accumulation
+        return jax.tree.map(jnp.zeros_like, tree)
     D = mesh.devices.size
     specs = jax.tree.map(
         lambda x: PartitionSpec(CLIENT_AXIS, *([None] * (x.ndim - 1))),

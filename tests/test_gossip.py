@@ -40,6 +40,23 @@ def test_circulant_plan_detection():
     assert circulant_plan(R) is None
 
 
+def test_gossip_apply_empty_plan_is_zero():
+    """An all-zero matrix is trivially circulant -> empty plan; the
+    consensus it defines is identically zero, and gossip_apply must
+    return that (matching the einsum path) rather than crash on an
+    empty accumulation."""
+    mesh = make_mesh()
+    Z = np.zeros((8, 8), np.float32)
+    plan = circulant_plan(Z)
+    assert plan == ()
+    assert plan_fits_mesh(plan, mesh, 8)
+    tree = {"w": jnp.ones((8, 3, 2), jnp.float32)}
+    out = gossip_apply(tree, plan, mesh)
+    np.testing.assert_array_equal(np.asarray(out["w"]), 0.0)
+    want = jnp.einsum("cj,j...->c...", jnp.asarray(Z), tree["w"])
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(want))
+
+
 def test_plan_fits_mesh_bounds():
     mesh = make_mesh()
     plan = circulant_plan(ring_mixing_matrix(8))
