@@ -224,6 +224,25 @@ class FederatedEngine:
 
     # ---------- helpers ----------
 
+    #: cap on per-instance plan-keyed jit caches (matches the old
+    #: lru_cache(4) bound): a topology whose circulant weights vary per
+    #: round must not accumulate one compiled executable per distinct plan
+    #: for the engine's lifetime
+    _JIT_CACHE_CAP = 4
+
+    def _plan_cached(self, cache_name: str, key, build):
+        """Per-instance plan-keyed cache with FIFO eviction past
+        ``_JIT_CACHE_CAP`` (a class-level lru_cache would store ``self``
+        and pin discarded engines' device-resident data)."""
+        cache = self.__dict__.setdefault(cache_name, {})
+        if key in cache:
+            cache[key] = cache.pop(key)  # refresh recency (true LRU)
+            return cache[key]
+        if len(cache) >= self._JIT_CACHE_CAP:
+            cache.pop(next(iter(cache)))
+        cache[key] = build()
+        return cache[key]
+
     def _max_samples(self) -> int:
         """Static per-client sample-axis pad (same in streamed and
         resident layouts, so round programs compile once)."""

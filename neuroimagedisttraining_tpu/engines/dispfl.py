@@ -238,33 +238,30 @@ class DisPFLEngine(FederatedEngine):
         return new_p, new_b, new_masks, losses
 
     def _round_jit_for(self, plan):
-        # per-INSTANCE plan-keyed cache (an lru_cache on the method would
-        # store `self` in a class-level table, pinning discarded engines
-        # and their device-resident data past their lifetime)
-        cache = self.__dict__.setdefault("_round_jit_cache", {})
-        if plan in cache:
-            return cache[plan]
+        def build():
+            def round_fn(per_params, per_bstats, masks_local, masks_shared,
+                         data, A, rngs, lr, round_idx):
+                w_local, b_mixed = self._consensus(
+                    per_params, per_bstats, masks_local, masks_shared, A,
+                    plan=plan)
+                new_p, new_b, new_masks, losses = self._local_and_evolve(
+                    w_local, b_mixed, masks_local, rngs,
+                    data.X_train, data.y_train, data.n_train, lr, round_idx)
+                # mask change tracking: hamming(shared_lstrd, local) per
+                # client (dispfl_api.py:110)
+                dist_self = jax.vmap(M.mask_hamming_distance)(masks_shared,
+                                                              masks_local)
+                real = (data.n_train > 0).astype(jnp.float32)
+                mean_loss = jnp.sum(losses * real) / jnp.maximum(
+                    jnp.sum(real), 1.0)
+                # next round's shared masks = this round's PRE-evolution
+                # masks
+                return (new_p, new_b, new_masks, masks_local, dist_self,
+                        mean_loss)
 
-        def round_fn(per_params, per_bstats, masks_local, masks_shared,
-                     data, A, rngs, lr, round_idx):
-            w_local, b_mixed = self._consensus(
-                per_params, per_bstats, masks_local, masks_shared, A,
-                plan=plan)
-            new_p, new_b, new_masks, losses = self._local_and_evolve(
-                w_local, b_mixed, masks_local, rngs,
-                data.X_train, data.y_train, data.n_train, lr, round_idx)
-            # mask change tracking: hamming(shared_lstrd, local) per client
-            # (dispfl_api.py:110)
-            dist_self = jax.vmap(M.mask_hamming_distance)(masks_shared,
-                                                          masks_local)
-            real = (data.n_train > 0).astype(jnp.float32)
-            mean_loss = jnp.sum(losses * real) / jnp.maximum(jnp.sum(real),
-                                                             1.0)
-            # next round's shared masks = this round's PRE-evolution masks
-            return new_p, new_b, new_masks, masks_local, dist_self, mean_loss
+            return jax.jit(round_fn)
 
-        cache[plan] = jax.jit(round_fn)
-        return cache[plan]
+        return self._plan_cached("_round_jit_cache", plan, build)
 
     @property
     def _round_jit(self):
@@ -281,11 +278,9 @@ class DisPFLEngine(FederatedEngine):
     # ---------- streamed round (data per chunk, state resident) ----------
 
     def _consensus_jit_for(self, plan):
-        cache = self.__dict__.setdefault("_consensus_jit_cache", {})
-        if plan not in cache:
-            cache[plan] = jax.jit(functools.partial(self._consensus,
-                                                    plan=plan))
-        return cache[plan]
+        return self._plan_cached(
+            "_consensus_jit_cache", plan,
+            lambda: jax.jit(functools.partial(self._consensus, plan=plan)))
 
     @property
     def _consensus_jit(self):

@@ -129,37 +129,30 @@ class DPSGDEngine(FederatedEngine):
         return gmean(new_p), gmean(new_b), real, denom
 
     def _round_jit_for(self, plan):
-        # per-INSTANCE plan-keyed cache (an lru_cache on the method would
-        # store `self` in a class-level table, pinning discarded engines
-        # and their device-resident data past their lifetime)
-        cache = self.__dict__.setdefault("_round_jit_cache", {})
-        if plan in cache:
-            return cache[plan]
+        def build():
+            def round_fn(per_params, per_bstats, data, M, rngs, lr):
+                mixed_p, mixed_b = self._consensus(per_params, per_bstats,
+                                                   M, plan=plan)
+                new_p, new_b, losses = self._local_block(
+                    mixed_p, mixed_b, rngs, data.X_train, data.y_train,
+                    data.n_train, lr)
+                w_global_p, w_global_b, real, denom = self._global_mean(
+                    new_p, new_b, data.n_train)
+                mean_loss = jnp.sum(losses * real) / denom
+                return new_p, new_b, w_global_p, w_global_b, mean_loss
 
-        def round_fn(per_params, per_bstats, data, M, rngs, lr):
-            mixed_p, mixed_b = self._consensus(per_params, per_bstats, M,
-                                               plan=plan)
-            new_p, new_b, losses = self._local_block(
-                mixed_p, mixed_b, rngs, data.X_train, data.y_train,
-                data.n_train, lr)
-            w_global_p, w_global_b, real, denom = self._global_mean(
-                new_p, new_b, data.n_train)
-            mean_loss = jnp.sum(losses * real) / denom
-            return new_p, new_b, w_global_p, w_global_b, mean_loss
+            return jax.jit(round_fn)
 
-        cache[plan] = jax.jit(round_fn)
-        return cache[plan]
+        return self._plan_cached("_round_jit_cache", plan, build)
 
     @property
     def _round_jit(self):
         return self._round_jit_for(None)
 
     def _consensus_jit_for(self, plan):
-        cache = self.__dict__.setdefault("_consensus_jit_cache", {})
-        if plan not in cache:
-            cache[plan] = jax.jit(functools.partial(self._consensus,
-                                                    plan=plan))
-        return cache[plan]
+        return self._plan_cached(
+            "_consensus_jit_cache", plan,
+            lambda: jax.jit(functools.partial(self._consensus, plan=plan)))
 
     @property
     def _consensus_jit(self):

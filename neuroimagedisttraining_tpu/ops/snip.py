@@ -112,9 +112,28 @@ def mask_from_scores(scores: PyTree, keep_ratio: float) -> tuple[PyTree, jax.Arr
     tree_map_with_path_names(collect, scores)
     all_scores = jnp.concatenate(flat_parts)
     norm = jnp.sum(all_scores)
+    # Fail LOUDLY on non-finite saliency (e.g. one client's phase-1 loss
+    # diverged): the histogram top-k would otherwise return a garbage
+    # threshold and the run would continue with a silently-wrong global
+    # mask. (The reference would crash inside torch.topk; silence is
+    # worse.) This runs eagerly — generate_global_mask calls it outside
+    # jit — so a host-side raise is available; under a trace the bool()
+    # conversion itself errors, which is still loud.
+    if not bool(jnp.isfinite(norm)):
+        bad = int(jnp.sum(~jnp.isfinite(all_scores)))
+        raise FloatingPointError(
+            f"SNIP saliency scores contain {bad} non-finite entries (or "
+            "their sum overflows): refusing to build the global mask. "
+            "Check the phase-1 loss of each client for divergence.")
     all_scores = all_scores / norm
     k = max(1, int(total_elems * keep_ratio))
     threshold = kth_largest(all_scores, k)
+    if not bool(jnp.isfinite(threshold)):
+        bad = int(jnp.sum(~jnp.isfinite(all_scores)))
+        raise FloatingPointError(
+            f"global top-k threshold is non-finite ({bad} non-finite "
+            "normalized saliency scores): refusing to build the global "
+            "mask. Check the phase-1 loss of each client for divergence.")
 
     def build(name, s):
         if is_weight_kernel(name, s):

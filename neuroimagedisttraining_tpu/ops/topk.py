@@ -99,6 +99,15 @@ def kth_largest(x: jax.Array, k: int, rounds: int = 4, nbins: int = 512,
     A mask ``x >= kth_largest(x, k)`` keeps >= k entries (ties included) —
     the same semantics as the reference's ``>= acceptable_score``
     (snip.py:96-98).
+
+    Non-finite contract: the histogram bracket assumes every comparison
+    ``x >= t`` is meaningful; a single NaN (or a +/-inf min/max bracket)
+    would otherwise silently converge to a garbage threshold — worse than
+    the reference, whose ``torch.topk`` would at least surface the NaN in
+    the returned value. So non-finite input yields a NaN threshold
+    (which poisons any ``>=`` mask to all-False *visibly*, and which
+    eager callers — ops/snip.py:mask_from_scores — turn into a raised
+    error before any mask is built).
     """
     assert x.ndim == 1
     assert nbins % _BIN_CHUNK == 0, (
@@ -127,7 +136,8 @@ def kth_largest(x: jax.Array, k: int, rounds: int = 4, nbins: int = 512,
         return (new_lo, new_hi), None
 
     (lo, hi), _ = jax.lax.scan(round_fn, (lo, hi), None, length=rounds)
-    return lo
+    ok = jnp.all(jnp.isfinite(x))
+    return jnp.where(ok, lo, jnp.float32(jnp.nan))
 
 
 def topk_threshold_mask(x: jax.Array, k: int, **kw) -> tuple[jax.Array, jax.Array]:

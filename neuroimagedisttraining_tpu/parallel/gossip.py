@@ -98,9 +98,17 @@ def gossip_apply(tree, plan: Plan, mesh):
     to collective-permutes of |k|-row slices instead of an all-to-all."""
     from jax.sharding import PartitionSpec
 
+    if plan is None:
+        # None is the "not circulant" sentinel from circulant_plan — the
+        # caller should have taken the dense einsum path; silently gossiping
+        # nothing here would return an all-zero consensus for a matrix that
+        # is NOT all-zero
+        raise ValueError(
+            "gossip_apply(plan=None): None means 'not circulant, use the "
+            "dense einsum path'; only an actual Plan tuple is accepted")
     if not jax.tree.leaves(tree):  # e.g. batch_stats of a GroupNorm model
         return tree
-    if not plan:
+    if plan == ():
         # an all-zero matrix is (trivially) circulant and yields an empty
         # plan; the consensus it defines is identically zero — match the
         # einsum path instead of tripping over an empty accumulation

@@ -57,6 +57,15 @@ def test_gossip_apply_empty_plan_is_zero():
     np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(want))
 
 
+def test_gossip_apply_rejects_none_plan():
+    """ADVICE r4: plan=None is the 'not circulant' sentinel — passing it
+    through must raise, not silently return an all-zero consensus."""
+    mesh = make_mesh()
+    tree = {"w": jnp.ones((8, 3), jnp.float32)}
+    with pytest.raises(ValueError, match="plan=None"):
+        gossip_apply(tree, None, mesh)
+
+
 def test_plan_fits_mesh_bounds():
     mesh = make_mesh()
     plan = circulant_plan(ring_mixing_matrix(8))

@@ -33,6 +33,30 @@ def test_kth_largest_with_duplicates():
     assert float(kth_largest(x, 5)) == 1.0
 
 
+def test_kth_largest_nan_input_yields_nan_not_garbage():
+    """VERDICT r4 #7: a single NaN score (one client's diverged loss) must
+    not silently produce a wrong-but-finite threshold."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=1000).astype(np.float32)
+    x[137] = np.nan
+    assert np.isnan(float(kth_largest(jnp.asarray(x), 10)))
+    x[137] = np.inf
+    assert np.isnan(float(kth_largest(jnp.asarray(x), 10)))
+
+
+def test_mask_from_scores_raises_on_nonfinite():
+    _, _, cs = _toy_trainer()
+    rng = np.random.default_rng(0)
+    scores = jax.tree.map(
+        lambda p: jnp.asarray(np.abs(rng.normal(size=p.shape)), jnp.float32),
+        cs.params)
+    # poison ONE maskable leaf with a single NaN
+    k = scores["f0"]["conv"]["kernel"]
+    scores["f0"]["conv"]["kernel"] = k.at[(0,) * k.ndim].set(jnp.nan)
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        S.mask_from_scores(scores, keep_ratio=0.3)
+
+
 def _toy_trainer():
     model = Tiny3DCNN(num_classes=1)
     trainer = LocalTrainer(model, OptimConfig(batch_size=4), num_classes=1)
