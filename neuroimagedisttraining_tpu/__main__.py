@@ -35,8 +35,9 @@ from neuroimagedisttraining_tpu.config import (
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     # reference flag surface (main_sailentgrads.py:31-127)
     parser.add_argument("--algorithm", type=str, default="fedavg",
-                        help="fedavg | salientgrads | dispfl | subavg | "
-                             "fedfomo | dpsgd | ditto | local")
+                        help="fedavg | fedprox | salientgrads | dispfl | "
+                             "subavg | fedfomo | dpsgd | ditto | local | "
+                             "turboaggregate")
     parser.add_argument("--model", type=str, default="3DCNN")
     parser.add_argument("--dataset", type=str, default="ABCD",
                         help="ABCD | abcd_h5 | synthetic | cifar10 | "
@@ -118,7 +119,7 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--streaming", action="store_true",
                         help="host-stream the cohort per round instead of "
                              "keeping it device-resident (cohorts > HBM); "
-                             "supported by all nine algorithms (fedfomo "
+                             "supported by all ten algorithms (fedfomo "
                              "additionally needs --val_fraction > 0: its "
                              "small val shards stay resident)")
     parser.add_argument("--stream_chunk_clients", type=int, default=0,

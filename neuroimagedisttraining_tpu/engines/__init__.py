@@ -2,6 +2,7 @@
 
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.fedavg import FedAvgEngine  # noqa: F401
+from neuroimagedisttraining_tpu.engines.fedprox import FedProxEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.salientgrads import SalientGradsEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.local import LocalEngine  # noqa: F401
 from neuroimagedisttraining_tpu.engines.ditto import DittoEngine  # noqa: F401
@@ -13,6 +14,7 @@ from neuroimagedisttraining_tpu.engines.turboaggregate import TurboAggregateEngi
 
 ENGINES = {
     "fedavg": FedAvgEngine,
+    "fedprox": FedProxEngine,
     "salientgrads": SalientGradsEngine,
     "sailentgrads": SalientGradsEngine,  # reference spelling
     "local": LocalEngine,

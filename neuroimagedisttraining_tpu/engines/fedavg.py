@@ -30,6 +30,11 @@ class FedAvgEngine(FederatedEngine):
     name = "fedavg"
     supports_streaming = True
 
+    def _prox_kwargs(self, global_params) -> dict:
+        """Extra ``local_train`` kwargs tying the local objective to the
+        round's incoming global model; FedProx overrides."""
+        return {}
+
     def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr):
         """One FedAvg round over pre-gathered sampled-client shards; shared
         by the device-resident and streaming paths."""
@@ -37,6 +42,7 @@ class FedAvgEngine(FederatedEngine):
         o = self.cfg.optim
         S = Xs.shape[0]
         max_samples = self._max_samples()
+        prox = self._prox_kwargs(params)
         cs = ClientState(
             params=jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
@@ -51,7 +57,7 @@ class FedAvgEngine(FederatedEngine):
         def local(cs_c, Xc, yc, nc):
             return trainer.local_train(
                 cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                batch_size=o.batch_size, max_samples=max_samples)
+                batch_size=o.batch_size, max_samples=max_samples, **prox)
 
         cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
         w = ns.astype(jnp.float32)

@@ -1,0 +1,39 @@
+"""FedProx: FedAvg with a global-model proximal term in the local objective.
+
+BASELINE.json configs[3] names "FedProx + robust aggregation
+(fedml_core/robustness) under Byzantine clients"; the reference repo ships
+the robustness half (fedml_core/robustness/robust_aggregation.py:32-55) but
+no fedprox engine, so the round shape here is FedAvg's
+(fedml_api/standalone/fedavg/fedavg_api.py:40-117) with the FedProx local
+objective
+
+    min_w  F_c(w) + (mu/2) * ||w - w_global||^2
+
+handled by proximal-gradient splitting: after every SGD step on F_c, pull
+``w -= lr * mu * (w - w_global)`` — the exact update the reference's Ditto
+trainer applies for its personal proximal term
+(fedml_api/standalone/ditto/my_model_trainer.py:63-64), here referenced to
+the round's INCOMING global model (FedProx) rather than Ditto's concurrent
+global track. ``mu`` reuses the reference's ``lamda`` flag.
+
+Aggregation, sampling, evaluation, the final fine-tune pass, streaming, and
+the robust defenses (``--defense_type norm_diff_clipping`` / ``weak_dp``)
+are inherited from the FedAvg engine unchanged — composing FedProx with
+Byzantine-client clipping is exactly the blueprint config.
+"""
+
+from __future__ import annotations
+
+from neuroimagedisttraining_tpu.engines.fedavg import FedAvgEngine
+
+
+class FedProxEngine(FedAvgEngine):
+    name = "fedprox"
+    supports_streaming = True
+
+    def _prox_kwargs(self, global_params) -> dict:
+        # inside the vmapped per-client closure the unbatched global
+        # reference broadcasts as a constant (same pattern as Ditto's
+        # personal track, engines/ditto.py)
+        return {"prox_lamda": float(self.cfg.fed.lamda),
+                "prox_ref": global_params}

@@ -182,6 +182,82 @@ def test_local_train_shuffle_matches_torch_epoch_walk():
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_local_train_prox_matches_torch_epoch_walk():
+    """FedProx local objective parity: the same epoch walk as above with a
+    post-step proximal pull ``w -= lr * mu * (w - w_ref)`` on BOTH sides
+    (the reference's Ditto-trainer update, ditto/my_model_trainer.py:63-64,
+    referenced to a fixed incoming global model as FedProx prescribes)."""
+    from neuroimagedisttraining_tpu.core.trainer import (
+        LocalTrainer, epoch_permutations, shuffle_batch_indices,
+    )
+    import flax.linen as nn
+
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(10)(x)
+
+    n, b, max_samples, epochs = 20, 8, 32, 2
+    lr, momentum, wd, clip, mu = 0.05, 0.9, 5e-4, 10.0, 0.7
+    rng = np.random.default_rng(13)
+    X = np.zeros((max_samples, 6), np.float32)
+    X[:n] = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.zeros((max_samples,), np.int32)
+    y[:n] = rng.integers(0, 10, n)
+
+    cfg = OptimConfig(lr=lr, momentum=momentum, wd=wd, grad_clip=clip,
+                      batch_size=b, epochs=epochs, batch_order="shuffle")
+    trainer = LocalTrainer(TinyMLP(), cfg, num_classes=10)
+    cs = trainer.init_client_state(jax.random.key(6), jnp.asarray(X[:1]))
+    # prox reference = a DIFFERENT point than the start (as in a real round,
+    # where the client may start from its personal state)
+    ref = jax.tree.map(
+        lambda p: p + 0.1 * jnp.asarray(
+            np.random.default_rng(21).normal(size=p.shape), jnp.float32),
+        cs.params)
+    new_cs, _ = trainer.local_train(cs, jnp.asarray(X), jnp.asarray(y),
+                                    jnp.int32(n), jnp.float32(lr),
+                                    epochs=epochs, batch_size=b,
+                                    max_samples=max_samples,
+                                    prox_lamda=mu, prox_ref=ref)
+
+    prng = jax.random.split(cs.rng)[1]
+    perms = epoch_permutations(prng, epochs, max_samples, n)
+    steps_per_epoch = -(-max_samples // b)
+
+    names = [("Dense_0", "kernel"), ("Dense_0", "bias"),
+             ("Dense_1", "kernel"), ("Dense_1", "bias")]
+    ps = [torch.nn.Parameter(torch.tensor(np.asarray(cs.params[m][k])))
+          for m, k in names]
+    refs = [torch.tensor(np.asarray(ref[m][k])) for m, k in names]
+
+    def fwd(xb):
+        h = torch.relu(xb @ ps[0] + ps[1])
+        return h @ ps[2] + ps[3]
+
+    opt = torch.optim.SGD(ps, lr=lr, momentum=momentum, weight_decay=wd)
+    X_t, y_t = torch.tensor(X), torch.tensor(y.astype(np.int64))
+    for t in range(epochs * steps_per_epoch):
+        idx, w = shuffle_batch_indices(perms, t, steps_per_epoch, b, n)
+        keep = np.asarray(idx)[np.asarray(w) > 0]
+        if len(keep) == 0:
+            continue
+        opt.zero_grad()
+        loss = torch.nn.CrossEntropyLoss()(fwd(X_t[keep]), y_t[keep])
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(ps, clip)
+        opt.step()
+        with torch.no_grad():  # the proximal pull after each step
+            for p, r in zip(ps, refs):
+                p.data -= lr * mu * (p.data - r)
+
+    for (m, k), p in zip(names, ps):
+        np.testing.assert_allclose(np.asarray(new_cs.params[m][k]),
+                                   p.detach().numpy(), rtol=2e-4, atol=2e-5)
+
+
 def _torch_sepconv(c, k, stride, w):
     """Reference SepConv (operations.py:55-71) rebuilt in torch with the
     given flax weights: dw-conv(k,s) -> 1x1 -> BN -> relu -> dw-conv(k,1)
