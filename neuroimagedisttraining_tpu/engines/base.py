@@ -224,14 +224,13 @@ class FederatedEngine:
 
     # ---------- helpers ----------
 
-    #: cap on per-instance plan-keyed jit caches (matches the old
-    #: lru_cache(4) bound): a topology whose circulant weights vary per
-    #: round must not accumulate one compiled executable per distinct plan
-    #: for the engine's lifetime
+    #: cap on per-instance plan-keyed jit caches: a topology whose
+    #: circulant weights vary per round must not accumulate one compiled
+    #: executable per distinct plan for the engine's lifetime
     _JIT_CACHE_CAP = 4
 
     def _plan_cached(self, cache_name: str, key, build):
-        """Per-instance plan-keyed cache with FIFO eviction past
+        """Per-instance plan-keyed cache with LRU eviction past
         ``_JIT_CACHE_CAP`` (a class-level lru_cache would store ``self``
         and pin discarded engines' device-resident data)."""
         cache = self.__dict__.setdefault(cache_name, {})

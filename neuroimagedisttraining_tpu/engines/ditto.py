@@ -58,8 +58,11 @@ class DittoEngine(FederatedEngine):
 
         cs, losses = jax.vmap(global_local)(cs, Xs, ys, ns)
         w = ns.astype(jnp.float32)
-        new_params = pt.tree_weighted_mean(cs.params, w)
-        new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+        # silo-aware aggregation of the global track (base.aggregate):
+        # silo-first ICI/DCN routing on a two-level mesh, flat mean
+        # otherwise — identical result (tests/test_sharding.py)
+        new_params = self.aggregate(cs.params, w)
+        new_bstats = self.aggregate(cs.batch_stats, w)
 
         # -- personal track (persistent, proximal to incoming global) --
         pp = jax.tree.map(lambda t: jnp.take(t, sampled_idx, axis=0),

@@ -157,8 +157,13 @@ class SalientGradsEngine(FederatedEngine):
 
         cs, losses = jax.vmap(local, in_axes=(0, 0, 0, 0))(cs, Xs, ys, ns)
         w = ns.astype(jnp.float32)
-        new_params = pt.tree_weighted_mean(cs.params, w)
-        new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+        # silo-aware aggregation (base.aggregate): on a two-level
+        # (silos, clients) mesh the masked FedAvg reduces silo-first over
+        # ICI with ONE aggregate per silo across DCN; flat weighted mean
+        # otherwise — identical result either way (tests/test_sharding.py),
+        # cross-silo layout parity with ABCD/data_loader.py:216-315
+        new_params = self.aggregate(cs.params, w)
+        new_bstats = self.aggregate(cs.batch_stats, w)
         # personal models <- this round's local results (scatter rows)
         per_params = jax.tree.map(
             lambda allp, newp: allp.at[sampled_idx].set(newp),

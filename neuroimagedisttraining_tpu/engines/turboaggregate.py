@@ -79,7 +79,11 @@ class TurboAggregateEngine(FedAvgEngine):
         weighted = jax.tree.map(
             lambda x: x.astype(jnp.float32)
             * wn.reshape((-1,) + (1,) * (x.ndim - 1)), client_params)
-        new_bstats = pt.tree_weighted_mean(cs.batch_stats, w)
+        # batch_stats are not secret-shared; route them through the
+        # silo-aware aggregate so the non-MPC half of the round keeps the
+        # two-level ICI/DCN layout (params cross the host MPC boundary
+        # regardless — that boundary IS the cross-silo link)
+        new_bstats = self.aggregate(cs.batch_stats, w)
         mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
         return weighted, new_bstats, mean_loss
 

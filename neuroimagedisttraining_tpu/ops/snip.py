@@ -125,6 +125,14 @@ def mask_from_scores(scores: PyTree, keep_ratio: float) -> tuple[PyTree, jax.Arr
             f"SNIP saliency scores contain {bad} non-finite entries (or "
             "their sum overflows): refusing to build the global mask. "
             "Check the phase-1 loss of each client for divergence.")
+    if not bool(norm != 0):
+        # all-zero saliency (e.g. dead activations or a zero-initialized
+        # head): normalizing would give 0/0 = NaN everywhere — distinct
+        # failure, distinct diagnostic
+        raise FloatingPointError(
+            "SNIP saliency scores are identically zero: no signal to rank "
+            "— the phase-1 gradient probe produced zero gradients for "
+            "every maskable weight (dead activations? zero init?).")
     all_scores = all_scores / norm
     k = max(1, int(total_elems * keep_ratio))
     threshold = kth_largest(all_scores, k)

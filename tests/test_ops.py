@@ -57,6 +57,15 @@ def test_mask_from_scores_raises_on_nonfinite():
         S.mask_from_scores(scores, keep_ratio=0.3)
 
 
+def test_mask_from_scores_raises_on_all_zero():
+    """Degenerate phase-1 probe (zero gradients everywhere) must get its
+    own diagnostic, not the non-finite one."""
+    _, _, cs = _toy_trainer()
+    scores = jax.tree.map(jnp.zeros_like, cs.params)
+    with pytest.raises(FloatingPointError, match="identically zero"):
+        S.mask_from_scores(scores, keep_ratio=0.3)
+
+
 def _toy_trainer():
     model = Tiny3DCNN(num_classes=1)
     trainer = LocalTrainer(model, OptimConfig(batch_size=4), num_classes=1)

@@ -159,6 +159,71 @@ def test_fedavg_round_identical_on_flat_and_two_level_mesh(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+def test_salientgrads_round_identical_on_flat_and_two_level_mesh(tmp_path):
+    """VERDICT r4 #1: the FLAGSHIP's aggregation now routes through the
+    silo-aware path — a masked SalientGrads round on the (2,4) silo mesh
+    must equal the flat 8-device round bitwise (same mask, same aggregate),
+    and must NOT have taken the flat fallback on the two-level mesh."""
+    from neuroimagedisttraining_tpu.data.synthetic import generate_synthetic_abcd
+
+    # 64 subjects so every one of the 8 sites draws train data: the
+    # 8-client sampled set then tiles the 8-device grid, which the
+    # silo-first routing requires (a smaller cohort can leave a site
+    # empty -> 7 sampled clients -> legitimate flat fallback)
+    cohort = generate_synthetic_abcd(num_subjects=64, shape=(12, 14, 12),
+                                     num_sites=8, seed=0)
+    outs = []
+    for shape in ((), (2, 4)):
+        eng = _make_engine(tmp_path, cohort, algorithm="salientgrads",
+                           mesh_shape=shape, client_num_in_total=8)
+        assert eng.real_clients == 8  # every site has train data
+        gs = eng.init_global_state()
+        masks, _ = eng.generate_global_mask(gs.params, gs.batch_stats)
+        per = eng.broadcast_states(gs, eng.num_clients)
+        sampled = eng.client_sampling(0)
+        out = eng._round_jit(gs.params, gs.batch_stats, per.params,
+                             per.batch_stats, eng.data, masks,
+                             jnp.asarray(sampled),
+                             eng.per_client_rngs(0, sampled),
+                             eng.round_lr(0))
+        if shape:  # the silo-first path must actually have been routed
+            assert not getattr(eng, "_warned_flat_fallback", False)
+        outs.append((masks, out[0], float(out[-1])))
+    (m_flat, p_flat, l_flat), (m_two, p_two, l_two) = outs
+    for a, b in zip(jax.tree.leaves(m_flat), jax.tree.leaves(m_two)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(l_flat, l_two, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_flat), jax.tree.leaves(p_two)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_ditto_round_identical_on_flat_and_two_level_mesh(tmp_path):
+    """Ditto's global track likewise routes silo-aware (VERDICT r4 #1)."""
+    from neuroimagedisttraining_tpu.data.synthetic import generate_synthetic_abcd
+
+    cohort = generate_synthetic_abcd(num_subjects=64, shape=(12, 14, 12),
+                                     num_sites=8, seed=0)
+    outs = []
+    for shape in ((), (2, 4)):
+        eng = _make_engine(tmp_path, cohort, algorithm="ditto",
+                           mesh_shape=shape, client_num_in_total=8)
+        gs = eng.init_global_state()
+        per = eng.broadcast_states(gs, eng.num_clients)
+        sampled = eng.client_sampling(0)
+        out = eng._round_jit(gs.params, gs.batch_stats, per.params,
+                             per.batch_stats, eng.data,
+                             jnp.asarray(sampled),
+                             eng.per_client_rngs(0, sampled),
+                             eng.round_lr(0))
+        if shape:
+            assert not getattr(eng, "_warned_flat_fallback", False)
+        outs.append((out[0], float(out[-1])))
+    (p_flat, l_flat), (p_two, l_two) = outs
+    np.testing.assert_allclose(l_flat, l_two, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_flat), jax.tree.leaves(p_two)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 def test_make_mesh_usage_errors():
     from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
 
