@@ -53,7 +53,8 @@ import numpy as np
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.parallel.gossip import (
-    circulant_plan, gossip_apply, plan_fits_mesh,
+    SparseSpec, circulant_plan, gossip_apply, gossip_apply_sparse,
+    plan_fits_mesh, sparse_plan,
 )
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import masks as M
@@ -159,17 +160,24 @@ class DisPFLEngine(FederatedEngine):
     # ---------- the round program ----------
 
     def _consensus(self, per_params, per_bstats, masks_local, masks_shared,
-                   A, plan=None):
+                   A, plan_arrays=None, *, plan=None):
         """Mask-overlap-weighted neighbor aggregation (state-only).
 
         counts[c] = sum_j A[c,j] * masks_shared[j]  (overlap count)
         w_tmp[c]  = (1/counts[c]) * sum_j A[c,j] * w[j], 0 where count=0
 
-        With a circulant ring/k-lattice adjacency tiling the mesh
-        (``plan``), each neighbor sum lowers to ppermute shifts
-        (parallel/gossip.py) instead of the dense all-to-all einsum.
+        With a circulant ring/k-lattice adjacency tiling the mesh (Plan
+        tuple), each neighbor sum lowers to ppermute shifts; with the
+        reference's per-round random k-regular adjacency (SparseSpec +
+        traced ``plan_arrays``) it lowers to a routed capped all_to_all
+        (parallel/gossip.py). Only dense patterns fall back to the
+        all-gather einsum. All three mixed trees (overlap counts, masked
+        sums, batch stats) share one lowering.
         """
-        if plan is not None:
+        if isinstance(plan, SparseSpec):
+            mix = lambda t: gossip_apply_sparse(t, plan, plan_arrays,
+                                                self.mesh)
+        elif plan is not None:
             mix = lambda t: gossip_apply(t, plan, self.mesh)
         else:
             mix = lambda t: jax.tree.map(
@@ -184,13 +192,12 @@ class DisPFLEngine(FederatedEngine):
             sums, counts)
         # personal re-mask (dispfl_api.py:238-239)
         w_local = jax.tree.map(jnp.multiply, w_tmp, masks_local)
-        # batch_stats are not masked; plain neighbor mean
+        # batch_stats are not masked; plain neighbor mean (same sparse
+        # lowering as the other mixes)
         deg = jnp.sum(A, axis=1)
         b_mixed = jax.tree.map(
-            lambda x: jnp.einsum("cj,j...->c...", A,
-                                 x.astype(jnp.float32))
-            / deg.reshape((-1,) + (1,) * (x.ndim - 1)),
-            per_bstats)
+            lambda x: x / deg.reshape((-1,) + (1,) * (x.ndim - 1)),
+            mix(per_bstats))
         return w_local, b_mixed
 
     def _local_and_evolve(self, w_local, b_mixed, masks_local, rngs, X, y,
@@ -240,10 +247,10 @@ class DisPFLEngine(FederatedEngine):
     def _round_jit_for(self, plan):
         def build():
             def round_fn(per_params, per_bstats, masks_local, masks_shared,
-                         data, A, rngs, lr, round_idx):
+                         data, A, rngs, lr, round_idx, plan_arrays):
                 w_local, b_mixed = self._consensus(
                     per_params, per_bstats, masks_local, masks_shared, A,
-                    plan=plan)
+                    plan_arrays, plan=plan)
                 new_p, new_b, new_masks, losses = self._local_and_evolve(
                     w_local, b_mixed, masks_local, rngs,
                     data.X_train, data.y_train, data.n_train, lr, round_idx)
@@ -268,12 +275,18 @@ class DisPFLEngine(FederatedEngine):
         return self._round_jit_for(None)
 
     def gossip_plan(self, A: np.ndarray):
-        """ppermute plan for this round's adjacency (unit weights: the
-        consensus normalizes by mask-overlap counts afterwards), or None
-        for the dense einsum path."""
+        """``(plan, plan_arrays)`` for this round's adjacency (unit
+        weights: the consensus normalizes by mask-overlap counts
+        afterwards): circulant Plan tuple, SparseSpec + routing arrays
+        (the reference's forced ``cs=random`` draw, dispfl_api.py:200),
+        or (None, {}) for the dense einsum."""
         plan = circulant_plan(A)
-        return plan if plan_fits_mesh(plan, self.mesh,
-                                      self.num_clients) else None
+        if plan_fits_mesh(plan, self.mesh, self.num_clients):
+            return plan, {}
+        sp = sparse_plan(A, self.mesh, self.num_clients)
+        if sp is not None:
+            return sp
+        return None, {}
 
     # ---------- streamed round (data per chunk, state resident) ----------
 
@@ -303,12 +316,14 @@ class DisPFLEngine(FederatedEngine):
         return jax.jit(tail)
 
     def _round_streaming(self, per_params, per_bstats, masks_local,
-                         masks_shared, A, rngs, lr, round_idx, plan=None):
+                         masks_shared, A, rngs, lr, round_idx, plan=None,
+                         plan_arrays=None):
         """Chunked streamed round: consensus on resident state, then each
         client chunk's data is host-fetched, trained, and evolved; chunk
         outputs concatenate back into the stacked [C, ...] state."""
         w_local, b_mixed = self._consensus_jit_for(plan)(
-            per_params, per_bstats, masks_local, masks_shared, A)
+            per_params, per_bstats, masks_local, masks_shared, A,
+            plan_arrays or {})
         (new_p, new_b, new_masks), losses = self.stream_map_train_chunks(
             self._local_chunk_jit, (w_local, b_mixed, masks_local), rngs,
             lr, round_idx)
@@ -384,7 +399,7 @@ class DisPFLEngine(FederatedEngine):
         for round_idx in range(start, cfg.fed.comm_round):
             active = self.active_draw(round_idx)
             A_np = self.adjacency(round_idx, active)
-            plan = self.gossip_plan(A_np)
+            plan, plan_arrays = self.gossip_plan(A_np)
             A = jnp.asarray(A_np)
             rngs = self.per_client_rngs(round_idx,
                                         np.arange(self.num_clients))
@@ -396,13 +411,14 @@ class DisPFLEngine(FederatedEngine):
                  dist_self, loss) = self._round_streaming(
                     per_params, per_bstats, masks_local, masks_shared,
                     A, rngs, self.round_lr(round_idx),
-                    jnp.float32(round_idx), plan=plan)
+                    jnp.float32(round_idx), plan=plan,
+                    plan_arrays=plan_arrays)
             else:
                 (per_params, per_bstats, masks_local, masks_shared,
                  dist_self, loss) = self._round_jit_for(plan)(
                     per_params, per_bstats, masks_local, masks_shared,
                     self.data, A, rngs, self.round_lr(round_idx),
-                    jnp.float32(round_idx))
+                    jnp.float32(round_idx), plan_arrays)
             real = self.real_clients
             # comm = actual gossip edges: client c receives each neighbor
             # j != c's sparse model (nnz of j's mask + dense leaves)

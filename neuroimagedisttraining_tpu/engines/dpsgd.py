@@ -16,10 +16,14 @@ TPU-native: neighbor choices become one row-stochastic mixing matrix
 ``M[C,C]`` per round. For ``cs="ring"`` at full activity the matrix is
 CIRCULANT and the consensus lowers to ``lax.ppermute`` shifts of 1-row
 slices between neighboring devices (parallel/gossip.py) — per-device
-traffic O(model), independent of C. Otherwise (random draws, padded
-rows) it is a single ``einsum('cj,j...->c...')`` over the client-sharded
-axis (an all-to-all over ICI). Either way, consensus + vmapped local
-training is one jitted program per round.
+traffic O(model), independent of C. For ``cs="random"`` (a fresh
+k-regular draw every round) the consensus lowers to a routed, capped
+``lax.all_to_all`` whose routing tables are traced operands
+(parallel/gossip.py::sparse_plan) — per-device traffic O(D * m * model),
+m ~ B(k+1)/D rows, one compiled program per size bucket. Only when
+neither structure applies (dense patterns) does it fall back to the
+``einsum('cj,j...->c...')`` all-gather. Either way, consensus + vmapped
+local training is one jitted program per round.
 """
 
 from __future__ import annotations
@@ -33,7 +37,8 @@ import numpy as np
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.parallel.gossip import (
-    circulant_plan, gossip_apply, plan_fits_mesh,
+    SparseSpec, circulant_plan, gossip_apply, gossip_apply_sparse,
+    plan_fits_mesh, sparse_plan,
 )
 
 
@@ -83,25 +88,38 @@ class DPSGDEngine(FederatedEngine):
     # shards.
     supports_streaming = True
 
-    def _consensus(self, per_params, per_bstats, M, plan=None):
+    def _consensus(self, per_params, per_bstats, M, plan_arrays=None, *,
+                   plan=None):
         """Gossip consensus over last round's models: ppermute ring shifts
-        when the round's matrix is circulant and tiles the mesh (``plan``),
-        else one all-to-all matmul against the mixing matrix."""
-        if plan is not None:
-            return (gossip_apply(per_params, plan, self.mesh),
-                    gossip_apply(per_bstats, plan, self.mesh))
-        mix = lambda t: jax.tree.map(
-            lambda x: jnp.einsum("cj,j...->c...", M, x), t)
+        when the round's matrix is circulant and tiles the mesh (Plan
+        tuple), a routed all_to_all for per-round sparse random topologies
+        (SparseSpec + traced ``plan_arrays``), else one all-gather matmul
+        against the mixing matrix."""
+        if isinstance(plan, SparseSpec):
+            mix = lambda t: gossip_apply_sparse(t, plan, plan_arrays,
+                                                self.mesh)
+        elif plan is not None:
+            mix = lambda t: gossip_apply(t, plan, self.mesh)
+        else:
+            mix = lambda t: jax.tree.map(
+                lambda x: jnp.einsum("cj,j...->c...", M, x), t)
         return mix(per_params), mix(per_bstats)
 
     def gossip_plan(self, M_np: np.ndarray):
-        """Static ppermute plan for this round's matrix, or None for the
-        dense einsum path. Hashable -> keys the per-plan jit cache (ring
-        topologies reuse one trace; the detection cost is C^2 host
-        compares per round)."""
+        """``(plan, plan_arrays)`` for this round's matrix: a hashable
+        circulant Plan tuple (ppermute shifts, round-invariant ring
+        topologies), a SparseSpec + routing arrays (routed all_to_all,
+        per-round random topologies — the spec keys the jit cache, the
+        arrays are traced operands), or (None, {}) for the dense einsum.
+        Detection cost: O(C^2) host compares / O(C*k) bucketing per
+        round."""
         plan = circulant_plan(M_np)
-        return plan if plan_fits_mesh(plan, self.mesh,
-                                      self.num_clients) else None
+        if plan_fits_mesh(plan, self.mesh, self.num_clients):
+            return plan, {}
+        sp = sparse_plan(M_np, self.mesh, self.num_clients)
+        if sp is not None:
+            return sp
+        return None, {}
 
     def _local_block(self, mixed_p, mixed_b, rngs, X, y, n, lr):
         trainer = self.trainer
@@ -130,9 +148,11 @@ class DPSGDEngine(FederatedEngine):
 
     def _round_jit_for(self, plan):
         def build():
-            def round_fn(per_params, per_bstats, data, M, rngs, lr):
+            def round_fn(per_params, per_bstats, data, M, rngs, lr,
+                         plan_arrays):
                 mixed_p, mixed_b = self._consensus(per_params, per_bstats,
-                                                   M, plan=plan)
+                                                   M, plan_arrays,
+                                                   plan=plan)
                 new_p, new_b, losses = self._local_block(
                     mixed_p, mixed_b, rngs, data.X_train, data.y_train,
                     data.n_train, lr)
@@ -173,9 +193,9 @@ class DPSGDEngine(FederatedEngine):
         return jax.jit(tail)
 
     def _round_streaming(self, per_params, per_bstats, M, rngs, lr,
-                         plan=None):
+                         plan=None, plan_arrays=None):
         mixed_p, mixed_b = self._consensus_jit_for(plan)(
-            per_params, per_bstats, M)
+            per_params, per_bstats, M, plan_arrays or {})
         (new_p, new_b), losses = self.stream_map_train_chunks(
             self._block_jit, (mixed_p, mixed_b), rngs, lr)
         w_global_p, w_global_b, mean_loss = self._tail_jit(
@@ -226,7 +246,7 @@ class DPSGDEngine(FederatedEngine):
             history = restored["history"]
         for round_idx in range(start, cfg.fed.comm_round):
             M_np = self.mixing_matrix(round_idx)
-            plan = self.gossip_plan(M_np)
+            plan, plan_arrays = self.gossip_plan(M_np)
             M = jnp.asarray(M_np)
             rngs = self.per_client_rngs(round_idx,
                                         np.arange(self.num_clients))
@@ -234,12 +254,13 @@ class DPSGDEngine(FederatedEngine):
                 per_params, per_bstats, g_params, g_bstats, loss = \
                     self._round_streaming(per_params, per_bstats, M, rngs,
                                           self.round_lr(round_idx),
-                                          plan=plan)
+                                          plan=plan,
+                                          plan_arrays=plan_arrays)
             else:
                 per_params, per_bstats, g_params, g_bstats, loss = \
                     self._round_jit_for(plan)(
                         per_params, per_bstats, self.data, M, rngs,
-                        self.round_lr(round_idx))
+                        self.round_lr(round_idx), plan_arrays)
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 mg = self._eval_g(g_params, g_bstats)

@@ -1,11 +1,19 @@
-"""Gossip-consensus bench: ppermute ring vs dense all-to-all einsum.
+"""Gossip-consensus bench: sparse lowerings vs the dense all-gather einsum.
 
-The claim (parallel/gossip.py): for circulant ring/k-lattice mixing
-matrices, consensus lowers to collective-permutes of |k|-row slices, so
-per-device traffic is O(k_max x model) instead of the einsum's O(C x
-model) stack materialization. This bench pins that on the 8-device mesh:
-wall time for both paths, the HLO collective ops each lowers to, and the
-analytic per-device receive volume.
+Two cells (GOSSIP_MODE env):
+- "ring" (default): circulant ring/k-lattice mixing lowers to
+  collective-permutes of |k|-row slices — per-device traffic
+  O(k_max x model) instead of the einsum's O(C x model) stack.
+- "random": the reference's per-round k-regular random adjacency
+  (DisPFL's forced default, dispfl_api.py:200) lowers to a routed,
+  capped lax.all_to_all with traced routing tables
+  (parallel/gossip.py::sparse_plan) — per-device traffic
+  O(D x m x model), m < B rows per pair, one compiled program per size
+  bucket across rounds of changing topologies.
+
+Each cell pins wall time for both paths, the HLO collective ops each
+lowers to, and the analytic per-device receive volume on the 8-device
+mesh.
 
 Multi-device collectives need >= 2 devices and the harness exposes ONE
 real TPU chip, so this cell self-provisions the 8-virtual-CPU-device mesh
@@ -13,8 +21,9 @@ real TPU chip, so this cell self-provisions the 8-virtual-CPU-device mesh
 traffic claims are device-count facts, not chip-speed facts; wall times
 here are CPU-mesh times and marked as such.
 
-Env: GOSSIP_CLIENTS (16), GOSSIP_PARAMS (4_000_000 floats), BENCH_REPS (5).
-Prints one JSON line.
+Env: GOSSIP_MODE (ring), GOSSIP_CLIENTS (16; 40 for random),
+GOSSIP_NEIGHBORS (2, random mode), GOSSIP_PARAMS (4_000_000 floats),
+BENCH_REPS (5). Prints one JSON line.
 """
 
 from __future__ import annotations
@@ -39,7 +48,8 @@ def main() -> None:
     import numpy as np
 
     from neuroimagedisttraining_tpu.parallel.gossip import (
-        circulant_plan, gossip_apply, plan_fits_mesh,
+        circulant_plan, gossip_apply, gossip_apply_sparse, plan_fits_mesh,
+        sparse_plan,
     )
     from neuroimagedisttraining_tpu.parallel.mesh import (
         client_sharding, make_mesh,
@@ -48,7 +58,8 @@ def main() -> None:
         ring_mixing_matrix,
     )
 
-    C = int(os.environ.get("GOSSIP_CLIENTS", 16))
+    mode = os.environ.get("GOSSIP_MODE", "ring")
+    C = int(os.environ.get("GOSSIP_CLIENTS", 40 if mode == "random" else 16))
     # rounded down to the 128-lane layout so the timed array, the label,
     # and the traffic figures all describe the same element count
     n_params = int(os.environ.get("GOSSIP_PARAMS", 4_000_000)) // 128 * 128
@@ -56,9 +67,26 @@ def main() -> None:
     mesh = make_mesh()
     D = mesh.devices.size
 
-    M = ring_mixing_matrix(C)
-    plan = circulant_plan(M)
-    assert plan_fits_mesh(plan, mesh, C), (C, D)
+    if mode == "random":
+        k = int(os.environ.get("GOSSIP_NEIGHBORS", 2))
+        rng = np.random.default_rng(1)
+        M = np.zeros((C, C), np.float32)
+        for c in range(C):
+            nei = rng.choice([j for j in range(C) if j != c], k,
+                             replace=False)
+            sel = np.append(nei, c)
+            M[c, sel] = 1.0 / len(sel)
+        out = sparse_plan(M, mesh, C)
+        assert out is not None, (
+            f"no sparse plan for C={C}, k={k} on the {D}-device mesh "
+            "(C must tile the mesh and the padded per-pair cap must stay "
+            "below a full block) — pick a sparser GOSSIP_NEIGHBORS / "
+            "larger GOSSIP_CLIENTS")
+        spec, arrays = out
+    else:
+        M = ring_mixing_matrix(C)
+        plan = circulant_plan(M)
+        assert plan_fits_mesh(plan, mesh, C), (C, D)
 
     x = jax.device_put(
         np.random.default_rng(0).normal(size=(C, n_params // 128, 128))
@@ -66,7 +94,11 @@ def main() -> None:
     tree = {"w": x}
     Md = jnp.asarray(M)
 
-    pp = jax.jit(lambda t: gossip_apply(t, plan, mesh))
+    if mode == "random":
+        arrays_d = jax.device_put(arrays)
+        pp = jax.jit(lambda t: gossip_apply_sparse(t, spec, arrays_d, mesh))
+    else:
+        pp = jax.jit(lambda t: gossip_apply(t, plan, mesh))
     ein = jax.jit(lambda t: jax.tree.map(
         lambda v: jnp.einsum("cj,j...->c...", Md, v), t))
 
@@ -91,22 +123,33 @@ def main() -> None:
 
     bytes_per_row = 4 * n_params
     # analytic per-device RECEIVE volume per consensus
-    offs = [abs(k) for k, _ in plan if k != 0]
-    pp_rx = sum(offs) * bytes_per_row
+    if mode == "random":
+        # all_to_all: D-1 remote slots of m padded rows each
+        pp_rx = (D - 1) * spec.m * bytes_per_row
+    else:
+        offs = [abs(k) for k, _ in plan if k != 0]
+        pp_rx = sum(offs) * bytes_per_row
     ein_rx = (C - C // D) * bytes_per_row  # the all-gathered remote stack
 
+    if mode == "random":
+        label = (f"routed all_to_all path (m={spec.m}/B={spec.B} padded "
+                 f"rows per pair, {int(os.environ.get('GOSSIP_NEIGHBORS', 2))} "
+                 "random neighbors/client)")
+    else:
+        label = "ppermute path"
     print(json.dumps({
-        "metric": "gossip_consensus_ring",
+        "metric": f"gossip_consensus_{mode}",
         "value": round(t_pp * 1e3, 2),
-        "unit": f"ms/consensus (ppermute path, C={C} clients x "
+        "unit": f"ms/consensus ({label}, C={C} clients x "
                 f"{n_params / 1e6:.1f}M params, {D}-device VIRTUAL CPU "
                 "mesh — lowering/traffic cell, not a chip-speed cell)",
         "einsum_ms": round(t_ein * 1e3, 2),
         "speedup_vs_einsum": round(t_ein / t_pp, 2),
-        "ppermute_rx_mb_per_device": round(pp_rx / 1e6, 2),
+        ("sparse_rx_mb_per_device" if mode == "random"
+         else "ppermute_rx_mb_per_device"): round(pp_rx / 1e6, 2),
         "einsum_rx_mb_per_device": round(ein_rx / 1e6, 2),
         "traffic_ratio": round(ein_rx / pp_rx, 1),
-        "ppermute_hlo": {
+        "sparse_hlo" if mode == "random" else "ppermute_hlo": {
             "collective-permute": hlo_pp.count("collective-permute"),
             "all-gather": hlo_pp.count("all-gather"),
             "all-to-all": hlo_pp.count("all-to-all")},

@@ -58,6 +58,14 @@ python scripts/bench_gossip.py > "$OUT/bench_gossip.json" \
         > "$OUT/bench_gossip.json"
 echo "    -> $(cut -c1-160 "$OUT/bench_gossip.json")" >&2
 
+# random-topology gossip: routed capped all_to_all vs dense einsum (the
+# reference's per-round k-regular draw — DisPFL default, dpsgd cs=random)
+env GOSSIP_MODE=random python scripts/bench_gossip.py \
+    > "$OUT/bench_gossip_random.json" \
+    || echo '{"metric": "gossip_random", "error": "failed"}' \
+        > "$OUT/bench_gossip_random.json"
+echo "    -> $(cut -c1-160 "$OUT/bench_gossip_random.json")" >&2
+
 python - "$OUT" <<'EOF'
 import json, sys, glob, os
 out = sys.argv[1]

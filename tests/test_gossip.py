@@ -165,19 +165,209 @@ def test_dpsgd_ring_round_ppermute_matches_einsum(tmp_path,
     engine = create_engine("dpsgd", cfg, fed, trainer, mesh=mesh,
                            logger=log)
     M_np = engine.mixing_matrix(0)
-    plan = engine.gossip_plan(M_np)
+    plan, plan_arrays = engine.gossip_plan(M_np)
     assert plan is not None, "ring @ 8 real clients on 8 devices must plan"
+    assert plan_arrays == {}  # circulant: no routing operands
 
     gs = engine.init_global_state()
     per = engine.broadcast_states(gs, engine.num_clients)
     rngs = engine.per_client_rngs(0, np.arange(engine.num_clients))
     args = (per.params, per.batch_stats, engine.data,
             jnp.asarray(M_np), rngs, jnp.float32(0.01))
-    out_pp = engine._round_jit_for(plan)(*args)
-    out_ein = engine._round_jit_for(None)(*args)
+    out_pp = engine._round_jit_for(plan)(*args, {})
+    out_ein = engine._round_jit_for(None)(*args, {})
     for a, b in zip(jax.tree.leaves(out_pp), jax.tree.leaves(out_ein)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=1e-6)
     # and the ppermute trace really lowers to collective-permute
-    txt = engine._round_jit_for(plan).lower(*args).compile().as_text()
+    txt = engine._round_jit_for(plan).lower(*args, {}).compile().as_text()
     assert "collective-permute" in txt
+
+
+# ---------- general sparse (per-round random) topologies ----------
+
+
+def _k_regular(C, k, seed, binary=False):
+    """Row c = {k random neighbors} ∪ {c}; uniform weights unless binary."""
+    rng = np.random.default_rng(seed)
+    M = np.zeros((C, C), np.float32)
+    for c in range(C):
+        nei = rng.choice([j for j in range(C) if j != c], k, replace=False)
+        sel = np.append(nei, c)
+        M[c, sel] = 1.0 if binary else 1.0 / len(sel)
+    return M
+
+
+def test_sparse_plan_routing_exact_vs_einsum():
+    """Routing exactness: on integer-valued inputs (exact f32 arithmetic,
+    any summation order) the routed all_to_all consensus must equal the
+    dense einsum BITWISE — same rows gathered, same weights, no
+    duplicates/omissions. Float inputs agree to reduction-order
+    tolerance."""
+    from neuroimagedisttraining_tpu.parallel.gossip import (
+        SparseSpec, gossip_apply_sparse, sparse_plan,
+    )
+
+    mesh = make_mesh()
+    C, k = 40, 2
+    M = _k_regular(C, k, seed=1)
+    out = sparse_plan(M, mesh, C)
+    assert out is not None
+    spec, arrays = out
+    assert isinstance(spec, SparseSpec)
+    assert spec.m < spec.B  # strictly below the all-gather volume
+    # integer-valued weights too, so every product/sum is exact: use the
+    # binary adjacency with integer payloads
+    A = _k_regular(C, k, seed=1, binary=True)
+    spec_b, arrays_b = sparse_plan(A, mesh, C)
+    rng = np.random.default_rng(3)
+    xi = {"w": jnp.asarray(rng.integers(-64, 64, size=(C, 5, 3)),
+                           jnp.float32)}
+    got = gossip_apply_sparse(xi, spec_b, arrays_b, mesh)
+    want = jnp.einsum("cj,j...->c...", jnp.asarray(A), xi["w"])
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(want))
+    # float payloads + uniform weights: equal up to reduction order
+    xf = {"w": jnp.asarray(rng.normal(size=(C, 5, 3)), jnp.float32)}
+    gotf = gossip_apply_sparse(xf, spec, arrays, mesh)
+    wantf = jnp.einsum("cj,j...->c...", jnp.asarray(M), xf["w"])
+    np.testing.assert_allclose(np.asarray(gotf["w"]), np.asarray(wantf),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_sparse_lowering_all_to_all_not_allgather():
+    """The compiled sparse consensus must move rows via all-to-all and NOT
+    materialize the client stack via all-gather."""
+    from neuroimagedisttraining_tpu.parallel.gossip import (
+        gossip_apply_sparse, sparse_plan,
+    )
+
+    mesh = make_mesh()
+    C = 40
+    spec, arrays = sparse_plan(_k_regular(C, 2, seed=1), mesh, C)
+    tree = {"w": jnp.zeros((C, 64, 32), jnp.float32)}
+    txt = (jax.jit(lambda t, a: gossip_apply_sparse(t, spec, a, mesh))
+           .lower(tree, arrays).compile().as_text())
+    assert "all-to-all" in txt
+    assert "all-gather" not in txt
+
+
+def test_sparse_plan_rejects_dense_and_single_row_blocks():
+    from neuroimagedisttraining_tpu.parallel.gossip import sparse_plan
+
+    mesh = make_mesh()
+    # full participation: every pair would exchange whole blocks
+    assert sparse_plan(np.ones((16, 16), np.float32), mesh, 16) is None
+    # one client per device: every row is a full block, no sparse win
+    assert sparse_plan(_k_regular(8, 3, seed=0), mesh, 8) is None
+
+
+def test_dpsgd_random_round_sparse_matches_einsum(tmp_path):
+    """Engine-level: a D-PSGD cs=random round (fresh k-regular draw) takes
+    the routed-all_to_all plan and produces the same state as the
+    dense-einsum trace."""
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import federate_cohort
+    from neuroimagedisttraining_tpu.data.synthetic import (
+        generate_synthetic_abcd,
+    )
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.parallel.gossip import SparseSpec
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    mesh = make_mesh()
+    C = 32
+    cohort = generate_synthetic_abcd(num_subjects=4 * C, shape=(12, 14, 12),
+                                     num_sites=C, seed=0)
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm="dpsgd",
+        data=DataConfig(dataset="synthetic", partition_method="site"),
+        optim=OptimConfig(lr=1e-2, batch_size=4, epochs=1),
+        # frac 1/16 -> 2 random neighbors per client: sparse rows
+        fed=FedConfig(client_num_in_total=C, comm_round=1, cs="random",
+                      frac=1 / 16, frequency_of_the_test=1),
+        log_dir=str(tmp_path))
+    fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh)
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1),
+                           cfg.optim, num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    engine = create_engine("dpsgd", cfg, fed, trainer, mesh=mesh,
+                           logger=log)
+    M_np = engine.mixing_matrix(0)
+    plan, plan_arrays = engine.gossip_plan(M_np)
+    assert isinstance(plan, SparseSpec), "cs=random must take the sparse plan"
+
+    gs = engine.init_global_state()
+    per = engine.broadcast_states(gs, engine.num_clients)
+    rngs = engine.per_client_rngs(0, np.arange(engine.num_clients))
+    args = (per.params, per.batch_stats, engine.data,
+            jnp.asarray(M_np), rngs, jnp.float32(0.01))
+    out_sp = engine._round_jit_for(plan)(*args, plan_arrays)
+    out_ein = engine._round_jit_for(None)(*args, {})
+    for a, b in zip(jax.tree.leaves(out_sp), jax.tree.leaves(out_ein)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+    # the consensus program routes via all-to-all, no client-stack
+    # all-gather
+    chlo = engine._consensus_jit_for(plan).lower(
+        per.params, per.batch_stats, jnp.asarray(M_np),
+        plan_arrays).compile().as_text()
+    assert "all-to-all" in chlo
+    assert "all-gather" not in chlo
+
+
+def test_dispfl_random_consensus_sparse_matches_einsum(tmp_path):
+    """Engine-level: DisPFL's forced-default random adjacency
+    (dispfl_api.py:200) takes the sparse plan; the mask-overlap consensus
+    (all three mixed trees) matches the einsum trace."""
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import federate_cohort
+    from neuroimagedisttraining_tpu.data.synthetic import (
+        generate_synthetic_abcd,
+    )
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.parallel.gossip import SparseSpec
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    mesh = make_mesh()
+    C = 32
+    cohort = generate_synthetic_abcd(num_subjects=4 * C, shape=(12, 14, 12),
+                                     num_sites=C, seed=0)
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm="dispfl",
+        data=DataConfig(dataset="synthetic", partition_method="site"),
+        optim=OptimConfig(lr=1e-2, batch_size=4, epochs=1),
+        fed=FedConfig(client_num_in_total=C, comm_round=1, cs="random",
+                      frac=1 / 16, frequency_of_the_test=1),
+        log_dir=str(tmp_path))
+    fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh)
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1),
+                           cfg.optim, num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    engine = create_engine("dispfl", cfg, fed, trainer, mesh=mesh,
+                           logger=log)
+    A_np = engine.adjacency(0, engine.active_draw(0))
+    plan, plan_arrays = engine.gossip_plan(A_np)
+    assert isinstance(plan, SparseSpec), "random adjacency must plan sparse"
+
+    gs = engine.init_global_state()
+    masks_local, _ = engine.init_masks_all(gs.params)
+    per = engine.broadcast_states(gs, engine.num_clients)
+    per_params = jax.tree.map(jnp.multiply, per.params, masks_local)
+    args = (per_params, per.batch_stats, masks_local, masks_local,
+            jnp.asarray(A_np))
+    w_sp, b_sp = engine._consensus_jit_for(plan)(*args, plan_arrays)
+    w_ein, b_ein = engine._consensus_jit_for(None)(*args, {})
+    for a, b in zip(jax.tree.leaves((w_sp, b_sp)),
+                    jax.tree.leaves((w_ein, b_ein))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
