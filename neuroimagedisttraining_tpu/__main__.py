@@ -259,14 +259,10 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
         # run must see the SAME train/test/val rows as a resident one
         train_map, test_map, _ = P.site_partition(cohort["site"],
                                                   seed=DATA_SPLIT_SEED)
-        if mesh is not None and \
-                cfg.fed.client_num_per_round % mesh.devices.size != 0:
-            raise ValueError(
-                f"--streaming over a {mesh.devices.size}-device mesh needs "
-                f"client_num_per_round ({cfg.fed.client_num_per_round}) to "
-                "be a multiple of the device count (choose --frac "
-                "accordingly) so every round's sharded feed tiles the "
-                "client axis")
+        # NOTE: a sampled-set size that does not tile the mesh (e.g. the
+        # north-star 100 clients at frac 0.1 on 8 devices) is handled by
+        # the engines' stream_sampling padding (zero-weight pad clients),
+        # so no tiling restriction applies to --frac
         if mesh is not None and cfg.stream_chunk_clients > 0 and \
                 cfg.stream_chunk_clients % mesh.devices.size != 0:
             raise ValueError(

@@ -129,13 +129,16 @@ class StreamingFederation:
             return self.val_map, self.nmax_val
         raise ValueError(f"unknown split {split!r}")
 
-    def _fetch(self, client_ids: np.ndarray, split: str):
+    def _fetch(self, client_ids: np.ndarray, split: str,
+               n_real: int | None = None):
         idx_map, nmax = self._split_maps(split)
         S = len(client_ids)
         Xs = np.zeros((S, nmax) + self.sample_shape, self.dtype)
         ys = np.zeros((S, nmax), np.int32)
         ns = np.zeros((S,), np.int32)
         for j, c in enumerate(client_ids):
+            if n_real is not None and j >= n_real:
+                break  # mesh-tiling pads: zero buffers, never gathered
             idx = idx_map[int(c)]
             if len(idx):
                 if isinstance(self.X, np.ndarray):
@@ -155,9 +158,7 @@ class StreamingFederation:
         of landing synchronously at the round boundary (VERDICT r3 weak #2).
         Blocks on the transfer so the timing is the true H2D cost."""
         t0 = time.perf_counter()
-        Xs, ys, ns = self._fetch(client_ids, split)
-        if n_real is not None:
-            ns[n_real:] = 0  # pad clients contribute nothing
+        Xs, ys, ns = self._fetch(client_ids, split, n_real)
         t1 = time.perf_counter()
         out = (self._put(Xs), self._put(ys), self._put(ns))
         jax.block_until_ready(out[0])
@@ -171,25 +172,29 @@ class StreamingFederation:
 
     # ---------- double-buffered round feed ----------
 
-    def prefetch_train(self, client_ids: np.ndarray) -> None:
+    def prefetch_train(self, client_ids: np.ndarray,
+                       n_real: int | None = None) -> None:
         """Kick off the next round's read + device transfer on the
-        background thread."""
-        key = ("train", tuple(int(c) for c in client_ids))
+        background thread. ``n_real``: entries past this index are
+        mesh-tiling pads — their fetched sample counts are zeroed so they
+        train as no-ops and weigh 0 in aggregation (the north-star
+        frac-sampled sets need not tile the device grid)."""
+        key = ("train", tuple(int(c) for c in client_ids), n_real)
         if self._pending is not None and self._pending[0] == key:
             return
         self._pending = (key, self._pool.submit(self._fetch_put,
                                                 np.asarray(client_ids),
-                                                "train"))
+                                                "train", n_real))
 
-    def get_train(self, client_ids: np.ndarray):
+    def get_train(self, client_ids: np.ndarray, n_real: int | None = None):
         """Device-resident padded arrays for the sampled clients; uses the
         prefetched (already transferred) buffer when it matches."""
-        key = ("train", tuple(int(c) for c in client_ids))
+        key = ("train", tuple(int(c) for c in client_ids), n_real)
         if self._pending is not None and self._pending[0] == key:
             out = self._pending[1].result()
             self._pending = None
             return out
-        return self._fetch_put(np.asarray(client_ids), "train")
+        return self._fetch_put(np.asarray(client_ids), "train", n_real)
 
     # ---------- resident val shards (FedFomo) ----------
 

@@ -115,6 +115,45 @@ class FederatedEngine:
         return np.sort(np.random.choice(range(total), per_round,
                                         replace=False))
 
+    def stream_sampling(self, round_idx: int,
+                        sampled: np.ndarray | None = None
+                        ) -> tuple[np.ndarray, int]:
+        """``(padded_ids, n_real)`` for the streamed sharded feed: the
+        round's sampled set padded to tile the mesh (the north-star config
+        — 100 clients, frac 0.1 — samples 10 clients onto an 8-device
+        grid). Pad entries prefer mesh-padding clients (rows
+        [real_clients, num_clients), n_train == 0) and then repeat the
+        last sampled id; either way the feed zeroes their fetched sample
+        counts (``n_real``), so pads train as masked no-ops and weigh 0 in
+        aggregation. Engines that scatter per-client state by sampled id
+        must route through ``scatter_sampled_rows`` (pad entries dropped).
+        Pass ``sampled`` when the round's set was already computed."""
+        if sampled is None:
+            sampled = self.client_sampling(round_idx)
+        if self.mesh is None:
+            return sampled, len(sampled)
+        D = self.mesh.devices.size
+        pad = (-len(sampled)) % D
+        if pad == 0:
+            return sampled, len(sampled)
+        pool = np.arange(self.real_clients, self.num_clients)
+        fill = np.concatenate([pool, np.full(max(0, pad - len(pool)),
+                                             sampled[-1])])[:pad]
+        return np.concatenate([sampled, fill]).astype(sampled.dtype), \
+            len(sampled)
+
+    def scatter_sampled_rows(self, all_tree, new_tree, sampled_idx, real):
+        """Write the sampled clients' new rows into the [C, ...] stacked
+        state. Pad entries (``real`` False — stream_sampling's mesh-tiling
+        pads, possibly DUPLICATE ids of a real client) are redirected to
+        an out-of-range index and dropped (``mode="drop"``), so no pad
+        write can land on — let alone clobber, via scatter's last-wins
+        duplicate resolution — a real client's freshly trained row."""
+        idx = jnp.where(real, sampled_idx, self.num_clients)
+        return jax.tree.map(
+            lambda allp, newp: allp.at[idx].set(newp, mode="drop"),
+            all_tree, new_tree)
+
     # ---------- evaluation ----------
 
     @functools.cached_property

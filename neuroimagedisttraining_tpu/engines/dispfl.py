@@ -53,8 +53,7 @@ import numpy as np
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.parallel.gossip import (
-    SparseSpec, circulant_plan, gossip_apply, gossip_apply_sparse,
-    plan_fits_mesh, sparse_plan,
+    SparseSpec, gossip_apply, gossip_apply_sparse, make_plan,
 )
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import masks as M
@@ -280,13 +279,7 @@ class DisPFLEngine(FederatedEngine):
         afterwards): circulant Plan tuple, SparseSpec + routing arrays
         (the reference's forced ``cs=random`` draw, dispfl_api.py:200),
         or (None, {}) for the dense einsum."""
-        plan = circulant_plan(A)
-        if plan_fits_mesh(plan, self.mesh, self.num_clients):
-            return plan, {}
-        sp = sparse_plan(A, self.mesh, self.num_clients)
-        if sp is not None:
-            return sp
-        return None, {}
+        return make_plan(A, self.mesh, self.num_clients)
 
     # ---------- streamed round (data per chunk, state resident) ----------
 

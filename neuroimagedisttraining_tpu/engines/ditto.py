@@ -81,12 +81,13 @@ class DittoEngine(FederatedEngine):
             return cs_p.params, cs_p.batch_stats
 
         new_pp, new_pb = jax.vmap(personal_local)(pp, pb, rngs2, Xs, ys, ns)
-        per_params = jax.tree.map(
-            lambda allp, newp: allp.at[sampled_idx].set(newp),
-            per_params, new_pp)
-        per_bstats = jax.tree.map(
-            lambda allp, newp: allp.at[sampled_idx].set(newp),
-            per_bstats, new_pb)
+        # pad entries from stream_sampling are dropped, never written
+        # (base.scatter_sampled_rows)
+        real = ns > 0
+        per_params = self.scatter_sampled_rows(per_params, new_pp,
+                                               sampled_idx, real)
+        per_bstats = self.scatter_sampled_rows(per_bstats, new_pb,
+                                               sampled_idx, real)
         mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
         return new_params, new_bstats, per_params, per_bstats, mean_loss
 
@@ -122,20 +123,22 @@ class DittoEngine(FederatedEngine):
                                       restored["per_bstats"])
             history = restored["history"]
         if self.stream is not None:
-            self.stream.prefetch_train(self.client_sampling(start))
+            self.stream.prefetch_train(*self.stream_sampling(start))
         for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
-            rngs = self.per_client_rngs(round_idx, sampled)
             if self.stream is not None:
-                Xs, ys, ns = self.stream.get_train(sampled)
+                fed_ids, n_real = self.stream_sampling(round_idx, sampled)
+                rngs = self.per_client_rngs(round_idx, fed_ids)
+                Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
                 if round_idx + 1 < cfg.fed.comm_round:
                     self.stream.prefetch_train(
-                        self.client_sampling(round_idx + 1))
+                        *self.stream_sampling(round_idx + 1))
                 (params, bstats, per_params, per_bstats,
                  loss) = self._round_stream_jit(
                     params, bstats, per_params, per_bstats, Xs, ys, ns,
-                    jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+                    jnp.asarray(fed_ids), rngs, self.round_lr(round_idx))
             else:
+                rngs = self.per_client_rngs(round_idx, sampled)
                 (params, bstats, per_params, per_bstats,
                  loss) = self._round_jit(
                     params, bstats, per_params, per_bstats, self.data,

@@ -37,8 +37,7 @@ import numpy as np
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.parallel.gossip import (
-    SparseSpec, circulant_plan, gossip_apply, gossip_apply_sparse,
-    plan_fits_mesh, sparse_plan,
+    SparseSpec, gossip_apply, gossip_apply_sparse, make_plan,
 )
 
 
@@ -113,13 +112,7 @@ class DPSGDEngine(FederatedEngine):
         arrays are traced operands), or (None, {}) for the dense einsum.
         Detection cost: O(C^2) host compares / O(C*k) bucketing per
         round."""
-        plan = circulant_plan(M_np)
-        if plan_fits_mesh(plan, self.mesh, self.num_clients):
-            return plan, {}
-        sp = sparse_plan(M_np, self.mesh, self.num_clients)
-        if sp is not None:
-            return sp
-        return None, {}
+        return make_plan(M_np, self.mesh, self.num_clients)
 
     def _local_block(self, mixed_p, mixed_b, rngs, X, y, n, lr):
         trainer = self.trainer

@@ -184,17 +184,17 @@ class FedAvgEngine(FederatedEngine):
             gs = self.init_global_state()
             params, bstats = gs.params, gs.batch_stats
             history = []
-        self.stream.prefetch_train(self.client_sampling(start))
+        self.stream.prefetch_train(*self.stream_sampling(start))
         for round_idx in range(start, cfg.fed.comm_round):
-            sampled = self.client_sampling(round_idx)
+            fed_ids, n_real = self.stream_sampling(round_idx)
             self.log.info("################ round %d (stream): clients %s",
-                          round_idx, sampled.tolist())
-            Xs, ys, ns = self.stream.get_train(sampled)
+                          round_idx, fed_ids[:n_real].tolist())
+            Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
             if round_idx + 1 < cfg.fed.comm_round:
                 # overlap next round's host read with this round's compute
                 self.stream.prefetch_train(
-                    self.client_sampling(round_idx + 1))
-            rngs = self.per_client_rngs(round_idx, sampled)
+                    *self.stream_sampling(round_idx + 1))
+            rngs = self.per_client_rngs(round_idx, fed_ids)
             params, bstats, loss = self._round_stream_jit(
                 params, bstats, Xs, ys, ns, rngs,
                 self.round_lr(round_idx))

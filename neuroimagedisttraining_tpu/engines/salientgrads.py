@@ -164,13 +164,13 @@ class SalientGradsEngine(FederatedEngine):
         # cross-silo layout parity with ABCD/data_loader.py:216-315
         new_params = self.aggregate(cs.params, w)
         new_bstats = self.aggregate(cs.batch_stats, w)
-        # personal models <- this round's local results (scatter rows)
-        per_params = jax.tree.map(
-            lambda allp, newp: allp.at[sampled_idx].set(newp),
-            per_params, cs.params)
-        per_bstats = jax.tree.map(
-            lambda allp, newp: allp.at[sampled_idx].set(newp),
-            per_bstats, cs.batch_stats)
+        # personal models <- this round's local results; pad entries from
+        # stream_sampling are dropped, never written (base.scatter_sampled_rows)
+        real = ns > 0
+        per_params = self.scatter_sampled_rows(per_params, cs.params,
+                                               sampled_idx, real)
+        per_bstats = self.scatter_sampled_rows(per_bstats, cs.batch_stats,
+                                               sampled_idx, real)
         mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
         return new_params, new_bstats, per_params, per_bstats, mean_loss
 
@@ -231,24 +231,26 @@ class SalientGradsEngine(FederatedEngine):
                                       restored["per_bstats"])
             history = restored["history"]
         if self.stream is not None:
-            self.stream.prefetch_train(self.client_sampling(start))
+            self.stream.prefetch_train(*self.stream_sampling(start))
         for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
-            rngs = self.per_client_rngs(round_idx, sampled)
             if self.stream is not None:
-                Xs, ys, ns = self.stream.get_train(sampled)
+                fed_ids, n_real = self.stream_sampling(round_idx, sampled)
+                rngs = self.per_client_rngs(round_idx, fed_ids)
+                Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
                 if round_idx + 1 < cfg.fed.comm_round:
                     # overlap next round's host read with this round
                     self.stream.prefetch_train(
-                        self.client_sampling(round_idx + 1))
+                        *self.stream_sampling(round_idx + 1))
                 (params, bstats, per_params, per_bstats,
                  loss) = self._round_stream_jit(
                     params, bstats, per_params, per_bstats, Xs, ys, ns,
-                    masks, jnp.asarray(sampled), rngs,
+                    masks, jnp.asarray(fed_ids), rngs,
                     self.round_lr(round_idx))
             else:
+                rngs = self.per_client_rngs(round_idx, sampled)
                 (params, bstats, per_params, per_bstats,
                  loss) = self._round_jit(
                     params, bstats, per_params, per_bstats, self.data,

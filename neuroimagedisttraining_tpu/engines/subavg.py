@@ -110,27 +110,38 @@ class SubFedAvgEngine(FederatedEngine):
         (new_p, new_b, new_m, losses, dists, accepts) = jax.vmap(
             per_client)(Ms, rngs, Xs, ys, ns)
 
+        # mesh-tiling pad entries (ns == 0, possibly duplicate ids from
+        # stream_sampling) must not contribute to the count-based
+        # aggregation, the stats, or the mask scatter
+        real = (ns > 0).astype(jnp.float32)
+        rb = lambda x: real.reshape((-1,) + (1,) * (x.ndim - 1))
+
         # ---- overlap-count aggregation against the OLD masks ----
-        count = jax.tree.map(lambda m: jnp.sum(m, axis=0), Ms)
-        summed = jax.tree.map(lambda w: jnp.sum(w.astype(jnp.float32),
-                                                axis=0), new_p)
+        count = jax.tree.map(lambda m: jnp.sum(m * rb(m), axis=0), Ms)
+        summed = jax.tree.map(
+            lambda w: jnp.sum(w.astype(jnp.float32) * rb(w), axis=0),
+            new_p)
         agg = jax.tree.map(
             lambda sm, ct, old: jnp.where(ct > 0, sm
                                           / jnp.maximum(ct, 1.0), old),
             summed, count, params)
+        n_real = jnp.maximum(jnp.sum(real), 1.0)
         new_bstats = jax.tree.map(
-            lambda b: jnp.mean(b.astype(jnp.float32), axis=0), new_b)
-        # scatter updated personal masks back
-        mask_pers = jax.tree.map(
-            lambda allm, nm: allm.at[sampled_idx].set(nm), mask_pers,
-            new_m)
-        mean_loss = jnp.mean(losses)
+            lambda b: jnp.sum(b.astype(jnp.float32) * rb(b), axis=0)
+            / n_real, new_b)
+        # scatter updated personal masks back; pad entries are dropped,
+        # never written (base.scatter_sampled_rows)
+        mask_pers = self.scatter_sampled_rows(mask_pers, new_m,
+                                              sampled_idx, ns > 0)
+        mean_loss = jnp.sum(losses * real) / n_real
         # per-sampled-client nnz of the NEW masks: the true uplink volume
         # (reference nonzero-comm metric, model_trainer.py:49-53)
         up_nnz = jax.vmap(lambda m: sum(
             jnp.sum(x) for x in jax.tree.leaves(m)))(new_m)
         return (agg, new_bstats, mask_pers, mean_loss,
-                jnp.mean(dists), jnp.sum(accepts), jnp.sum(up_nnz))
+                jnp.sum(dists * real) / n_real,
+                jnp.sum(accepts * real),
+                jnp.sum(up_nnz * real))
 
     @functools.cached_property
     def _round_jit(self):
@@ -211,22 +222,24 @@ class SubFedAvgEngine(FederatedEngine):
             params, bstats = restored["params"], restored["batch_stats"]
             mask_pers, history = restored["mask_pers"], restored["history"]
         if self.stream is not None:
-            self.stream.prefetch_train(self.client_sampling(start))
+            self.stream.prefetch_train(*self.stream_sampling(start))
         for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
-            rngs = self.per_client_rngs(round_idx, sampled)
             if self.stream is not None:
-                Xs, ys, ns = self.stream.get_train(sampled)
+                fed_ids, n_real = self.stream_sampling(round_idx, sampled)
+                rngs = self.per_client_rngs(round_idx, fed_ids)
+                Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
                 if round_idx + 1 < cfg.fed.comm_round:
                     self.stream.prefetch_train(
-                        self.client_sampling(round_idx + 1))
+                        *self.stream_sampling(round_idx + 1))
                 (params, bstats, mask_pers, loss, mean_dist, n_accept,
                  up_nnz) = self._round_stream_jit(
                     params, bstats, mask_pers, Xs, ys, ns,
-                    jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+                    jnp.asarray(fed_ids), rngs, self.round_lr(round_idx))
             else:
+                rngs = self.per_client_rngs(round_idx, sampled)
                 (params, bstats, mask_pers, loss, mean_dist, n_accept,
                  up_nnz) = self._round_jit(
                     params, bstats, mask_pers, self.data,

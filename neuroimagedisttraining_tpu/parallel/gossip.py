@@ -150,6 +150,22 @@ def gossip_apply(tree, plan: Plan, mesh):
                          out_specs=specs)(tree)
 
 
+def make_plan(M: np.ndarray, mesh, num_clients: int):
+    """``(plan, plan_arrays)`` for a round's mixing/adjacency matrix — the
+    shared circulant -> sparse -> dense cascade used by the decentralized
+    engines: a hashable circulant Plan tuple (ppermute shifts) when the
+    matrix is circulant and tiles the mesh, a SparseSpec + traced routing
+    arrays (routed all_to_all) for sparse patterns, else ``(None, {})``
+    for the dense einsum."""
+    plan = circulant_plan(M)
+    if plan_fits_mesh(plan, mesh, num_clients):
+        return plan, {}
+    sp = sparse_plan(M, mesh, num_clients)
+    if sp is not None:
+        return sp
+    return None, {}
+
+
 # ---------- general sparse (per-round random) topologies ----------
 
 
