@@ -103,6 +103,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--mpc_frac_bits", type=int, default=16,
                         help="TurboAggregate: fixed-point fraction bits "
                              "for GF(p) quantization")
+    parser.add_argument("--mpc_backend", type=str, default="device",
+                        choices=("device", "host"),
+                        help="TurboAggregate MPC stage: 'device' (jitted "
+                             "uint32 mod-p on the accelerator, default) | "
+                             "'host' (numpy path modeling the "
+                             "client<->server boundary)")
     parser.add_argument("--defense_type", type=str, default="none",
                         help="none | norm_diff_clipping | weak_dp")
     parser.add_argument("--norm_bound", type=float, default=5.0)
@@ -177,7 +183,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             comm_round=args.comm_round, cs=args.cs, active=args.active,
             lamda=args.lamda, local_epochs=args.local_epochs,
             fomo_m=args.fomo_m, mpc_n_shares=args.mpc_n_shares,
-            mpc_frac_bits=args.mpc_frac_bits,
+            mpc_frac_bits=args.mpc_frac_bits, mpc_backend=args.mpc_backend,
             defense_type=args.defense_type,
             norm_bound=args.norm_bound, stddev=args.stddev,
             frequency_of_the_test=args.frequency_of_the_test,

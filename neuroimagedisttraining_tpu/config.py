@@ -104,6 +104,12 @@ class FedConfig:
     # per neighbor group)
     mpc_frac_bits: int = 16        # fixed-point fraction bits for GF(p)
     # quantization
+    # "device": the quantize/share/accumulate pipeline runs as jitted
+    # uint32 mod-p ops on the TPU's VPU, fused with the round (no host
+    # round-trip); "host": the numpy path that models the client<->server
+    # communication boundary (the multi-aggregator cross-silo deployment
+    # always uses the host toolkit — it crosses real process boundaries)
+    mpc_backend: str = "device"
     # Evaluation cadence
     frequency_of_the_test: int = 1
     ci: bool = False               # CI mode: evaluate client 0 only

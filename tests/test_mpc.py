@@ -146,6 +146,71 @@ def test_secure_sum_never_materializes_client_updates():
     np.testing.assert_allclose(got, stack.sum(axis=0), atol=4 * 2.0 ** -16)
 
 
+def test_secure_sum_device_matches_plain_sum_and_host():
+    """On-device MPC (ops/mpc_device.py): the jitted uint32 mod-p pipeline
+    reconstructs the plain sum to quantization tolerance, is invariant to
+    the masking key, and agrees with the host numpy path."""
+    import jax
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    rng = np.random.default_rng(11)
+    stack = (rng.normal(size=(6, 40)) * 0.2).astype(np.float32)
+    got = np.asarray(jax.jit(
+        lambda s, k: D.secure_sum_device(s, k, n_shares=3))(
+            stack, jax.random.key(0)))
+    np.testing.assert_allclose(got, stack.sum(axis=0), atol=6 * 2.0 ** -16)
+    # key/n_shares only decorrelate the masking material
+    got2 = np.asarray(D.secure_sum_device(stack, jax.random.key(99),
+                                          n_shares=5))
+    np.testing.assert_allclose(got2, got, atol=1e-6)
+    # and the two backends implement the same aggregation (float32 vs
+    # float64 quantize rounding can differ by one LSB per element)
+    host = mpc.secure_sum(stack, n_shares=3, rng=np.random.default_rng(1))
+    np.testing.assert_allclose(got, host, atol=8 * 2.0 ** -16)
+
+
+def test_secure_sum_device_slots_are_masked():
+    """Privacy invariant on device: the only server-visible intermediates
+    (per-slot totals) are uniformly-random masked material — none equals
+    any client's quantized update or the plain quantized sum."""
+    import jax
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    rng = np.random.default_rng(7)
+    stack = (rng.normal(size=(4, 64)) * 0.5).astype(np.float32)
+    out, slots = D.secure_sum_device(stack, jax.random.key(3), n_shares=3,
+                                     return_slots=True)
+    np.testing.assert_allclose(np.asarray(out), stack.sum(axis=0),
+                               atol=4 * 2.0 ** -16)
+    qs = [np.asarray(D.quantize_device(x)) for x in stack]
+    q_total = np.asarray(D.quantize_device(stack)).astype(np.int64)
+    q_sum = np.mod(q_total.sum(axis=0), mpc.P_DEFAULT)
+    for slot in np.asarray(slots):
+        for q in qs:
+            assert not np.array_equal(slot, q), \
+                "slot total equals a client's plaintext update"
+        assert not np.array_equal(slot.astype(np.int64), q_sum), \
+            "slot total equals the plain quantized sum"
+
+
+def test_turboaggregate_host_backend_still_works(tmp_path,
+                                                 synthetic_cohort):
+    """mpc_backend='host' keeps the boundary-modeling numpy path alive."""
+    import jax
+
+    from tests.test_fedavg import _make_engine
+
+    eng = _make_engine(tmp_path, synthetic_cohort,
+                       algorithm="turboaggregate", mpc_backend="host")
+    assert eng.cfg.fed.mpc_backend == "host"
+    res = eng.train()
+    assert np.isfinite(res["history"][-1]["train_loss"])
+    assert all(np.all(np.isfinite(np.asarray(l)))
+               for l in jax.tree.leaves(res["params"]))
+
+
 def test_key_agreement_symmetric():
     p, g = 2**31 - 1, 5
     sk_a, sk_b = 123457, 987653
