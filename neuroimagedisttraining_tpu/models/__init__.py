@@ -67,6 +67,7 @@ from neuroimagedisttraining_tpu.models.vision2d import (  # noqa: F401
     vgg11,
     vgg16,
     CNNCifar,
+    CNNCifarBN,
     CNN_OriginalFedAvg,
     CNN_DropOut,
     LeNet5,
@@ -110,6 +111,8 @@ def create_model(name: str, num_classes: int = 1, dtype=jnp.float32,
         return vgg16(num_classes=num_classes, dtype=dtype)
     if name in ("cnn_cifar10", "cnn_cifar100", "simple-cnn"):
         return CNNCifar(num_classes=num_classes, dtype=dtype)
+    if name in ("cnn_cifar10_bn", "cnn_cifar100_bn"):
+        return CNNCifarBN(num_classes=num_classes, dtype=dtype)
     if name in ("cnn", "cnn_originalfedavg"):
         return CNN_OriginalFedAvg(only_digits=num_classes <= 10, dtype=dtype)
     if name in ("cnn_dropout", "femnist-cnn"):

@@ -102,6 +102,7 @@ def test_cnn_original_fedavg_param_count():
     ("resnet18_ip", (1, 32, 32, 3), 10),
     ("vgg11", (1, 32, 32, 3), 10),
     ("cnn_cifar10", (1, 32, 32, 3), 10),
+    ("cnn_cifar10_bn", (1, 32, 32, 3), 10),
     ("cnn_cifar100", (1, 32, 32, 3), 100),
     ("lenet5", (1, 28, 28, 1), 10),
     ("lenet5_cifar", (1, 32, 32, 3), 10),
