@@ -27,8 +27,9 @@ null when the chip is unknown).
 
 Phase 3 — one-round timings for every other engine program, now including
 the flagship's steady-state MASKED round (salientgrads phase 2), ditto
-(dual-track: ~2x compute/sample), local, and turboaggregate (with the
-host-side MPC aggregation stage also timed alone).
+(dual-track: ~2x compute/sample), fedprox, local, and turboaggregate
+(with the MPC aggregation stage — device-jitted by default — also timed
+alone).
 
 ``vs_baseline`` compares against the reference's single-V100 sequential
 simulation. The reference publishes NO numbers (BASELINE.md), so the
@@ -223,7 +224,8 @@ def main() -> None:
 
         def dispfl_round():
             out = dp._round_jit(dpp, dper.batch_stats, m_local, m_local,
-                                fed, A_dp, rngs_all, lr, jnp.float32(1))
+                                fed, A_dp, rngs_all, lr, jnp.float32(1),
+                                {})
             _sync(out[-1], jax.tree.leaves(out[0])[0])
 
         algo_round_s["dispfl"] = _bestof(dispfl_round)
@@ -235,7 +237,7 @@ def main() -> None:
 
         def dpsgd_round():
             out = dg._round_jit(dper.params, dper.batch_stats, fed, M_mix,
-                                rngs_all, lr)
+                                rngs_all, lr, {})
             _sync(out[-1], jax.tree.leaves(out[0])[0])
 
         algo_round_s["dpsgd"] = _bestof(dpsgd_round)
@@ -288,6 +290,17 @@ def main() -> None:
             _sync(out[-1], jax.tree.leaves(out[0])[0])
 
         algo_round_s["salientgrads_masked"] = _bestof(salientgrads_round)
+
+        # FedProx: the FedAvg round + per-step proximal pull toward the
+        # incoming global (engines/fedprox.py; BASELINE.json configs[3])
+        fp = create_engine("fedprox", dataclasses.replace(
+            cfg, algorithm="fedprox"), fed, trainer, logger=log)
+
+        def fedprox_round():
+            out = fp._round_jit(params, bstats, fed, sampled, rngs_s, lr)
+            _sync(out[-1], jax.tree.leaves(out[0])[0])
+
+        algo_round_s["fedprox"] = _bestof(fedprox_round)
 
         # Ditto: dual-track round (global step + proximal personal step —
         # ~2x the FedAvg compute per sample by construction)
