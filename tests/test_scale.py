@@ -178,6 +178,95 @@ def test_salientgrads_100clients_resident_and_streaming(tmp_path,
         stream_engine.stream.close()
 
 
+def test_ditto_100clients_streamed_round_matches_resident(tmp_path,
+                                                          scale_cohort):
+    """Ditto's guarded personal-state scatter + n-weighted aggregation
+    under the padded streamed feed (6 duplicate pads) must equal the
+    resident 10-client round."""
+    res = _scale_engine(tmp_path, scale_cohort, "ditto")
+    st = _scale_engine(tmp_path, scale_cohort, "ditto", streaming=True)
+    try:
+        gs = res.init_global_state()
+        per = res.broadcast_states(gs, res.num_clients)
+        sampled = res.client_sampling(0)
+        out_res = res._round_jit(
+            gs.params, gs.batch_stats, per.params, per.batch_stats,
+            res.data, jnp.asarray(sampled),
+            res.per_client_rngs(0, sampled), res.round_lr(0))
+
+        fed_ids, n_real = st.stream_sampling(0)
+        assert n_real == 10 and len(fed_ids) == 16
+        assert (fed_ids[10:] == sampled[-1]).all()  # duplicate pads
+        Xs, ys, ns = st.stream.get_train(fed_ids, n_real)
+        per_st = st.broadcast_states(gs, st.num_clients)
+        out_st = st._round_stream_jit(
+            gs.params, gs.batch_stats, per_st.params, per_st.batch_stats,
+            Xs, ys, ns, jnp.asarray(fed_ids),
+            st.per_client_rngs(0, fed_ids), st.round_lr(0))
+        # global params + loss
+        for a, b in zip(jax.tree.leaves(out_res[0]),
+                        jax.tree.leaves(out_st[0])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+        np.testing.assert_allclose(float(out_res[-1]), float(out_st[-1]),
+                                   rtol=1e-6)
+        # personal stacks (first 100 rows; resident carries 4 mesh pads)
+        for a, b in zip(jax.tree.leaves(out_res[2]),
+                        jax.tree.leaves(out_st[2])):
+            np.testing.assert_allclose(np.asarray(a)[:C],
+                                       np.asarray(b)[:C], atol=1e-6)
+    finally:
+        st.stream.close()
+
+
+def test_subavg_100clients_streamed_round_matches_resident(tmp_path,
+                                                           scale_cohort):
+    """Sub-FedAvg's count-based aggregation and mask scatter explicitly
+    mask pad contributions; the padded streamed round must equal the
+    resident one (aggregate, masks, loss, accept stats)."""
+    res = _scale_engine(tmp_path, scale_cohort, "subavg")
+    st = _scale_engine(tmp_path, scale_cohort, "subavg", streaming=True)
+    try:
+        from neuroimagedisttraining_tpu.ops.masks import ones_mask
+
+        gs = res.init_global_state()
+        masks_res = res.broadcast_states(ones_mask(gs.params),
+                                         res.num_clients)
+        masks_st = st.broadcast_states(ones_mask(gs.params),
+                                       st.num_clients)
+        sampled = res.client_sampling(0)
+        out_res = res._round_jit(
+            gs.params, gs.batch_stats, masks_res, res.data,
+            jnp.asarray(sampled), res.per_client_rngs(0, sampled),
+            res.round_lr(0))
+
+        fed_ids, n_real = st.stream_sampling(0)
+        assert n_real == 10 and len(fed_ids) == 16
+        assert (fed_ids[10:] == sampled[-1]).all()  # duplicate pads
+        Xs, ys, ns = st.stream.get_train(fed_ids, n_real)
+        out_st = st._round_stream_jit(
+            gs.params, gs.batch_stats, masks_st, Xs, ys, ns,
+            jnp.asarray(fed_ids), st.per_client_rngs(0, fed_ids),
+            st.round_lr(0))
+        # aggregated params AND batch_stats (independent pad-masked
+        # reductions in engines/subavg.py)
+        for i in (0, 1):
+            for a, b in zip(jax.tree.leaves(out_res[i]),
+                            jax.tree.leaves(out_st[i])):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=1e-6)
+        for a, b in zip(jax.tree.leaves(out_res[2]),
+                        jax.tree.leaves(out_st[2])):
+            np.testing.assert_array_equal(np.asarray(a)[:C],
+                                          np.asarray(b)[:C])
+        # loss / mean mask dist / accepts / uplink nnz all pad-clean
+        for i in (3, 4, 5, 6):
+            np.testing.assert_allclose(float(out_res[i]),
+                                       float(out_st[i]), rtol=1e-6)
+    finally:
+        st.stream.close()
+
+
 def test_dispfl_100clients_consensus_path_and_round(tmp_path,
                                                     scale_cohort):
     """DisPFL at 100 clients: the reference-default random adjacency at
