@@ -23,7 +23,6 @@ import jax.numpy as jnp
 
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
-from neuroimagedisttraining_tpu.utils import pytree as pt
 
 
 class DittoEngine(FederatedEngine):

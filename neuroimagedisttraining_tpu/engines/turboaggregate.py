@@ -33,7 +33,6 @@ from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.fedavg import FedAvgEngine
 from neuroimagedisttraining_tpu.ops import mpc
-from neuroimagedisttraining_tpu.utils import pytree as pt
 
 
 class TurboAggregateEngine(FedAvgEngine):
