@@ -1,0 +1,46 @@
+"""``nidtlint`` — AST-based invariant checker for this package.
+
+The training stack keeps three kinds of invariants that ordinary linters
+cannot see: jitted round programs must stay trace-safe (no host syncs, no
+Python RNG), every engine must keep the ``FederatedEngine`` round
+contract, and the ``distributed/`` transports must honor the broker's
+write-lock protocol. ``nidtlint`` turns those from comments into
+machine-checked rules, run as a tier-1 gate (tests/test_analysis.py) and
+via ``scripts/run_static_checks.sh``.
+
+CLI::
+
+    python -m neuroimagedisttraining_tpu.analysis <paths> [--json]
+    python -m neuroimagedisttraining_tpu.analysis --list-rules
+
+Suppression: ``# nidt: allow[rule-id] -- one-line justification`` on the
+offending line; the justification is mandatory (rule ``pragma``).
+"""
+
+from neuroimagedisttraining_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RULE_REGISTRY,
+    Rule,
+    all_rule_ids,
+    lint_paths,
+    lint_source,
+    register,
+)
+
+# importing the rule modules registers every rule family
+from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
+    determinism,
+    engine_contract,
+    lock_discipline,
+    trace_safety,
+)
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULE_REGISTRY",
+    "all_rule_ids",
+    "lint_paths",
+    "lint_source",
+    "register",
+]

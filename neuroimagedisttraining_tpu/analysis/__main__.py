@@ -1,0 +1,7 @@
+"""Entry point: ``python -m neuroimagedisttraining_tpu.analysis <paths>``."""
+
+import sys
+
+from neuroimagedisttraining_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,64 @@
+"""``nidtlint`` command line: ``python -m neuroimagedisttraining_tpu.analysis``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. Default output is one
+``file:line rule-id message`` per finding; ``--json`` emits a machine-
+readable report for CI annotation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Sequence
+
+from neuroimagedisttraining_tpu.analysis import lint_paths
+from neuroimagedisttraining_tpu.analysis.core import RULE_REGISTRY
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m neuroimagedisttraining_tpu.analysis",
+        description=("nidtlint: AST invariant checker for trace-safety, "
+                     "engine contracts, lock discipline and determinism"))
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as a JSON array")
+    p.add_argument("--rules", default=None, metavar="ID[,ID...]",
+                   help="only run the named rule ids")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print every rule family and exit")
+    return p
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for cls in RULE_REGISTRY.values():
+            print(f"{', '.join(cls.rule_ids)}: {cls.description}")
+        return 0
+    if not args.paths:
+        print("error: no paths given (try --list-rules)", file=sys.stderr)
+        return 2
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        findings = lint_paths(args.paths, rules=rules)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps([f.as_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"nidtlint: {len(findings)} finding(s) "
+                  f"across {len({f.path for f in findings})} file(s)",
+                  file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__.py
+    sys.exit(main())

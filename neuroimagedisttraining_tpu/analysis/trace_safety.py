@@ -1,0 +1,217 @@
+"""Trace-safety rules: no host syncs or Python-RNG reads inside traced code.
+
+Every engine's round program is jitted (``jax.jit(round_fn)``) and its
+per-client block is vmapped; a ``float()``/``.item()``/``np.asarray``/
+``jax.device_get`` there either fails to trace or — worse — silently bakes
+a traced value into a Python constant, and ``np.random.*`` bakes ONE draw
+into the compiled executable, destroying round-to-round randomness. The
+rule marks a function as traced when it is
+
+- decorated with ``jax.jit`` (or ``functools.partial(jax.jit, ...)``), or
+- passed (possibly through ``functools.partial``) to ``jax.jit``,
+  ``jax.vmap``, ``jax.pmap``, ``pjit`` or ``shard_map`` — resolved
+  lexically: local ``def``s by enclosing-scope name lookup, methods by
+  ``self.<name>`` within the class, lambdas in place,
+
+and then flags the calls above anywhere lexically inside it (nested
+helpers included). Calls *of* the traced function, and host code that
+merely consumes its outputs, are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from neuroimagedisttraining_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    normalize,
+    register,
+)
+
+#: tracer entry point -> positional indices of the arguments it traces
+#: (jax.lax.cond traces both branches; while_loop traces cond AND body)
+TRACERS: dict[str, tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jax.shard_map": (0,),  # jax >= 0.8 spelling of shard_map
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.experimental.pjit.pjit": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.associative_scan": (0,),
+}
+_PARTIAL = {"functools.partial"}
+
+#: host-synchronizing calls by canonical dotted name
+HOST_SYNC_DOTTED = {
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+    "jax.device_get",
+}
+#: host-synchronizing zero-arg methods on array-likes
+HOST_SYNC_METHODS = {"item", "tolist"}
+
+_SCOPES = (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+           ast.ClassDef)
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _annotate_parents(tree: ast.Module) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._nidt_parent = node  # type: ignore[attr-defined]
+
+
+def _ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "_nidt_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_nidt_parent", None)
+
+
+def _unwrap_partial(node: ast.AST, aliases: dict[str, str]) -> ast.AST:
+    """``functools.partial(f, ...)`` -> ``f`` (recursively)."""
+    if (isinstance(node, ast.Call)
+            and normalize(dotted_name(node.func), aliases) in _PARTIAL
+            and node.args):
+        return _unwrap_partial(node.args[0], aliases)
+    return node
+
+
+def _is_tracer(node: ast.AST, aliases: dict[str, str]) -> bool:
+    return normalize(dotted_name(node), aliases) in TRACERS
+
+
+class _DefIndex:
+    """Lexical lookup of function definitions: ``(scope, name) -> def``."""
+
+    def __init__(self, tree: ast.Module):
+        self._by_scope: dict[tuple[int, str], ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = self._enclosing_scope(node)
+                self._by_scope[(id(scope), node.name)] = node
+
+    @staticmethod
+    def _enclosing_scope(node: ast.AST) -> ast.AST:
+        for anc in _ancestors(node):
+            if isinstance(anc, _SCOPES):
+                return anc
+        return node
+
+    def resolve_name(self, at: ast.AST, name: str) -> ast.AST | None:
+        """Innermost-scope-first lookup of ``name`` from ``at``'s position."""
+        for anc in _ancestors(at):
+            if isinstance(anc, _SCOPES):
+                hit = self._by_scope.get((id(anc), name))
+                if hit is not None:
+                    return hit
+        return None
+
+    def resolve_method(self, at: ast.AST, name: str) -> ast.AST | None:
+        for anc in _ancestors(at):
+            if isinstance(anc, ast.ClassDef):
+                return self._by_scope.get((id(anc), name))
+        return None
+
+
+def collect_traced(mod: ModuleInfo) -> list[ast.AST]:
+    """All function/lambda nodes handed to a tracer in this module."""
+    _annotate_parents(mod.tree)
+    index = _DefIndex(mod.tree)
+    aliases = mod.aliases
+    traced: dict[int, ast.AST] = {}
+
+    def mark(node: ast.AST | None) -> None:
+        if isinstance(node, _FUNCS):
+            traced[id(node)] = node
+
+    def mark_target(at: ast.AST, target: ast.AST) -> None:
+        target = _unwrap_partial(target, aliases)
+        if isinstance(target, (ast.List, ast.Tuple)):
+            for el in target.elts:  # e.g. jax.lax.switch branch lists
+                mark_target(at, el)
+        elif isinstance(target, ast.Lambda):
+            mark(target)
+        elif isinstance(target, ast.Name):
+            mark(index.resolve_name(at, target.id))
+        elif (isinstance(target, ast.Attribute)
+              and isinstance(target.value, ast.Name)
+              and target.value.id in ("self", "cls")):
+            mark(index.resolve_method(at, target.attr))
+        # imported / foreign attributes (e.g. jax.vmap(module.fn)) are not
+        # resolvable lexically — their bodies are linted in their own file
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                if _is_tracer(target, aliases):
+                    mark(node)
+                elif (isinstance(deco, ast.Call)
+                      and normalize(dotted_name(deco.func), aliases)
+                      in _PARTIAL and deco.args
+                      and _is_tracer(deco.args[0], aliases)):
+                    mark(node)
+        if not (isinstance(node, ast.Call)
+                and _is_tracer(node.func, aliases) and node.args):
+            continue
+        for idx in TRACERS[normalize(dotted_name(node.func), aliases)]:
+            if idx < len(node.args):
+                mark_target(node, node.args[idx])
+    return list(traced.values())
+
+
+@register
+class TraceSafetyRule(Rule):
+    rule_ids = ("trace-host-sync", "trace-np-random")
+    description = ("no float()/.item()/.tolist()/np.asarray/jax.device_get "
+                   "(trace-host-sync) or np.random.* (trace-np-random) "
+                   "lexically inside jitted/vmapped/shard_mapped functions")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        seen: set[int] = set()
+        for root in collect_traced(mod):
+            for node in ast.walk(root):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                seen.add(id(node))
+                yield from self._check_call(mod, node)
+
+    def _check_call(self, mod: ModuleInfo,
+                    node: ast.Call) -> Iterator[Finding]:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "float":
+            yield Finding(mod.path, node.lineno, "trace-host-sync",
+                          "float() on a traced value forces a host sync "
+                          "(bakes the tracer into a Python constant)")
+            return
+        if (isinstance(func, ast.Attribute)
+                and func.attr in HOST_SYNC_METHODS and not node.args):
+            yield Finding(mod.path, node.lineno, "trace-host-sync",
+                          f".{func.attr}() forces a host sync inside a "
+                          "traced function")
+            return
+        name = normalize(dotted_name(func), mod.aliases)
+        if name in HOST_SYNC_DOTTED:
+            yield Finding(mod.path, node.lineno, "trace-host-sync",
+                          f"{name} materializes on host inside a traced "
+                          "function")
+        elif name is not None and name.startswith("numpy.random."):
+            yield Finding(mod.path, node.lineno, "trace-np-random",
+                          f"{name} inside a traced function bakes one "
+                          "Python-RNG draw into the compiled executable")

@@ -177,7 +177,7 @@ class SocketCommManager(QueueDispatchMixin, BaseCommManager):
         for _ in range(retries):  # receiver may not be listening yet
             try:
                 with socket.create_connection(addr, timeout=10.0) as conn:
-                    conn.sendall(struct.pack("!Q", len(raw)) + raw)
+                    conn.sendall(struct.pack("!Q", len(raw)) + raw)  # nidt: allow[lock-send] -- conn is a fresh per-frame connection local to this call; no concurrent writer exists
                 return
             except OSError as e:
                 last_err = e

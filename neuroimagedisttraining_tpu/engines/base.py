@@ -111,8 +111,11 @@ class FederatedEngine:
         per_round = min(self.cfg.fed.client_num_per_round, total)
         if total == per_round:
             return np.arange(total)
-        np.random.seed(round_idx)
-        return np.sort(np.random.choice(range(total), per_round,
+        # nidt: allow[determinism-global-random] -- reference-parity
+        # sampling shim: MUST replay the legacy global stream
+        # (fedavg_api.py:92-100) to keep client cohorts bit-identical
+        np.random.seed(round_idx)  # nidt: allow[determinism-global-random] -- reference-parity shim (fedavg_api.py:92-100)
+        return np.sort(np.random.choice(range(total), per_round,  # nidt: allow[determinism-global-random] -- reference-parity shim (fedavg_api.py:92-100)
                                         replace=False))
 
     def stream_sampling(self, round_idx: int,

@@ -39,7 +39,7 @@ class DittoEngine(FederatedEngine):
         f = self.cfg.fed
         S = Xs.shape[0]
         max_samples = self._max_samples()
-        lamda = float(f.lamda)
+        lamda = float(f.lamda)  # nidt: allow[trace-host-sync] -- cfg.fed.lamda is a static Python scalar bound at trace time, not a tracer
 
         def bcast(t):
             return jax.tree.map(

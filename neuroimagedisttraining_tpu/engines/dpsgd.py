@@ -48,10 +48,10 @@ def benefit_choose(round_idx: int, cur_clnt: int, total: int,
         return np.arange(total)
     if cs == "random":
         num = min(per_round, total)
-        np.random.seed(round_idx + cur_clnt)
-        idx = np.random.choice(range(total), num, replace=False)
+        np.random.seed(round_idx + cur_clnt)  # nidt: allow[determinism-global-random] -- reference-parity shim (dpsgd_api.py:116-139)
+        idx = np.random.choice(range(total), num, replace=False)  # nidt: allow[determinism-global-random] -- reference-parity shim (dpsgd_api.py:116-139)
         while cur_clnt in idx:
-            idx = np.random.choice(range(total), num, replace=False)
+            idx = np.random.choice(range(total), num, replace=False)  # nidt: allow[determinism-global-random] -- reference-parity shim (dpsgd_api.py:116-139)
         return idx
     if cs == "ring":
         return np.asarray([(cur_clnt - 1) % total, (cur_clnt + 1) % total])

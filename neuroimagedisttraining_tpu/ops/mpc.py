@@ -74,7 +74,7 @@ def bgw_encode(X, N: int, T: int, p: int = P_DEFAULT, rng=None) -> np.ndarray:
     """Degree-T Shamir shares of X (field elements, any shape) evaluated at
     alpha = 1..N (BGW_encoding, mpc_function.py:62-75). Returns [N, *X.shape].
     Secrecy: any T shares reveal nothing; T+1 reconstruct."""
-    rng = rng or np.random.default_rng()
+    rng = rng or np.random.default_rng()  # nidt: allow[determinism-unseeded-rng] -- secret-sharing masks MUST be unpredictable: fresh OS entropy unless a test injects rng
     X = _asfield(X, p)
     coeffs = np.concatenate(
         [X[None], rng.integers(0, p, size=(T,) + X.shape, dtype=np.int64)])
@@ -125,7 +125,7 @@ def lcc_encode(X, N: int, K: int, T: int, p: int = P_DEFAULT,
     interpolate through them at ``betas`` and evaluate at ``alphas``
     (LCC_encoding / LCC_encoding_w_Random, mpc_function.py:111-164).
     Returns [N, m//K, ...]."""
-    rng = rng or np.random.default_rng()
+    rng = rng or np.random.default_rng()  # nidt: allow[determinism-unseeded-rng] -- secret-sharing masks MUST be unpredictable: fresh OS entropy unless a test injects rng
     X = _asfield(X, p)
     m = X.shape[0]
     assert m % K == 0, f"first axis {m} not divisible by K={K}"
@@ -164,7 +164,7 @@ def lcc_decode(f_eval, N: int, K: int, T: int, worker_idx,
 def additive_shares(x, n_out: int, p: int = P_DEFAULT, rng=None) -> np.ndarray:
     """n_out shares summing to x mod p (Gen_Additive_SS,
     mpc_function.py:214-224)."""
-    rng = rng or np.random.default_rng()
+    rng = rng or np.random.default_rng()  # nidt: allow[determinism-unseeded-rng] -- secret-sharing masks MUST be unpredictable: fresh OS entropy unless a test injects rng
     x = _asfield(x, p)
     shares = rng.integers(0, p, size=(n_out - 1,) + x.shape, dtype=np.int64)
     last = np.mod(x - np.mod(shares.sum(axis=0), p), p)
@@ -188,7 +188,7 @@ def secure_sum(stack, n_shares: int, frac_bits: int = 16,
     accumulator state after each client) is appended, so tests can assert
     the invariant directly.
     """
-    rng = rng or np.random.default_rng()
+    rng = rng or np.random.default_rng()  # nidt: allow[determinism-unseeded-rng] -- secret-sharing masks MUST be unpredictable: fresh OS entropy unless a test injects rng
     stack = np.asarray(stack)
     slots = np.zeros((n_shares,) + stack.shape[1:], np.int64)
     for c in range(stack.shape[0]):

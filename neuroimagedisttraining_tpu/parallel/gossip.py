@@ -45,6 +45,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
 from neuroimagedisttraining_tpu.parallel.mesh import CLIENT_AXIS
 
 #: plan entry: (signed client-axis offset, mixing weight)
@@ -146,8 +151,8 @@ def gossip_apply(tree, plan: Plan, mesh):
 
         return jax.tree.map(one, blk_tree)
 
-    return jax.shard_map(block_fn, mesh=mesh, in_specs=(specs,),
-                         out_specs=specs)(tree)
+    return shard_map(block_fn, mesh=mesh, in_specs=(specs,),
+                     out_specs=specs)(tree)
 
 
 def make_plan(M: np.ndarray, mesh, num_clients: int):
@@ -297,7 +302,7 @@ def gossip_apply_sparse(tree, spec: SparseSpec, arrays, mesh):
 
         return jax.tree.map(one, blk_tree)
 
-    return jax.shard_map(
+    return shard_map(
         block_fn, mesh=mesh,
         in_specs=(specs, vec, vec, vec), out_specs=specs,
     )(tree, jnp.asarray(arrays["send_idx"]),

@@ -10,11 +10,14 @@ host-streaming path — on boxes without a toolchain.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
 
 import numpy as np
+
+log = logging.getLogger("neuroimagedisttraining_tpu.native")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
                            "native")
@@ -33,7 +36,14 @@ def _build() -> bool:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
-    except (OSError, subprocess.SubprocessError):
+    except (OSError, subprocess.SubprocessError) as e:
+        # surface WHY the numpy slow path is in use; logged once per
+        # process because load() latches _lib = False after this fails
+        stderr = getattr(e, "stderr", None)
+        detail = (stderr.decode("utf-8", errors="replace").strip()
+                  if stderr else str(e))
+        log.warning("native gather build failed (%s); falling back to the "
+                    "numpy slow path: %s", " ".join(cmd), detail)
         return False
 
 

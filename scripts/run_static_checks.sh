@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Single entry point for the repo's static analysis (ARCHITECTURE.md
+# "Static analysis"): generic lint (ruff, pycodestyle/pyflakes tier, config
+# in pyproject.toml) + the repo-specific invariant checker (nidtlint).
+# Exits non-zero if either reports findings.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check (pycodestyle/pyflakes tier) =="
+    ruff check neuroimagedisttraining_tpu tests scripts || rc=1
+else
+    # ruff is optional tooling — nidtlint below is the dependency-free gate
+    echo "== ruff not installed; skipping the generic lint tier ==" >&2
+fi
+
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
+
+exit $rc

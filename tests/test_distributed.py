@@ -566,3 +566,81 @@ def test_broker_retains_for_late_subscriber():
     assert got and got[0].get(M.ARG_ROUND_IDX) == 42
     srv.stop_receive_message()
     broker.stop()
+
+
+def test_broker_retains_latest_frame_for_late_subscriber():
+    """MQTT-retain keeps only the NEWEST frame per topic: a subscriber
+    attaching after several publishes receives the latest state, not the
+    first — resuming peers must never train from a stale global model."""
+    import socket as sock
+
+    from neuroimagedisttraining_tpu.distributed.broker import (
+        _OP_PUB, _OP_SUB, MessageBroker, _read_frame, _write_frame,
+    )
+
+    broker = MessageBroker()
+    pub = sock.create_connection(("127.0.0.1", broker.port), timeout=10)
+    _write_frame(pub, _OP_PUB, "model", b"round-1")
+    _write_frame(pub, _OP_PUB, "model", b"round-2")
+    time.sleep(0.3)  # let the broker's serve thread process both frames
+
+    sub = sock.create_connection(("127.0.0.1", broker.port), timeout=10)
+    sub.settimeout(10)
+    _write_frame(sub, _OP_SUB, "model")
+    frame = _read_frame(sub)
+    assert frame is not None and frame[2] == b"round-2"
+    for c in (pub, sub):
+        c.close()
+    broker.stop()
+
+
+def test_broker_retained_frame_never_overtakes_live_pub():
+    """Concurrency contract (broker.py:20-26): retained delivery happens
+    under the new subscriber's write lock taken BEFORE registration, so a
+    subscriber that attaches mid-stream may first see the stale retained
+    frame but every following frame must be newer — monotone sequence
+    numbers prove no live PUB was overtaken."""
+    import socket as sock
+
+    from neuroimagedisttraining_tpu.distributed.broker import (
+        _OP_PUB, _OP_SUB, MessageBroker, _read_frame, _write_frame,
+    )
+
+    broker = MessageBroker()
+    pub = sock.create_connection(("127.0.0.1", broker.port), timeout=10)
+    _write_frame(pub, _OP_PUB, "seq", b"%08d" % 0)  # the stale retainee
+    time.sleep(0.2)
+
+    stop = threading.Event()
+
+    def publisher():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                _write_frame(pub, _OP_PUB, "seq", b"%08d" % i)
+            except OSError:
+                return
+            time.sleep(0.001)
+
+    th = threading.Thread(target=publisher, daemon=True)
+    th.start()
+    try:
+        for _ in range(8):  # subscribers attach while PUBs are in flight
+            sub = sock.create_connection(("127.0.0.1", broker.port),
+                                         timeout=10)
+            sub.settimeout(10)
+            _write_frame(sub, _OP_SUB, "seq")
+            seq = []
+            for _ in range(5):
+                frame = _read_frame(sub)
+                assert frame is not None
+                seq.append(int(frame[2]))
+            assert seq == sorted(seq), (
+                f"stale retained frame overtook a live PUB: {seq}")
+            sub.close()
+    finally:
+        stop.set()
+        th.join(timeout=10)
+        pub.close()
+        broker.stop()

@@ -7,7 +7,6 @@ reference's seeding contract, (d) aggregation algebra is exact on tiny
 pytrees.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

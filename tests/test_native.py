@@ -47,3 +47,23 @@ def test_fetch_rows_uses_native_path():
     src = rng.integers(0, 256, size=(40, 6, 6), dtype=np.uint8)
     idx = np.asarray([7, 2, 2, 39, 0])
     np.testing.assert_array_equal(fetch_rows(src, idx), src[idx])
+
+
+def test_failed_build_logs_gpp_stderr(tmp_path, monkeypatch, caplog):
+    """A compiler failure must not be silent: the g++ stderr is logged at
+    warning level so the numpy-fallback slow path is diagnosable."""
+    import logging
+
+    bad_src = tmp_path / "broken.cpp"
+    bad_src.write_text("this is not C++\n")
+    monkeypatch.setattr(native, "_SRC", str(bad_src))
+    monkeypatch.setattr(native, "_SO", str(tmp_path / "broken.so"))
+    with caplog.at_level(logging.WARNING,
+                         logger="neuroimagedisttraining_tpu.native"):
+        assert native._build() is False
+    assert any("native gather build failed" in r.message
+               for r in caplog.records)
+    # the g++ diagnostic itself (or, without a toolchain, the OSError)
+    # made it into the log record
+    assert any("error" in r.message.lower() or "No such file" in r.message
+               for r in caplog.records)
