@@ -68,6 +68,28 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--seed", type=int, default=1024)
     parser.add_argument("--cs", type=str, default="random")
     parser.add_argument("--active", type=float, default=1.0)
+    parser.add_argument("--fault_spec", type=str, default="",
+                        help="deterministic fault schedule (faults/): "
+                             "'crash:RANK@ROUND,crash_prob:P,"
+                             "straggle:P:MAX_S,drop:P,dup:P,disconnect:P' "
+                             "— crashed clients leave the sampled cohort "
+                             "(survivor-reweighted rounds); the same seed "
+                             "drives the multiprocess federation")
+    parser.add_argument("--round_deadline", type=float, default=0.0,
+                        help="cross-silo per-round deadline seconds "
+                             "(distributed.run); recorded in the config "
+                             "for parity with the multiprocess runner")
+    parser.add_argument("--quorum", type=int, default=0,
+                        help="min survivor uploads for a deadline round "
+                             "to aggregate (0 = all clients)")
+    parser.add_argument("--heartbeat_interval", type=float, default=0.0,
+                        help="cross-silo clients: liveness beat period "
+                             "seconds (0 = off); recorded in the config "
+                             "for parity with distributed.run")
+    parser.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                        help="cross-silo server: mark clients suspect "
+                             "once their heartbeat is older than this "
+                             "(0 = off)")
     parser.add_argument("--tag", type=str, default="test")
     parser.add_argument("--num_classes", type=int, default=1)
     # sparsity family
@@ -181,6 +203,10 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         fed=FedConfig(
             client_num_in_total=args.client_num_in_total, frac=args.frac,
             comm_round=args.comm_round, cs=args.cs, active=args.active,
+            fault_spec=args.fault_spec,
+            round_deadline=args.round_deadline, quorum=args.quorum,
+            heartbeat_interval=args.heartbeat_interval,
+            heartbeat_timeout=args.heartbeat_timeout,
             lamda=args.lamda, local_epochs=args.local_epochs,
             fomo_m=args.fomo_m, mpc_n_shares=args.mpc_n_shares,
             mpc_frac_bits=args.mpc_frac_bits, mpc_backend=args.mpc_backend,

@@ -17,8 +17,10 @@ The rule enforces both lexically:
 Lexical means per-function: a helper that writes without taking the lock
 is flagged at its ``def`` site even if every current caller holds the
 lock — that invariant lives in the callers and must be pragma'd with the
-justification where the send happens. The rule only fires for files under
-a ``distributed/`` directory.
+justification where the send happens. The rule fires for files under a
+``distributed/`` or ``faults/`` directory (the fault-injection wrapper
+writes raw frames too — torn-frame sends carry the same interleaving
+hazard as the transports').
 """
 
 from __future__ import annotations
@@ -63,12 +65,12 @@ def _shared_attr(node: ast.AST) -> str | None:
 @register
 class LockDisciplineRule(Rule):
     rule_ids = ("lock-send", "lock-shared-map")
-    description = ("in distributed/, socket .send/.sendall and mutations "
-                   "of shared topic/subscriber maps must sit inside a "
-                   "`with <lock>:` block")
+    description = ("in distributed/ and faults/, socket .send/.sendall "
+                   "and mutations of shared topic/subscriber maps must "
+                   "sit inside a `with <lock>:` block")
 
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
-        if "distributed" not in mod.path_parts:
+        if not {"distributed", "faults"} & set(mod.path_parts):
             return
         yield from self._walk(mod, mod.tree.body, lock_depth=0)
 

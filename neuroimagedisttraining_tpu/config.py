@@ -110,6 +110,16 @@ class FedConfig:
     # communication boundary (the multi-aggregator cross-silo deployment
     # always uses the host toolkit — it crosses real process boundaries)
     mpc_backend: str = "device"
+    # Deterministic fault injection + tolerance (faults/, ISSUE 2).
+    # fault_spec grammar: "crash:RANK@ROUND,crash_prob:P,straggle:P:MAX_S,
+    # drop:P,dup:P,disconnect:P" (faults/schedule.parse_fault_spec); one
+    # config seed replays the identical fault trace in the simulated
+    # engines AND the multiprocess federation.
+    fault_spec: str = ""
+    round_deadline: float = 0.0    # s; >0 arms the cross-silo per-round deadline
+    quorum: int = 0                # min uploads to aggregate at deadline; 0 = all
+    heartbeat_interval: float = 0.0  # s; >0 makes silo clients beat liveness
+    heartbeat_timeout: float = 0.0   # s; >0 marks silent clients suspect
     # Evaluation cadence
     frequency_of_the_test: int = 1
     ci: bool = False               # CI mode: evaluate client 0 only

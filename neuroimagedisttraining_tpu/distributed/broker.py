@@ -157,6 +157,9 @@ class MessageBroker:
                             # topic would be misparsed. Tear the whole
                             # connection down, not just this subscription.
                             self._drop(t)
+        except OSError:
+            pass  # conn closed under us (peer died / broker.stop()) —
+            # normal teardown, not a serve-thread crash to report
         finally:
             self._drop(conn)
 

@@ -166,22 +166,34 @@ class SocketCommManager(QueueDispatchMixin, BaseCommManager):
 
     # ---- send side ----
 
-    def send_message(self, msg: Message, retries: int = 50,
-                     retry_delay: float = 0.1) -> None:
+    def send_message(self, msg: Message, retries: int = 7,
+                     retry_delay: float = 0.1,
+                     max_delay: float = 2.0) -> None:
+        """Send one frame with capped exponential backoff between
+        connection attempts: attempt ``i`` sleeps
+        ``min(max_delay, retry_delay * 2**i)``. A fixed interval hammers
+        a restarting peer with connect storms; backoff spreads the same
+        patience over far fewer attempts. The default budget
+        (~5 s: 0.1+0.2+0.4+0.8+1.6+2.0) matches the historical
+        50 x 0.1 s fixed-interval wait; callers wanting a longer window
+        (e.g. first contact while the server jit-compiles) pass bigger
+        ``retries``."""
         import time
 
         raw = msg.to_bytes()
         addr = (self.host_map[msg.receiver_id],
                 self.base_port + msg.receiver_id)
         last_err: Exception | None = None
-        for _ in range(retries):  # receiver may not be listening yet
+        for attempt in range(retries):  # receiver may not be listening yet
             try:
                 with socket.create_connection(addr, timeout=10.0) as conn:
                     conn.sendall(struct.pack("!Q", len(raw)) + raw)  # nidt: allow[lock-send] -- conn is a fresh per-frame connection local to this call; no concurrent writer exists
                 return
             except OSError as e:
                 last_err = e
-                time.sleep(retry_delay)
+                if attempt + 1 < retries:
+                    time.sleep(min(max_delay,
+                                   retry_delay * (2.0 ** attempt)))
         raise ConnectionError(
             f"rank {self.rank} could not reach rank {msg.receiver_id} "
             f"at {addr}: {last_err}")

@@ -22,16 +22,40 @@ collectives; the socket plane then only carries control messages.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import Callable
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from neuroimagedisttraining_tpu.distributed import message as M
 from neuroimagedisttraining_tpu.distributed.managers import (
     ClientManager, ServerManager,
 )
+from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
+
+log = logging.getLogger("neuroimagedisttraining_tpu.cross_silo")
+
+_weighted_mean_jit = None
+
+
+def survivor_weighted_mean(trees: list, ns: list[float]):
+    """Sample-count-weighted mean over whatever subset of clients
+    reported — THE jitted engine aggregation (utils/pytree
+    ``tree_weighted_mean``, the op ``FederatedEngine.aggregate`` lowers
+    to for frac-sampled rounds), so a deadline-truncated cross-silo
+    round is bitwise-identical to an engine round over the same survivor
+    set (pinned in tests/test_faults.py)."""
+    global _weighted_mean_jit
+    if _weighted_mean_jit is None:
+        _weighted_mean_jit = jax.jit(tree_weighted_mean)
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *trees)
+    out = _weighted_mean_jit(stacked, jnp.asarray(ns, jnp.float32))
+    return jax.tree.map(lambda x: np.asarray(x), out)
 
 
 def init_multihost(coordinator_address: str, num_processes: int,
@@ -58,20 +82,70 @@ def _to_numpy_tree(tree):
 
 
 class FedAvgServer(ServerManager):
-    """Rank 0. Aggregates client updates sample-weighted per round."""
+    """Rank 0. Aggregates client updates sample-weighted per round.
+
+    Fault tolerance (all opt-in; defaults reproduce the strict
+    wait-for-everyone protocol):
+
+    - ``round_deadline`` > 0 arms a per-round timer. When it fires with
+      at least ``quorum`` uploads, the server aggregates over the
+      survivors with sample-count re-weighting (the same jitted
+      ``tree_weighted_mean`` the engines use for frac-sampled rounds)
+      and marks the missing clients suspect; with fewer than ``quorum``
+      it re-arms and keeps waiting — quorum is a hard floor, never
+      silently lowered.
+    - uploads are tagged with ``round_idx``: stale uploads (a straggler
+      finishing after the deadline aggregated without it) and duplicate
+      frames (a chaotic transport re-delivering) can never double-count.
+    - ``heartbeat_timeout`` > 0 starts a monitor that marks clients
+      suspect once their heartbeat goes stale — a crashed client is
+      flagged within ~``timeout + timeout/4`` even mid-round.
+    - a suspect client that re-registers is shipped the current round's
+      model directly (late rejoin) and leaves the suspect set; a fresh
+      upload or heartbeat also clears suspicion.
+    """
 
     def __init__(self, init_params, comm_round: int, num_clients: int,
-                 world_size: int | None = None, **kw):
+                 world_size: int | None = None, round_deadline: float = 0.0,
+                 quorum: int = 0, heartbeat_timeout: float = 0.0, **kw):
         super().__init__(rank=0, world_size=world_size or num_clients + 1,
                          **kw)
         self.params = _to_numpy_tree(init_params)
         self.comm_round = comm_round
         self.num_clients = num_clients
+        self.round_deadline = float(round_deadline)
+        self.quorum = int(quorum) if quorum > 0 else num_clients
+        self.heartbeat_timeout = float(heartbeat_timeout)
         self.round_idx = 0
         self._registered: set[int] = set()
         self._updates: dict[int, tuple] = {}
         self.history: list[dict] = []
         self._done = threading.Event()
+        #: guards all round state: handlers run on the dispatch thread,
+        #: the deadline timer and heartbeat monitor on their own threads
+        self._rlock = threading.Lock()
+        self._started = False
+        self._suspect: set[int] = set()
+        self._last_beat: dict[int, float] = {}
+        self._timer: threading.Timer | None = None
+        #: bumped on every arm/cancel: a fired callback that was blocked
+        #: on the lock while the round (or secure phase) moved on must
+        #: become a no-op — round_idx alone cannot distinguish the
+        #: secure A->B transition within one round
+        self._deadline_gen = 0
+
+    @property
+    def fault_tolerant(self) -> bool:
+        return self.round_deadline > 0 or self.heartbeat_timeout > 0
+
+    def suspect_clients(self) -> set[int]:
+        with self._rlock:
+            return set(self._suspect)
+
+    def run(self) -> None:
+        if self.heartbeat_timeout > 0:
+            threading.Thread(target=self._monitor_loop, daemon=True).start()
+        super().run()
 
     # ---- handlers ----
 
@@ -80,37 +154,165 @@ class FedAvgServer(ServerManager):
             M.MSG_TYPE_C2S_REGISTER, self._on_register)
         self.register_message_receive_handler(
             M.MSG_TYPE_C2S_SEND_MODEL, self._on_model)
+        self.register_message_receive_handler(
+            M.MSG_TYPE_C2S_HEARTBEAT, self._on_heartbeat)
 
     def _on_register(self, msg: M.Message) -> None:
-        self._registered.add(msg.sender_id)
-        if len(self._registered) == self.num_clients:
-            self._broadcast_sync(M.MSG_TYPE_S2C_INIT_CONFIG)
+        with self._rlock:
+            c = msg.sender_id
+            self._registered.add(c)
+            self._suspect.discard(c)
+            self._last_beat[c] = time.monotonic()
+            if not self._started:
+                if len(self._registered) == self.num_clients:
+                    self._started = True
+                    self._broadcast_sync(M.MSG_TYPE_S2C_INIT_CONFIG)
+            else:
+                # late rejoin: ship the CURRENT round state directly so a
+                # restarted silo re-enters without waiting a full round
+                log.info("server: client %d re-registered; shipping "
+                         "round %d state", c, self.round_idx)
+                self._send_sync_to(M.MSG_TYPE_S2C_SYNC_MODEL, c)
+
+    def _on_heartbeat(self, msg: M.Message) -> None:
+        with self._rlock:
+            self._last_beat[msg.sender_id] = time.monotonic()
+            self._suspect.discard(msg.sender_id)
+
+    def _accept_update(self, msg: M.Message) -> bool:
+        """Round-tag + duplicate gate (call under ``_rlock``): True iff
+        this upload belongs to the current round and is the sender's
+        first. Stale rounds and re-delivered frames never double-count."""
+        r = msg.get(M.ARG_ROUND_IDX)
+        if r is not None and int(r) != self.round_idx:
+            log.warning("server: dropping stale upload from %d "
+                        "(round %s, current %d)", msg.sender_id, r,
+                        self.round_idx)
+            return False
+        if msg.sender_id in self._updates:
+            log.warning("server: dropping duplicate upload from %d "
+                        "(round %d)", msg.sender_id, self.round_idx)
+            return False
+        return True
 
     def _on_model(self, msg: M.Message) -> None:
-        self._updates[msg.sender_id] = (
-            msg.get(M.ARG_MODEL_PARAMS), float(msg.get(M.ARG_NUM_SAMPLES)))
-        if len(self._updates) < self.num_clients:
-            return
-        # weighted FedAvg (fedavg_api.py:102-117)
-        trees, ws = zip(*self._updates.values())
-        w = np.asarray(ws, np.float64)
-        w = w / w.sum()
-        self.params = jax.tree.map(
-            lambda *leaves: sum(
-                wi * np.asarray(leaf, np.float32)
-                for wi, leaf in zip(w, leaves)).astype(
-                    np.asarray(leaves[0]).dtype),
-            *trees)
-        self._updates.clear()
-        self._complete_round(int(len(ws)))
+        with self._rlock:
+            if self._done.is_set() or not self._accept_update(msg):
+                return
+            self._updates[msg.sender_id] = (
+                msg.get(M.ARG_MODEL_PARAMS),
+                float(msg.get(M.ARG_NUM_SAMPLES)))
+            self._last_beat[msg.sender_id] = time.monotonic()
+            self._suspect.discard(msg.sender_id)
+            self._maybe_complete()
 
-    def _complete_round(self, n_clients: int) -> None:
+    def _maybe_complete(self) -> None:
+        """Under ``_rlock``: aggregate as soon as every non-suspect
+        client has reported (and the quorum floor holds) — suspects are
+        picked up by the deadline path if they resurface."""
+        expected = set(range(1, self.num_clients + 1)) - self._suspect
+        have = set(self._updates)
+        if not have or not expected <= have or len(have) < min(
+                self.quorum, self.num_clients):
+            return
+        self._aggregate_and_advance()
+
+    def _aggregate_and_advance(self) -> None:
+        """Under ``_rlock``: weighted FedAvg over whoever reported
+        (fedavg_api.py:102-117 semantics, jitted engine aggregation)."""
+        if self._timer is not None:
+            self._timer.cancel()
+        senders = sorted(self._updates)
+        trees = [self._updates[s][0] for s in senders]
+        ws = [self._updates[s][1] for s in senders]
+        self.params = survivor_weighted_mean(trees, ws)
+        self._updates.clear()
+        self._complete_round(len(senders), survivors=senders)
+
+    # ---- deadline / heartbeat machinery ----
+
+    def _arm_deadline(self) -> None:
+        if self.round_deadline <= 0 or self._done.is_set():
+            return
+        if self._timer is not None:
+            self._timer.cancel()
+        self._deadline_gen += 1
+        self._timer = threading.Timer(
+            self.round_deadline, self._on_deadline,
+            args=(self.round_idx, self._deadline_gen))
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _deadline_stale(self, round_for: int, gen: int) -> bool:
+        """Under ``_rlock``: True iff this callback belongs to a window
+        that was superseded while the callback waited for the lock."""
+        return (self._done.is_set() or self.round_idx != round_for
+                or gen != self._deadline_gen)
+
+    def _mark_missing_suspect(self, have: set[int]) -> None:
+        """Under ``_rlock``: clients that missed the deadline become
+        suspect — unless their heartbeat is still fresh (a straggler,
+        not a corpse; it may catch up next round)."""
+        for c in set(range(1, self.num_clients + 1)) - have:
+            if self._beat_stale(c):
+                log.warning("server: marking client %d suspect "
+                            "(missed round %d deadline)", c, self.round_idx)
+                self._suspect.add(c)
+
+    def _beat_stale(self, c: int) -> bool:
+        if self.heartbeat_timeout <= 0:
+            return True  # no liveness signal configured: missing == dead
+        last = self._last_beat.get(c)
+        return last is None or (time.monotonic() - last
+                                > self.heartbeat_timeout)
+
+    def _on_deadline(self, round_for: int, gen: int) -> None:
+        with self._rlock:
+            if self._deadline_stale(round_for, gen):
+                return
+            if self._updates and len(self._updates) >= min(
+                    self.quorum, self.num_clients):
+                self._mark_missing_suspect(set(self._updates))
+                log.warning("server: round %d deadline - aggregating %d/%d "
+                            "survivors", round_for, len(self._updates),
+                            self.num_clients)
+                self._aggregate_and_advance()
+            else:
+                self._arm_deadline()  # below quorum: keep waiting
+
+    def _monitor_loop(self) -> None:
+        poll = max(0.05, self.heartbeat_timeout / 4)
+        while not self._done.wait(poll):
+            now = time.monotonic()
+            with self._rlock:
+                if self._done.is_set():
+                    return
+                for c, last in list(self._last_beat.items()):
+                    if (now - last > self.heartbeat_timeout
+                            and c not in self._suspect):
+                        log.warning("server: heartbeat from client %d "
+                                    "stale (%.2fs) - marking suspect",
+                                    c, now - last)
+                        self._suspect.add(c)
+                if self._started:
+                    # a new suspect may have been the only missing
+                    # uploader — the round can complete right now
+                    self._maybe_complete()
+
+    def _complete_round(self, n_clients: int,
+                        survivors: list[int] | None = None) -> None:
         """Shared end-of-round transition: record history, advance, then
         either finish the federation or broadcast the next sync."""
-        self.history.append({"round": self.round_idx,
-                             "clients": n_clients})
+        entry = {"round": self.round_idx, "clients": n_clients}
+        if survivors is not None:
+            entry["survivors"] = list(survivors)
+        if self._suspect:
+            entry["suspects"] = sorted(self._suspect)
+        self.history.append(entry)
         self.round_idx += 1
         if self.round_idx >= self.comm_round:
+            if self._timer is not None:
+                self._timer.cancel()
             self._broadcast_finish()
             self._done.set()
             self.finish()
@@ -119,17 +321,47 @@ class FedAvgServer(ServerManager):
 
     # ---- sends ----
 
+    def _send_tolerant(self, msg: M.Message) -> None:
+        """In fault-tolerant mode a broadcast target may be dead — use a
+        short retry budget and fold failures into suspicion instead of
+        crashing the dispatch/timer thread. Legacy mode keeps the strict
+        raise-on-unreachable contract.
+
+        NOTE: these sends run under ``_rlock`` (the callers are round
+        transitions). A dead same-host peer refuses instantly, so the
+        lock hold is sub-second; a WAN peer whose packets are BLACKHOLED
+        (no RST) can pin the lock for up to retries x the 10 s connect
+        timeout — an accepted tradeoff until broadcasts move to a
+        dedicated sender thread."""
+        if not self.fault_tolerant:
+            self.send_message(msg)
+            return
+        try:
+            try:
+                self.com_manager.send_message(msg, retries=3,
+                                              retry_delay=0.05)
+            except TypeError:  # transport without retry knobs (broker)
+                self.com_manager.send_message(msg)
+        except (ConnectionError, OSError) as e:
+            log.warning("server: client %d unreachable (%s) - marking "
+                        "suspect", msg.receiver_id, e)
+            self._suspect.add(msg.receiver_id)
+
+    def _send_sync_to(self, msg_type: str, c: int) -> None:
+        msg = M.Message(msg_type, 0, c)
+        msg.add(M.ARG_MODEL_PARAMS, self.params)
+        msg.add(M.ARG_ROUND_IDX, self.round_idx)
+        msg.add(M.ARG_CLIENT_INDEX, c - 1)
+        self._send_tolerant(msg)
+
     def _broadcast_sync(self, msg_type: str) -> None:
         for c in range(1, self.num_clients + 1):
-            msg = M.Message(msg_type, 0, c)
-            msg.add(M.ARG_MODEL_PARAMS, self.params)
-            msg.add(M.ARG_ROUND_IDX, self.round_idx)
-            msg.add(M.ARG_CLIENT_INDEX, c - 1)
-            self.send_message(msg)
+            self._send_sync_to(msg_type, c)
+        self._arm_deadline()
 
     def _broadcast_finish(self) -> None:
         for c in range(1, self.num_clients + 1):
-            self.send_message(M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
+            self._send_tolerant(M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
 
 
 class SecureFedAvgServer(FedAvgServer):
@@ -143,9 +375,12 @@ class SecureFedAvgServer(FedAvgServer):
     then share ``quantize(w_c * params)`` — with w_c <= 1 the field values
     stay within the fixed-point range regardless of cohort size. The
     server folds each arriving share set into per-slot accumulators
-    (slot-major, mod p) and combines slots only once ALL clients have
-    reported — so no stored server-side intermediate equals an individual
-    client's update.
+    (slot-major, mod p) and combines slots only once every weighted
+    client has reported — or once the deadline+quorum path truncates the
+    cohort, in which case the dropped clients' shares were never folded
+    (atomic discard) and the dequantized sum is re-weighted over the
+    survivors. Either way no stored server-side intermediate equals an
+    individual client's update.
 
     Trust model: with ``n_aggregators == 0`` (the paper's single-
     aggregator degenerate case) each client's n_shares slots transit THIS
@@ -167,8 +402,19 @@ class SecureFedAvgServer(FedAvgServer):
         self.n_aggregators = n_aggregators
         self._slot_acc: dict | None = None
         self._n_by_client: dict[int, float] = {}
-        self._n_clients_in = 0
         self._slot_totals: dict[int, dict] = {}
+        #: phase within the round: "A" collecting sample counts, "B"
+        #: collecting share uploads (deadline behavior differs per phase)
+        self._phase = "A"
+        #: normalized weight sent to each phase-A reporter this round —
+        #: kept so a phase-B dropout can be re-weighted post-dequantize
+        self._weights_sent: dict[int, float] = {}
+        #: clients whose complete share set was folded this round; a
+        #: client is in the aggregate iff it is here — shares from a
+        #: dropped client are discarded atomically (its single upload
+        #: message either folds whole or, when stale/duplicate, not at
+        #: all — there is no partial slot fold)
+        self._folded: set[int] = set()
         #: when record_trace, every aggregator total this server saw —
         #: model-sized per round, so tests-only
         self.record_trace = record_trace
@@ -184,21 +430,73 @@ class SecureFedAvgServer(FedAvgServer):
     # ---- phase A: sample counts -> normalized weights ----
 
     def _on_num_samples(self, msg: M.Message) -> None:
-        self._n_by_client[msg.sender_id] = float(
-            msg.get(M.ARG_NUM_SAMPLES))
-        if len(self._n_by_client) < self.num_clients:
-            return
+        with self._rlock:
+            r = msg.get(M.ARG_ROUND_IDX)
+            if ((r is not None and int(r) != self.round_idx)
+                    or self._phase != "A"
+                    or msg.sender_id in self._n_by_client):
+                log.warning("server: dropping stale/duplicate sample "
+                            "count from %d", msg.sender_id)
+                return
+            self._n_by_client[msg.sender_id] = float(
+                msg.get(M.ARG_NUM_SAMPLES))
+            self._last_beat[msg.sender_id] = time.monotonic()
+            self._suspect.discard(msg.sender_id)
+            self._maybe_complete()
+
+    def _send_agg_weights(self) -> None:
+        """Under ``_rlock``: close phase A — normalize weights over the
+        reporters and open phase B with a fresh deadline window."""
         total = max(sum(self._n_by_client.values()), 1e-12)
-        for c, n in self._n_by_client.items():
+        self._weights_sent = {c: n / total
+                              for c, n in self._n_by_client.items()}
+        for c, w in self._weights_sent.items():
             out = M.Message(M.MSG_TYPE_S2C_AGG_WEIGHTS, 0, c)
-            out.add(M.ARG_AGG_WEIGHT, n / total)
+            out.add(M.ARG_AGG_WEIGHT, w)
             out.add(M.ARG_ROUND_IDX, self.round_idx)
-            self.send_message(out)
+            self._send_tolerant(out)
         self._n_by_client.clear()
+        self._phase = "B"
+        self._arm_deadline()
 
     # ---- phase B: slot-major share accumulation ----
 
     def _on_model(self, msg: M.Message) -> None:
+        with self._rlock:
+            if self._done.is_set():
+                return
+            r = msg.get(M.ARG_ROUND_IDX)
+            if (self._phase != "B"
+                    or (r is not None and int(r) != self.round_idx)
+                    or msg.sender_id in self._folded
+                    or msg.sender_id not in self._weights_sent):
+                log.warning("server: dropping stale/duplicate/unweighted "
+                            "share upload from %d (round %s, current %d)",
+                            msg.sender_id, r, self.round_idx)
+                return
+            self._fold_shares(msg)
+            self._last_beat[msg.sender_id] = time.monotonic()
+            self._suspect.discard(msg.sender_id)
+            self._maybe_complete()
+
+    def _maybe_complete(self) -> None:
+        """Under ``_rlock``: phase-aware early completion — advance as
+        soon as every non-suspect expected peer has reported (quorum
+        floor still holds). Called from the upload handlers and from the
+        heartbeat monitor when suspicion changes."""
+        floor = min(self.quorum, self.num_clients)
+        if self._phase == "A":
+            expected = set(range(1, self.num_clients + 1)) - self._suspect
+            have = set(self._n_by_client)
+            if have and expected <= have and len(have) >= floor:
+                self._send_agg_weights()
+        else:
+            expected = set(self._weights_sent) - self._suspect
+            if (self._folded and expected <= self._folded
+                    and len(self._folded) >= floor):
+                self._finalize_secure()
+
+    def _fold_shares(self, msg: M.Message) -> None:
         from neuroimagedisttraining_tpu.ops import mpc
 
         shares_tree = msg.get(M.ARG_MODEL_PARAMS)  # leaves: [n_shares, ...]
@@ -210,40 +508,92 @@ class SecureFedAvgServer(FedAvgServer):
             self._slot_acc = jax.tree.map(
                 lambda acc, s: (acc + np.asarray(s, np.int64))
                 % mpc.P_DEFAULT, self._slot_acc, shares_tree)
-        self._n_clients_in += 1
-        if self._n_clients_in < self.num_clients:
-            return
-        # weights already sum to 1 client-side, so the slot total IS the
-        # weighted mean
+        self._folded.add(msg.sender_id)
+
+    def _finalize_secure(self) -> None:
+        """Under ``_rlock``: combine slots and dequantize. When every
+        phase-A reporter folded, the slot total IS the weighted mean
+        (weights sum to 1 client-side). When a reporter dropped between
+        phases, the survivors' weights sum to W < 1 — re-weight by 1/W
+        post-dequantize so the aggregate stays a true weighted mean over
+        the survivor set (Bonawitz-style dropout tolerance)."""
+        from neuroimagedisttraining_tpu.ops import mpc
+
+        if self._timer is not None:
+            self._timer.cancel()
+        w_sum = sum(self._weights_sent.get(c, 0.0) for c in self._folded)
+        rescale = (1.0 / w_sum
+                   if self._folded != set(self._weights_sent) and w_sum > 0
+                   else 1.0)
         self.params = jax.tree.map(
-            lambda slots, old: mpc.dequantize(
+            lambda slots, old: (rescale * mpc.dequantize(
                 np.mod(slots.sum(axis=0), mpc.P_DEFAULT),
-                frac_bits=self.frac_bits).astype(np.asarray(old).dtype),
+                frac_bits=self.frac_bits)).astype(np.asarray(old).dtype),
             self._slot_acc, self.params)
         self._slot_acc = None
-        n_in, self._n_clients_in = self._n_clients_in, 0
-        self._complete_round(n_in)
+        survivors = sorted(self._folded)
+        self._folded = set()
+        self._weights_sent = {}
+        self._phase = "A"
+        self._complete_round(len(survivors), survivors=survivors)
+
+    def _on_deadline(self, round_for: int, gen: int) -> None:
+        with self._rlock:
+            if self._deadline_stale(round_for, gen):
+                return
+            floor = min(self.quorum, self.num_clients)
+            if self._phase == "A":
+                if self._n_by_client and len(self._n_by_client) >= floor:
+                    self._mark_missing_suspect(set(self._n_by_client))
+                    log.warning("server: round %d phase-A deadline - "
+                                "weighting %d/%d reporters", round_for,
+                                len(self._n_by_client), self.num_clients)
+                    self._send_agg_weights()
+                else:
+                    self._arm_deadline()
+            else:
+                if self._folded and len(self._folded) >= floor:
+                    self._mark_missing_suspect(set(self._folded))
+                    log.warning("server: round %d phase-B deadline - "
+                                "aggregating %d/%d survivors", round_for,
+                                len(self._folded), self.num_clients)
+                    self._finalize_secure()
+                else:
+                    self._arm_deadline()
 
     # ---- phase B': aggregator slot totals (n_aggregators > 0) ----
+    # NOTE: the grouped deployment needs ALL K slot totals to
+    # reconstruct (one missing slot destroys the additive sharing), so
+    # deadline/quorum applies to the degenerate single-server mode only;
+    # with aggregators a dropped client stalls the aggregators' fold —
+    # a documented limitation, not silently wrong math.
 
     def _on_slot_total(self, msg: M.Message) -> None:
         from neuroimagedisttraining_tpu.ops import mpc
 
-        total = msg.get(M.ARG_MODEL_PARAMS)
-        if self.record_trace:
-            self.received_totals.append(total)
-        self._slot_totals[int(msg.get(M.ARG_SLOT_INDEX))] = total
-        if len(self._slot_totals) < self.n_aggregators:
-            return
-        totals = [self._slot_totals[j] for j in sorted(self._slot_totals)]
-        self.params = jax.tree.map(
-            lambda old, *slots: mpc.dequantize(
-                np.mod(sum(np.asarray(s, np.int64) for s in slots),
-                       mpc.P_DEFAULT),
-                frac_bits=self.frac_bits).astype(np.asarray(old).dtype),
-            self.params, *totals)
-        self._slot_totals.clear()
-        self._complete_round(self.num_clients)
+        with self._rlock:
+            total = msg.get(M.ARG_MODEL_PARAMS)
+            if self.record_trace:
+                self.received_totals.append(total)
+            self._slot_totals[int(msg.get(M.ARG_SLOT_INDEX))] = total
+            if len(self._slot_totals) < self.n_aggregators:
+                return
+            totals = [self._slot_totals[j]
+                      for j in sorted(self._slot_totals)]
+            self.params = jax.tree.map(
+                lambda old, *slots: mpc.dequantize(
+                    np.mod(sum(np.asarray(s, np.int64) for s in slots),
+                           mpc.P_DEFAULT),
+                    frac_bits=self.frac_bits).astype(
+                        np.asarray(old).dtype),
+                self.params, *totals)
+            self._slot_totals.clear()
+            # close the round's phase state so the next round's sample
+            # counts pass the phase-A gate
+            self._weights_sent = {}
+            self._folded = set()
+            self._phase = "A"
+            self._complete_round(self.num_clients)
 
     def _broadcast_finish(self) -> None:
         super()._broadcast_finish()
@@ -307,15 +657,23 @@ class SlotAggregatorProc(ClientManager):
 
 
 class FedAvgClientProc(ClientManager):
-    """Rank >= 1. Trains via the injected ``train_fn`` on every sync."""
+    """Rank >= 1. Trains via the injected ``train_fn`` on every sync.
+
+    ``heartbeat_interval`` > 0 starts a liveness thread beating to the
+    server every interval — the signal the server's suspicion machinery
+    (``heartbeat_timeout``) consumes. Uploads echo the sync's round
+    index so the server can reject stale/duplicate frames."""
 
     def __init__(self, rank: int, num_clients: int,
-                 train_fn: Callable, world_size: int | None = None, **kw):
+                 train_fn: Callable, world_size: int | None = None,
+                 heartbeat_interval: float = 0.0, **kw):
         super().__init__(rank=rank, world_size=world_size or num_clients + 1,
                          **kw)
         self.num_clients = num_clients
         self.train_fn = train_fn
+        self.heartbeat_interval = float(heartbeat_interval)
         self.final_params = None
+        self._hb_stop = threading.Event()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -330,13 +688,30 @@ class FedAvgClientProc(ClientManager):
         reg = M.Message(M.MSG_TYPE_C2S_REGISTER, self.rank, 0)
         # the server process may still be initializing (model build + jit
         # compile) when this silo is ready — give the FIRST contact a
-        # generous retry window on transports that support it
+        # generous retry window on transports that support it (capped
+        # exponential backoff: ~0.25s ramping to 2s, ~5 min total)
         try:
-            self.com_manager.send_message(reg, retries=1200,
+            self.com_manager.send_message(reg, retries=150,
                                           retry_delay=0.25)
         except TypeError:  # transport without retry knobs (e.g. broker)
             self.com_manager.send_message(reg)
+        if self.heartbeat_interval > 0:
+            threading.Thread(target=self._heartbeat_loop,
+                             daemon=True).start()
         self.com_manager.handle_receive_message()
+        self._hb_stop.set()  # loop exited (finish or simulated crash)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            beat = M.Message(M.MSG_TYPE_C2S_HEARTBEAT, self.rank, 0)
+            try:
+                try:
+                    self.com_manager.send_message(beat, retries=1)
+                except TypeError:
+                    self.com_manager.send_message(beat)
+            except Exception:  # noqa: BLE001 — liveness is best-effort;
+                # a missed beat (server busy/gone) must not kill the loop
+                pass
 
     def _on_sync(self, msg: M.Message) -> None:
         params = msg.get(M.ARG_MODEL_PARAMS)
@@ -345,10 +720,12 @@ class FedAvgClientProc(ClientManager):
         out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
         out.add(M.ARG_MODEL_PARAMS, _to_numpy_tree(new_params))
         out.add(M.ARG_NUM_SAMPLES, float(n))
+        out.add(M.ARG_ROUND_IDX, round_idx)
         self.send_message(out)
 
     def _on_finish(self, msg: M.Message) -> None:
         self.final_params = None  # server holds the aggregate
+        self._hb_stop.set()
         self.finish()
 
 
@@ -386,11 +763,13 @@ class SecureFedAvgClientProc(FedAvgClientProc):
         self._trained = _to_numpy_tree(new_params)
         out = M.Message(M.MSG_TYPE_C2S_NUM_SAMPLES, self.rank, 0)
         out.add(M.ARG_NUM_SAMPLES, float(n))
+        out.add(M.ARG_ROUND_IDX, round_idx)
         self.send_message(out)
 
     def _on_weights(self, msg: M.Message) -> None:
         from neuroimagedisttraining_tpu.ops import mpc
 
+        round_idx = msg.get(M.ARG_ROUND_IDX)
         w = float(msg.get(M.ARG_AGG_WEIGHT))
         shares_tree = jax.tree.map(
             lambda x: mpc.additive_shares(
@@ -408,8 +787,12 @@ class SecureFedAvgClientProc(FedAvgClientProc):
                 out.add(M.ARG_MODEL_PARAMS,
                         jax.tree.map(lambda s: s[j], shares_tree))
                 out.add(M.ARG_SLOT_INDEX, j)
+                if round_idx is not None:
+                    out.add(M.ARG_ROUND_IDX, int(round_idx))
                 self.send_message(out)
             return
         out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
         out.add(M.ARG_MODEL_PARAMS, shares_tree)
+        if round_idx is not None:
+            out.add(M.ARG_ROUND_IDX, int(round_idx))
         self.send_message(out)

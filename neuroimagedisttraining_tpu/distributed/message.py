@@ -28,6 +28,9 @@ MSG_TYPE_S2C_INIT_CONFIG = "server_init_config"
 MSG_TYPE_S2C_SYNC_MODEL = "server_sync_model"
 MSG_TYPE_C2S_SEND_MODEL = "client_send_model"
 MSG_TYPE_S2C_FINISH = "server_finish"
+# liveness signal (cross_silo heartbeat monitor): clients beat on an
+# interval; the server marks silent clients suspect within a bound
+MSG_TYPE_C2S_HEARTBEAT = "client_heartbeat"
 # secure-aggregation weight exchange (cross_silo.SecureFedAvgServer)
 MSG_TYPE_C2S_NUM_SAMPLES = "client_num_samples"
 MSG_TYPE_S2C_AGG_WEIGHTS = "server_agg_weights"

@@ -32,7 +32,17 @@ Each client trains its own site shard with the real jitted LocalTrainer
 (silo k holds site ``(k-1) mod num_sites``); the server runs the
 register -> broadcast -> train -> upload -> aggregate -> finish protocol
 (cross_silo.py) and prints one JSON line with the final round count and
-aggregate param norm. This is the cross-silo deployment shape: bulk
+aggregate param norm.
+
+Fault tolerance (ISSUE 2): ``--transport broker`` swaps the socket plane
+for the pub/sub broker (hosted by the server process);
+``--fault_spec "crash:3@1,drop:0.1,..."`` wraps each client's transport
+in the seeded FaultyCommManager (faults/) so chaos replays bit-identically
+from ``--seed``; ``--round_deadline``/``--quorum`` let the server
+aggregate survivor subsets instead of hanging on a dead silo, and
+``--heartbeat_interval``/``--heartbeat_timeout`` drive the suspicion
+machinery. ``scripts/run_chaos_smoke.sh`` exercises the kill-k scenario
+end-to-end on both transports. This is the cross-silo deployment shape: bulk
 per-silo compute on each silo's own accelerator(s), small model payloads
 on the control plane (on a TPU pod, prefer --multihost_coordinator on
 the main CLI so bulk tensors ride ICI/DCN collectives instead).
@@ -125,6 +135,60 @@ def _make_train_fn(args):
     return train_fn
 
 
+def _make_comm(args, rank: int, host_map):
+    """Build the rank's transport per ``--transport``; client ranks are
+    wrapped in ``FaultyCommManager`` when ``--fault_spec`` is given (the
+    transports' own code is untouched). Returns ``(comm, broker)`` —
+    ``comm`` may be None (socket, no faults: the manager builds its
+    default), ``broker`` is the in-process daemon on the server rank."""
+    import time
+
+    comm = None
+    broker = None
+    world_size = args.num_clients + 1 + args.n_aggregators
+    if args.transport == "broker":
+        from neuroimagedisttraining_tpu.distributed.broker import (
+            BrokerCommManager, MessageBroker,
+        )
+
+        port = args.broker_port or args.base_port
+        if rank == 0:
+            broker = MessageBroker(host="0.0.0.0", port=port)
+            comm = BrokerCommManager("127.0.0.1", broker.port, client_id=0,
+                                     client_num=args.num_clients)
+        else:
+            host = (host_map or {}).get(0, "127.0.0.1")
+            # the server process hosts the broker daemon — back off while
+            # it boots (model build + jit compile precede the broker)
+            delay, deadline = 0.25, time.monotonic() + 300
+            while True:
+                try:
+                    comm = BrokerCommManager(host, port, client_id=rank,
+                                             client_num=args.num_clients)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(delay)
+                    delay = min(2.0, delay * 2)
+    elif args.fault_spec and rank != 0:
+        from neuroimagedisttraining_tpu.distributed.comm import (
+            SocketCommManager,
+        )
+
+        comm = SocketCommManager(rank, world_size, host_map=host_map,
+                                 base_port=args.base_port)
+    if args.fault_spec and rank != 0 and comm is not None:
+        from neuroimagedisttraining_tpu.faults import (
+            FaultSchedule, FaultyCommManager, parse_fault_spec,
+        )
+
+        comm = FaultyCommManager(
+            comm, FaultSchedule(parse_fault_spec(args.fault_spec),
+                                args.seed), rank)
+    return comm, broker
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="neuroimagedisttraining_tpu.distributed.run",
@@ -148,6 +212,37 @@ def main(argv=None) -> int:
     ap.add_argument("--base_port", type=int, default=29500)
     ap.add_argument("--hosts", type=str, default="",
                     help="rank=ip,... (default: all localhost)")
+    ap.add_argument("--transport", type=str, default="socket",
+                    choices=("socket", "broker"),
+                    help="control-plane transport: point-to-point TCP "
+                         "(every rank listens on base_port+rank) or the "
+                         "in-repo pub/sub broker (MQTT topic scheme; the "
+                         "server process hosts the broker daemon)")
+    ap.add_argument("--broker_port", type=int, default=0,
+                    help="broker transport: the broker daemon's port "
+                         "(0 = base_port); clients connect to rank 0's "
+                         "host at this port")
+    ap.add_argument("--fault_spec", type=str, default="",
+                    help="deterministic chaos schedule applied to client "
+                         "ranks via FaultyCommManager: 'crash:RANK@ROUND,"
+                         "crash_prob:P,straggle:P:MAX_S,drop:P,dup:P,"
+                         "disconnect:P' — replays identically from "
+                         "--seed on every rank")
+    ap.add_argument("--round_deadline", type=float, default=0.0,
+                    help="server: per-round deadline seconds; when it "
+                         "fires with >= --quorum uploads the round "
+                         "aggregates over the survivors (sample-count "
+                         "re-weighted) instead of hanging forever")
+    ap.add_argument("--quorum", type=int, default=0,
+                    help="min uploads for a deadline aggregation "
+                         "(0 = simple majority when --round_deadline is "
+                         "set, else all clients)")
+    ap.add_argument("--heartbeat_interval", type=float, default=0.0,
+                    help="clients: liveness beat period seconds "
+                         "(0 = no heartbeats)")
+    ap.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                    help="server: mark a client suspect once its "
+                         "heartbeat is older than this (0 = off)")
     ap.add_argument("--secure", action="store_true",
                     help="TurboAggregate additive-share aggregation over "
                          "the control plane")
@@ -187,6 +282,19 @@ def main(argv=None) -> int:
             ap.error(f"--n_aggregators ({args.n_aggregators}) must equal "
                      f"--mpc_n_shares ({args.mpc_n_shares}): slot j "
                      "routes to aggregator j")
+    if args.transport == "broker" and args.n_aggregators > 0:
+        ap.error("--transport broker routes messages through the MQTT "
+                 "topic scheme (server <-> client only); the grouped "
+                 "multi-aggregator deployment needs --transport socket")
+    if args.round_deadline > 0 and args.quorum == 0:
+        args.quorum = args.num_clients // 2 + 1  # simple majority
+    if args.heartbeat_timeout > 0 and not (
+            0 < args.heartbeat_interval < args.heartbeat_timeout):
+        # beats slower than the timeout would mark every HEALTHY client
+        # suspect mid-round and silently truncate aggregates
+        ap.error("--heartbeat_timeout requires 0 < --heartbeat_interval "
+                 f"< timeout (got interval={args.heartbeat_interval}, "
+                 f"timeout={args.heartbeat_timeout})")
     host_map = _parse_hosts(args.hosts)
     if args.force_cpu:
         from neuroimagedisttraining_tpu.parallel.mesh import (
@@ -240,17 +348,26 @@ def main(argv=None) -> int:
         cls = SecureFedAvgServer if args.secure else FedAvgServer
         kw = ({"frac_bits": args.mpc_frac_bits,
                "n_aggregators": args.n_aggregators} if args.secure else {})
+        comm, broker = _make_comm(args, 0, host_map)
         server = cls(init, args.comm_round, args.num_clients,
-                     base_port=args.base_port, host_map=host_map, **kw)
-        print(f"[server] listening on port {args.base_port}; waiting for "
+                     base_port=args.base_port, host_map=host_map,
+                     comm=comm, round_deadline=args.round_deadline,
+                     quorum=args.quorum,
+                     heartbeat_timeout=args.heartbeat_timeout, **kw)
+        print(f"[server] {args.transport} control plane on port "
+              f"{args.broker_port or args.base_port}; waiting for "
               f"{args.num_clients} silos", flush=True)
         server.run()
+        if broker is not None:
+            broker.stop()
         norm = float(np.sqrt(sum(
             float(np.sum(np.asarray(v, np.float64) ** 2))
             for v in jax.tree.leaves(server.params))))
         print(json.dumps({"rounds_completed": len(server.history),
                           "clients": args.num_clients,
                           "secure": bool(args.secure),
+                          "transport": args.transport,
+                          "suspects": sorted(server.suspect_clients()),
                           "final_param_norm": round(norm, 6)}), flush=True)
         return 0
 
@@ -259,8 +376,10 @@ def main(argv=None) -> int:
     kw = ({"n_shares": args.mpc_n_shares, "frac_bits": args.mpc_frac_bits,
            "mpc_seed": args.seed,
            "n_aggregators": args.n_aggregators} if args.secure else {})
+    comm, _ = _make_comm(args, args.rank, host_map)
     client = cls(args.rank, args.num_clients, train_fn,
-                 base_port=args.base_port, host_map=host_map, **kw)
+                 base_port=args.base_port, host_map=host_map, comm=comm,
+                 heartbeat_interval=args.heartbeat_interval, **kw)
     print(f"[silo {args.rank}] joining server", flush=True)
     client.run()
     return 0

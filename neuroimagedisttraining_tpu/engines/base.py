@@ -24,6 +24,9 @@ from neuroimagedisttraining_tpu.core.losses import binary_auc
 from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer
 from neuroimagedisttraining_tpu.core.optim import round_lr
 from neuroimagedisttraining_tpu.data.federate import FederatedData
+from neuroimagedisttraining_tpu.faults.schedule import (
+    FaultSchedule, parse_fault_spec,
+)
 from neuroimagedisttraining_tpu.utils import checkpoint as ckpt
 from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger, get_logger
 from neuroimagedisttraining_tpu.utils import pytree as pt
@@ -68,6 +71,15 @@ class FederatedEngine:
         else:
             raise ValueError("need fed_data or stream")
         self.real_clients = int(np.sum(self._n_train_host > 0))
+        # deterministic fault injection (faults/): the SAME seeded
+        # schedule that drives the multiprocess federation filters the
+        # simulated round's cohort, so one config seed replays one fault
+        # trace in both worlds (engine client index c == rank c + 1)
+        spec = (parse_fault_spec(cfg.fed.fault_spec)
+                if cfg.fed.fault_spec else None)
+        self.fault_schedule = (FaultSchedule(spec, cfg.seed)
+                               if spec is not None and spec.any_faults
+                               else None)
         self.stat_info: dict[str, Any] = {
             "sum_comm_params": 0.0, "sum_training_flops": 0.0,
             "global_test_acc": [], "person_test_acc": [],
@@ -110,13 +122,20 @@ class FederatedEngine:
         total = self.real_clients
         per_round = min(self.cfg.fed.client_num_per_round, total)
         if total == per_round:
-            return np.arange(total)
-        # nidt: allow[determinism-global-random] -- reference-parity
-        # sampling shim: MUST replay the legacy global stream
-        # (fedavg_api.py:92-100) to keep client cohorts bit-identical
-        np.random.seed(round_idx)  # nidt: allow[determinism-global-random] -- reference-parity shim (fedavg_api.py:92-100)
-        return np.sort(np.random.choice(range(total), per_round,  # nidt: allow[determinism-global-random] -- reference-parity shim (fedavg_api.py:92-100)
-                                        replace=False))
+            sampled = np.arange(total)
+        else:
+            # nidt: allow[determinism-global-random] -- reference-parity
+            # sampling shim: MUST replay the legacy global stream
+            # (fedavg_api.py:92-100) to keep client cohorts bit-identical
+            np.random.seed(round_idx)  # nidt: allow[determinism-global-random] -- reference-parity shim (fedavg_api.py:92-100)
+            sampled = np.sort(np.random.choice(range(total), per_round,  # nidt: allow[determinism-global-random] -- reference-parity shim (fedavg_api.py:92-100)
+                                               replace=False))
+        if self.fault_schedule is not None:
+            # crashed clients drop out of the cohort; the weighted
+            # aggregation over the survivor set re-weights by sample
+            # count exactly as a frac-sampled round would
+            sampled = self.fault_schedule.survivors(round_idx, sampled)
+        return sampled
 
     def stream_sampling(self, round_idx: int,
                         sampled: np.ndarray | None = None

@@ -111,9 +111,19 @@ class DisPFLEngine(FederatedEngine):
     def active_draw(self, round_idx: int) -> np.ndarray:
         """Bernoulli(active) per client (dispfl_api.py:96). Deviation: we
         seed by round for reproducibility; the reference draws from global
-        unseeded np.random state."""
-        rng = np.random.default_rng(self.cfg.seed * 100003 + round_idx)
-        a = (rng.random(self.real_clients) < self.cfg.fed.active)
+        unseeded np.random state. The draw now lives in
+        ``faults.schedule.activity_mask`` (bit-identical stream) so the
+        engine and the cross-silo fault schedule share one seed; a
+        ``--fault_spec`` additionally forces crashed clients inactive."""
+        from neuroimagedisttraining_tpu.faults.schedule import activity_mask
+
+        if self.fault_schedule is not None:
+            a = self.fault_schedule.active_mask(round_idx,
+                                                self.real_clients,
+                                                self.cfg.fed.active)
+        else:
+            a = activity_mask(self.cfg.seed, round_idx,
+                              self.real_clients, self.cfg.fed.active)
         out = np.zeros(self.num_clients, bool)
         out[: self.real_clients] = a
         return out

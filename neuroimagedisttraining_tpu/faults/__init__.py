@@ -1,0 +1,34 @@
+"""Deterministic fault injection + the fault-tolerant control-plane pieces.
+
+Two halves (ISSUE 2):
+
+- ``schedule``: a seeded :class:`FaultSchedule` — a pure function of
+  ``(seed, round, rank)`` describing client crashes, straggler delays,
+  message drops/duplicates and mid-frame disconnects. The same schedule
+  drives the simulated engines (``engines/base.py`` survivor sampling,
+  DisPFL's activity draw) and the multiprocess federation, so one config
+  seed replays an identical fault trace everywhere.
+- ``chaos``: :class:`FaultyCommManager`, a wrapper applying the schedule
+  to any ``BaseCommManager`` (socket or broker transport) without
+  touching transport code.
+
+The tolerance the chaos forces (deadline + quorum aggregation, heartbeat
+suspicion, rejoin, stale/duplicate rejection) lives in
+``distributed/cross_silo.py``; this package only *produces* failures.
+"""
+
+from neuroimagedisttraining_tpu.faults.schedule import (
+    FaultSchedule,
+    FaultSpec,
+    activity_mask,
+    parse_fault_spec,
+)
+from neuroimagedisttraining_tpu.faults.chaos import FaultyCommManager
+
+__all__ = [
+    "FaultSchedule",
+    "FaultSpec",
+    "FaultyCommManager",
+    "activity_mask",
+    "parse_fault_spec",
+]

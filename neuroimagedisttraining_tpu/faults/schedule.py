@@ -1,0 +1,229 @@
+"""Seeded fault schedule: a pure function of ``(seed, round, rank)``.
+
+Every event decision derives from ``np.random.default_rng(...)`` seeded
+with the full event coordinates ``(seed, stream, round, rank[, seq])`` —
+no global numpy stream, no OS entropy (nidtlint determinism rules), so
+the entire fault trace replays bit-identically from the config seed in
+any process, in any order of queries.
+
+Ranks use the cross-silo numbering: rank 0 is the server, clients are
+ranks ``1..num_clients``. The simulated engines map client index ``c``
+to rank ``c + 1`` (``FederatedEngine.client_sampling`` survivor
+filtering), so one ``--fault_spec`` drives both the in-process
+simulation and the multiprocess federation.
+
+``activity_mask`` is DisPFL's Bernoulli activity draw (dispfl_api.py:96,
+ours at engines/dispfl.py), lifted here so the engine and the schedule
+share one seeded stream — the unification ISSUE 2 requires.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import numpy as np
+
+# sub-stream tags: distinct event kinds never share an RNG stream
+_STREAM_CRASH = 1
+_STREAM_STRAGGLE = 2
+_STREAM_DROP = 3
+_STREAM_DUP = 4
+_STREAM_DISCONNECT = 5
+
+
+def activity_mask(seed: int, round_idx: int, n: int,
+                  active_prob: float) -> np.ndarray:
+    """DisPFL's per-round Bernoulli(active) draw, bit-identical to the
+    engine's historical inline formula (engines/dispfl.py active_draw):
+    one generator seeded ``seed * 100003 + round_idx``, one uniform per
+    client."""
+    rng = np.random.default_rng(seed * 100003 + round_idx)
+    return rng.random(n) < active_prob
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """What can go wrong. All probabilities are per-event Bernoulli
+    parameters; ``crashes`` adds deterministic (rank, round) kill points
+    on top of the probabilistic draw."""
+
+    crashes: tuple[tuple[int, int], ...] = ()  # (rank, round): dead from round on
+    crash_prob: float = 0.0        # per-(round, rank); crashes are permanent
+    straggle_prob: float = 0.0     # per-(round, rank)
+    straggle_delay: float = 0.0    # max seconds; actual ~ U(0, max)
+    drop_prob: float = 0.0         # per outbound protocol message
+    dup_prob: float = 0.0          # per outbound protocol message
+    disconnect_prob: float = 0.0   # mid-frame disconnect per outbound message
+
+    @property
+    def any_faults(self) -> bool:
+        return bool(self.crashes) or any(
+            p > 0 for p in (self.crash_prob, self.straggle_prob,
+                            self.drop_prob, self.dup_prob,
+                            self.disconnect_prob))
+
+
+def parse_fault_spec(text: str) -> FaultSpec:
+    """Parse the ``--fault_spec`` mini-grammar: comma/semicolon-separated
+    directives::
+
+        crash:RANK@ROUND        deterministic kill of RANK at ROUND
+        crash_prob:P            per-(round, rank) Bernoulli crash
+        straggle:P:MAX_DELAY    with prob P delay sends by U(0, MAX_DELAY) s
+        drop:P                  drop outbound protocol messages with prob P
+        dup:P                   duplicate outbound messages with prob P
+        disconnect:P            tear the connection mid-frame with prob P
+
+    e.g. ``"crash:3@1,drop:0.1,straggle:0.5:0.2"``. Empty string => no
+    faults."""
+    crashes: list[tuple[int, int]] = []
+    kw: dict[str, float] = {}
+    for part in text.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, rest = part.partition(":")
+        key = key.strip()
+        try:
+            if key == "crash":
+                rank_s, _, round_s = rest.partition("@")
+                crashes.append((int(rank_s), int(round_s)))
+            elif key == "straggle":
+                p_s, _, d_s = rest.partition(":")
+                kw["straggle_prob"] = float(p_s)
+                kw["straggle_delay"] = float(d_s)
+            elif key == "crash_prob":
+                kw["crash_prob"] = float(rest)
+            elif key in ("drop", "dup", "disconnect"):
+                kw[f"{key}_prob"] = float(rest)
+            else:
+                raise ValueError(f"unknown fault directive {key!r}")
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"bad --fault_spec directive {part!r}: {e}") from None
+    for name, p in kw.items():
+        if name != "straggle_delay" and not 0.0 <= p <= 1.0:
+            raise ValueError(f"--fault_spec {name}={p} not in [0, 1]")
+    return FaultSpec(crashes=tuple(crashes), **kw)
+
+
+class FaultSchedule:
+    """The deterministic chaos oracle. Every query is a pure function of
+    ``(seed, round, rank[, msg stream, seq])`` — repeated queries and
+    fresh instances over the same spec+seed agree bit-for-bit."""
+
+    def __init__(self, spec: FaultSpec, seed: int):
+        self.spec = spec
+        self.seed = int(seed)
+        self._crash_at: dict[int, int] = {}
+        for rank, round_idx in spec.crashes:
+            prev = self._crash_at.get(rank)
+            self._crash_at[rank] = (round_idx if prev is None
+                                    else min(prev, round_idx))
+
+    # ---- per-(round, rank) event draws ----
+
+    def _draw(self, stream: int, round_idx: int, rank: int,
+              seq: int | None = None) -> np.random.Generator:
+        coords = [self.seed, stream, int(round_idx), int(rank)]
+        if seq is not None:
+            coords.append(int(seq))
+        return np.random.default_rng(coords)
+
+    def crashed(self, round_idx: int, rank: int) -> bool:
+        """True iff ``rank`` is dead at ``round_idx`` (crashes are
+        permanent until an explicit rejoin, which the schedule does not
+        model — the control plane's re-register path does)."""
+        at = self._crash_at.get(rank)
+        if at is not None and round_idx >= at:
+            return True
+        p = self.spec.crash_prob
+        if p > 0:
+            for r in range(int(round_idx) + 1):
+                if self._draw(_STREAM_CRASH, r, rank).random() < p:
+                    return True
+        return False
+
+    def crash_round(self, rank: int, horizon: int) -> int | None:
+        """First round < horizon at which ``rank`` is dead, or None."""
+        for r in range(horizon):
+            if self.crashed(r, rank):
+                return r
+        return None
+
+    def straggle_seconds(self, round_idx: int, rank: int) -> float:
+        if self.spec.straggle_prob <= 0 or self.spec.straggle_delay <= 0:
+            return 0.0
+        rng = self._draw(_STREAM_STRAGGLE, round_idx, rank)
+        if rng.random() >= self.spec.straggle_prob:
+            return 0.0
+        return float(rng.random() * self.spec.straggle_delay)
+
+    # ---- per-message draws (seq = per-(round, msg-type) send index) ----
+
+    def drop(self, round_idx: int, rank: int, seq: int) -> bool:
+        return (self.spec.drop_prob > 0 and
+                self._draw(_STREAM_DROP, round_idx, rank, seq).random()
+                < self.spec.drop_prob)
+
+    def duplicate(self, round_idx: int, rank: int, seq: int) -> bool:
+        return (self.spec.dup_prob > 0 and
+                self._draw(_STREAM_DUP, round_idx, rank, seq).random()
+                < self.spec.dup_prob)
+
+    def disconnect(self, round_idx: int, rank: int, seq: int) -> bool:
+        return (self.spec.disconnect_prob > 0 and
+                self._draw(_STREAM_DISCONNECT, round_idx, rank,
+                           seq).random() < self.spec.disconnect_prob)
+
+    # ---- federation-level views ----
+
+    def survivors(self, round_idx: int, client_indices: np.ndarray
+                  ) -> np.ndarray:
+        """Filter 0-based engine client indices (rank = index + 1) down
+        to those alive at ``round_idx``. If the schedule would kill every
+        sampled client the original set is returned unchanged — an empty
+        round has no reference semantics and would poison the aggregate
+        with a 0/0."""
+        alive = np.asarray([not self.crashed(round_idx, int(c) + 1)
+                            for c in np.asarray(client_indices)], bool)
+        if not alive.any():
+            return np.asarray(client_indices)
+        return np.asarray(client_indices)[alive]
+
+    def active_mask(self, round_idx: int, n_clients: int,
+                    active_prob: float = 1.0) -> np.ndarray:
+        """DisPFL-style activity combined with crashes: a client is
+        active iff its Bernoulli(active) draw succeeds AND it has not
+        crashed. With no crash directives this is bit-identical to the
+        historical DisPFL draw."""
+        a = activity_mask(self.seed, round_idx, n_clients, active_prob)
+        dead = np.asarray([self.crashed(round_idx, c + 1)
+                           for c in range(n_clients)], bool)
+        return a & ~dead
+
+    def trace(self, rounds: int, ranks: range | list[int],
+              msgs_per_round: int = 4) -> list[dict]:
+        """Materialize the full event table — the replay artifact tests
+        pin (two instances over the same spec+seed must produce equal
+        traces)."""
+        out = []
+        for r in range(rounds):
+            for k in ranks:
+                out.append({
+                    "round": r, "rank": int(k),
+                    "crashed": self.crashed(r, k),
+                    "straggle_s": self.straggle_seconds(r, k),
+                    "drop": [self.drop(r, k, s)
+                             for s in range(msgs_per_round)],
+                    "dup": [self.duplicate(r, k, s)
+                            for s in range(msgs_per_round)],
+                    "disconnect": [self.disconnect(r, k, s)
+                                   for s in range(msgs_per_round)],
+                })
+        return out
+
+    def describe(self) -> str:
+        return (f"FaultSchedule(seed={self.seed}, "
+                f"{dataclasses.asdict(self.spec)})")

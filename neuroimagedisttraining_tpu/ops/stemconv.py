@@ -116,7 +116,10 @@ def _dw_pallas(x: jax.Array, g: jax.Array,
         in_specs=[pl.BlockSpec((_MROWS, _BLK), lambda i: (0, i)),
                   pl.BlockSpec((_BLK, c_out), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((1, _MROWS, c_out), lambda i: (i, 0, 0)),
-        compiler_params=pltpu.CompilerParams(
+        # jax >= 0.5 renamed TPUCompilerParams -> CompilerParams; support
+        # both so the kernel imports under the pinned 0.4.x toolchain
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(p2[:, :rmain], g2[:rmain])

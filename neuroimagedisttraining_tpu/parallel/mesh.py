@@ -32,16 +32,34 @@ def provision_virtual_devices(n: int) -> bool:
 
     Must run before first backend touch; the axon/TPU plugin ignores the
     ``XLA_FLAGS=--xla_force_host_platform_device_count`` env route, so the
-    config API is the only reliable path. Returns True if the config was
-    applied, False if the backend was already initialized (in which case the
-    caller must live with whatever devices exist)."""
+    config API is preferred — but the ``jax_num_cpu_devices`` option only
+    exists on jax >= 0.5, so older toolchains fall back to the env route
+    (read at backend init, i.e. still effective before first touch).
+    Returns True if a provisioning route was applied, False if the backend
+    was already initialized (in which case the caller must live with
+    whatever devices exist)."""
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
     except Exception:
         return False
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        import os
+        import re
+
+        flag = f"--xla_force_host_platform_device_count={n}"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in flags:
+            # replace a pre-set (possibly different) count rather than
+            # silently keeping it and still claiming the route applied
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags)
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
     try:
         from jax._src import xla_bridge
         if xla_bridge.backends_are_initialized():

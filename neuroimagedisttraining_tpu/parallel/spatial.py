@@ -39,6 +39,16 @@ def make_space_mesh(num_devices: int | None = None) -> Mesh:
     return make_mesh(num_devices=num_devices, axis_name=SPACE_AXIS)
 
 
+def _axis_size(axis_name: str) -> int:
+    """``lax.axis_size`` exists only on jax >= 0.5; under the pinned
+    0.4.x toolchain the axis env lookup returns the size directly."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax import core
+
+    return core.axis_frame(axis_name)
+
+
 def _halo_exchange(x: jax.Array, halo: int, axis_name: str) -> jax.Array:
     """Concatenate each shard's D-block with ``halo`` rows from both
     neighbors (zeros at the global volume edges).
@@ -49,7 +59,7 @@ def _halo_exchange(x: jax.Array, halo: int, axis_name: str) -> jax.Array:
     """
     if halo == 0:  # 1-wide depth kernel: nothing to exchange (x[:, -0:]
         return x   # would select the WHOLE block, doubling the depth)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     # receive the LAST `halo` rows of the left neighbor (shift right)
     from_left = lax.ppermute(x[:, -halo:], axis_name,

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Multiprocess kill-k chaos smoke (ISSUE 2): a 4-silo FedAvg federation
+# where client 3 crashes at round 1 (deterministic FaultSchedule via
+# --fault_spec) must still complete every round on BOTH control-plane
+# transports — the deadline+quorum server aggregates the survivors with
+# sample-count re-weighting and flags the corpse via heartbeats.
+#
+# Heavier than the tier-1 suite (each run trains the tiny 3D CNN in 5
+# real OS processes), so it lives here as a CI smoke, not a pytest.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PY=${PYTHON:-python}
+ROUNDS=3
+CLIENTS=4
+
+run_one() {
+    local transport=$1
+    local port
+    port=$($PY -c "from neuroimagedisttraining_tpu.distributed.ports \
+import free_port_block; print(free_port_block(16))")
+    local common=(--num_clients "$CLIENTS" --comm_round "$ROUNDS"
+                  --model 3dcnn_tiny --dataset synthetic
+                  --synthetic_num_subjects 24
+                  --synthetic_shape 12 14 12 --batch_size 4
+                  --base_port "$port" --force_cpu
+                  --transport "$transport"
+                  --fault_spec "crash:3@1"
+                  --round_deadline 30 --quorum 2
+                  --heartbeat_interval 0.5 --heartbeat_timeout 5)
+    echo "== chaos smoke ($transport transport, port $port): kill client 3 at round 1 =="
+    local out="/tmp/chaos_smoke_${transport}.log"
+    $PY -m neuroimagedisttraining_tpu.distributed.run \
+        --role server "${common[@]}" > "$out" 2>&1 &
+    local server_pid=$!
+    local pids=()
+    for r in $(seq 1 "$CLIENTS"); do
+        $PY -m neuroimagedisttraining_tpu.distributed.run \
+            --role client --rank "$r" "${common[@]}" \
+            > "/tmp/chaos_smoke_${transport}_c${r}.log" 2>&1 &
+        pids+=($!)
+    done
+    if ! wait "$server_pid"; then
+        echo "FAIL($transport): server exited non-zero"; cat "$out"; return 1
+    fi
+    for p in "${pids[@]}"; do wait "$p" 2>/dev/null || true; done
+    local json
+    # -o '{.*}' keeps the JSON object even if an interleaved stderr line
+    # lands on the same stdout line (both streams share the log file)
+    json=$(grep -a -o '^{.*}' "$out" | tail -1)
+    echo "$json"
+    $PY - "$json" <<EOF
+import json, sys
+res = json.loads(sys.argv[1])
+assert res["rounds_completed"] == $ROUNDS, res
+assert 3 in res["suspects"], f"killed client not flagged suspect: {res}"
+print(f"OK({res['transport']}): {res['rounds_completed']} rounds, "
+      f"suspects={res['suspects']}")
+EOF
+}
+
+rc=0
+run_one socket || rc=1
+run_one broker || rc=1
+exit $rc

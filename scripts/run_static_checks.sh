@@ -16,6 +16,10 @@ else
     echo "== ruff not installed; skipping the generic lint tier ==" >&2
 fi
 
+# nidtlint walks the whole package, including faults/ — the
+# lock-discipline rules cover distributed/ AND faults/ (the chaos
+# wrapper writes raw frames), and the determinism rules hold the fault
+# schedule to the same seeded-stream contract as the engines.
 echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1

@@ -264,6 +264,9 @@ def test_lock_flags_unlocked_send_only_under_distributed():
             conn.sendall(payload)
         """
     assert rules_of(lint(src, path="pkg/distributed/t.py")) == ["lock-send"]
+    # faults/ writes raw frames too (FaultyCommManager's torn-frame
+    # sends) — same interleaving hazard, same rule scope
+    assert rules_of(lint(src, path="pkg/faults/t.py")) == ["lock-send"]
     assert lint(src, path="pkg/engines/t.py") == []
 
 

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from neuroimagedisttraining_tpu.utils import checkpoint as ckpt
+import pytest
 
 
 def test_roundtrip_arrays_and_keys(tmp_path):
@@ -97,6 +98,7 @@ def test_resume_bitwise_identical_fedavg(tmp_path, synthetic_cohort):
         np.testing.assert_array_equal(np.asarray(leaf_b), np.asarray(leaf_a))
 
 
+@pytest.mark.slow
 def test_resume_bitwise_identical_dispfl(tmp_path, synthetic_cohort):
     """Same bitwise-resume contract for the most stateful engine (personal
     params + evolving masks)."""

@@ -49,6 +49,7 @@ def test_search_net_forward_and_alpha_shapes(search_setup):
     assert np.all(np.isfinite(np.asarray(logits)))
 
 
+@pytest.mark.slow
 def test_bilevel_search_step_moves_alphas_and_weights(search_setup):
     net, x, _ = search_setup
     y = jnp.array([1, 3])
@@ -66,6 +67,7 @@ def test_bilevel_search_step_moves_alphas_and_weights(search_setup):
     assert max(moved) > 0
 
 
+@pytest.mark.slow
 def test_arch_grads_unrolled_vs_regularized(search_setup):
     net, x, params = search_setup
     y = jnp.array([0, 2])

@@ -2,27 +2,28 @@
 managers, and the full register->broadcast->train->upload->aggregate->finish
 protocol loop (fedml_core/distributed semantics, SURVEY §2.2/§2.3)."""
 
-import itertools
 import multiprocessing as mp
 import os
 import threading
 import time
 
 import numpy as np
+import pytest
 
 from neuroimagedisttraining_tpu.distributed import message as M
 from neuroimagedisttraining_tpu.distributed.comm import SocketCommManager
 from neuroimagedisttraining_tpu.distributed.cross_silo import (
     FedAvgClientProc, FedAvgServer,
 )
-
-_PORT_SEQ = itertools.count()
+from neuroimagedisttraining_tpu.distributed.ports import free_port_block
 
 
 def _base_port() -> int:
-    """Per-process, per-test unique port block so concurrent pytest runs
-    (or a parallel full-suite invocation) never collide on fixed ports."""
-    return 51000 + (os.getpid() % 180) * 64 + next(_PORT_SEQ) * 8
+    """Kernel-probed free port block (distributed/ports.py): unlike the
+    old hardcoded 51000+pid scheme, parallel CI runs never collide on
+    bind — the kernel hands out an ephemeral anchor and the whole block
+    is proven bindable."""
+    return free_port_block(8)
 
 
 def test_message_codec_roundtrip():
@@ -244,7 +245,7 @@ def test_init_multihost_single_process():
     import subprocess
     import sys
 
-    port = _base_port() + 90
+    port = free_port_block(1)
     code = (
         "from neuroimagedisttraining_tpu.distributed.cross_silo import "
         "init_multihost\n"
@@ -451,6 +452,7 @@ def test_cross_silo_cli_runner():
     assert res["final_param_norm"] > 0
 
 
+@pytest.mark.slow
 def test_cross_silo_cli_runner_secure():
     """Same run under --secure: additive-share slots ride the control
     plane; the aggregate must match the plain run to fixed-point
@@ -462,6 +464,7 @@ def test_cross_silo_cli_runner_secure():
                                plain["final_param_norm"], rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_cross_silo_cli_runner_secure_multi_aggregator():
     """Full grouped deployment across SIX OS processes: server + 2 silo
     trainers + 3 slot aggregators. Slot j rides to aggregator j; the

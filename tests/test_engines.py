@@ -288,6 +288,7 @@ def test_subavg_fake_prune_percentile_matches_numpy():
     np.testing.assert_allclose(np.asarray(new["layer"]["kernel"]), want)
 
 
+@pytest.mark.slow
 def test_subavg_end_to_end_prunes(tmp_path, synthetic_cohort):
     """Loose thresholds so the accept-test fires: density drops below 1."""
     from neuroimagedisttraining_tpu.config import SparsityConfig
@@ -378,6 +379,7 @@ def test_fedfomo_requires_val_split(tmp_path, synthetic_cohort):
                                               "x", console=False))
 
 
+@pytest.mark.slow
 def test_fedfomo_end_to_end(tmp_path, synthetic_cohort):
     engine = _fomo_engine(tmp_path, synthetic_cohort)
     result = engine.train()
@@ -394,6 +396,7 @@ def test_fedfomo_end_to_end(tmp_path, synthetic_cohort):
         assert jnp.issubdtype(leaf.dtype, jnp.floating)
 
 
+@pytest.mark.slow
 def test_fedfomo_partial_participation_uses_fomo_m(tmp_path,
                                                    synthetic_cohort):
     engine = _fomo_engine(tmp_path, synthetic_cohort, frac=0.5, fomo_m=1)

@@ -1,0 +1,765 @@
+"""faults/: deterministic chaos + the tolerance it forces (ISSUE 2).
+
+Covers the seeded FaultSchedule (pure function of (seed, round, rank) —
+identical replay), the FaultyCommManager wrapper over both transports,
+the server's deadline/quorum survivor aggregation (bitwise-equal to the
+jitted engine aggregation over the same survivor set), round-tagged
+dedup (duplicates/stale uploads never double-count), heartbeat
+suspicion, late rejoin, the engine-side survivor sampling driven by the
+same schedule, and SecureFedAvgServer under dropout.
+"""
+
+import multiprocessing as mp
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.distributed.comm import SocketCommManager
+from neuroimagedisttraining_tpu.distributed.cross_silo import (
+    FedAvgClientProc,
+    FedAvgServer,
+    SecureFedAvgClientProc,
+    SecureFedAvgServer,
+    survivor_weighted_mean,
+)
+from neuroimagedisttraining_tpu.distributed.ports import free_port_block
+from neuroimagedisttraining_tpu.faults import (
+    FaultSchedule,
+    FaultyCommManager,
+    activity_mask,
+    parse_fault_spec,
+)
+from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
+
+
+def _base_port() -> int:
+    return free_port_block(8)
+
+
+# ---------------------------------------------------------------- schedule
+
+
+def test_parse_fault_spec_grammar():
+    spec = parse_fault_spec(
+        "crash:3@1,crash_prob:0.01;straggle:0.5:0.25,drop:0.1,"
+        "dup:0.05,disconnect:0.02")
+    assert spec.crashes == ((3, 1),)
+    assert spec.crash_prob == 0.01
+    assert spec.straggle_prob == 0.5 and spec.straggle_delay == 0.25
+    assert spec.drop_prob == 0.1 and spec.dup_prob == 0.05
+    assert spec.disconnect_prob == 0.02
+    assert spec.any_faults
+    assert not parse_fault_spec("").any_faults
+    with pytest.raises(ValueError):
+        parse_fault_spec("explode:0.5")
+    with pytest.raises(ValueError):
+        parse_fault_spec("drop:1.5")
+
+
+def test_fault_schedule_replays_identically():
+    """The acceptance property: the ENTIRE fault trace is a pure
+    function of the config seed — fresh instances, any query order."""
+    text = "crash:2@1,crash_prob:0.05,straggle:0.4:0.1,drop:0.2,dup:0.1"
+    a = FaultSchedule(parse_fault_spec(text), seed=1024)
+    b = FaultSchedule(parse_fault_spec(text), seed=1024)
+    # query b in reverse order first: per-event streams are independent
+    tb = list(reversed([b.drop(r, k, s) for r in range(5)
+                        for k in range(1, 5) for s in range(3)]))
+    ta = list(reversed([a.drop(r, k, s) for r in range(5)
+                        for k in range(1, 5) for s in range(3)]))
+    assert ta == tb
+    assert a.trace(6, range(6)) == b.trace(6, range(6))
+    # a different seed produces a different trace
+    c = FaultSchedule(parse_fault_spec(text), seed=7)
+    assert c.trace(6, range(6)) != a.trace(6, range(6))
+
+
+def test_schedule_crash_semantics():
+    s = FaultSchedule(parse_fault_spec("crash:3@2"), seed=0)
+    assert not s.crashed(0, 3) and not s.crashed(1, 3)
+    assert s.crashed(2, 3) and s.crashed(7, 3)  # permanent
+    assert not s.crashed(7, 1)
+    assert s.crash_round(3, horizon=10) == 2
+    assert s.crash_round(1, horizon=10) is None
+    # survivors() maps engine client index c -> rank c + 1
+    np.testing.assert_array_equal(
+        s.survivors(2, np.arange(4)), np.asarray([0, 1, 3]))
+    # a schedule that kills everyone keeps the cohort (0/0 guard)
+    k = FaultSchedule(parse_fault_spec("crash:1@0,crash:2@0"), seed=0)
+    np.testing.assert_array_equal(k.survivors(0, np.arange(2)),
+                                  np.arange(2))
+
+
+def test_activity_mask_matches_legacy_dispfl_formula():
+    """The unified draw reproduces engines/dispfl.py's historical inline
+    formula bit-for-bit, so seeds keep their meaning."""
+    for seed, round_idx, n, p in [(1024, 0, 21, 0.5), (7, 3, 8, 0.3),
+                                  (42, 17, 4, 0.9)]:
+        rng = np.random.default_rng(seed * 100003 + round_idx)
+        want = rng.random(n) < p
+        np.testing.assert_array_equal(
+            activity_mask(seed, round_idx, n, p), want)
+
+
+def test_schedule_active_mask_forces_crashed_inactive():
+    s = FaultSchedule(parse_fault_spec("crash:2@1"), seed=1024)
+    # round 0: pure activity; round 1+: client index 1 (rank 2) forced off
+    np.testing.assert_array_equal(s.active_mask(0, 4, 1.0),
+                                  np.ones(4, bool))
+    np.testing.assert_array_equal(s.active_mask(1, 4, 1.0),
+                                  np.asarray([True, False, True, True]))
+
+
+# ------------------------------------------------------------ free ports
+
+
+def test_free_port_block_is_bindable():
+    import socket
+
+    base = free_port_block(4)
+    socks = []
+    try:
+        for i in range(4):
+            s = socket.socket()
+            s.bind(("127.0.0.1", base + i))
+            socks.append(s)
+    finally:
+        for s in socks:
+            s.close()
+    with pytest.raises(ValueError):
+        free_port_block(0)
+
+
+# ------------------------------------------- in-thread tolerant protocol
+
+
+def _toy_train(rank, lr=0.5):
+    """Deterministic float32 'training': pull w toward the rank value."""
+    def fn(params, round_idx):
+        p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+        p["w"] = p["w"] + np.float32(lr) * (np.float32(rank) - p["w"])
+        return p, 10.0 * rank
+    return fn
+
+
+def _make_client(rank, num_clients, bp, *, spec=None, seed=0, hb=0.0,
+                 train=None):
+    comm = SocketCommManager(rank, num_clients + 1, base_port=bp)
+    if spec:
+        comm = FaultyCommManager(
+            comm, FaultSchedule(parse_fault_spec(spec), seed), rank)
+    return FedAvgClientProc(rank, num_clients,
+                            train or _toy_train(rank), base_port=bp,
+                            comm=comm, heartbeat_interval=hb)
+
+
+def _replay_rounds(init, survivors_per_round, lr=0.5):
+    """Host-side replay of the protocol: per round, survivors train from
+    the current global model and the aggregate is the jitted engine
+    aggregation over the survivor set."""
+    params = {k: np.asarray(v, np.float32) for k, v in init.items()}
+    for r, survivors in enumerate(survivors_per_round):
+        outs = {c: _toy_train(c, lr)(params, r) for c in survivors}
+        senders = sorted(outs)
+        params = survivor_weighted_mean(
+            [outs[s][0] for s in senders], [outs[s][1] for s in senders])
+    return params
+
+
+def test_deadline_quorum_survivor_aggregate_bitwise():
+    """Client 4 crashes at round 1 (seeded schedule). The server's
+    deadline+quorum round aggregates the 3 survivors with sample-count
+    re-weighting, bitwise-equal to the jitted engine aggregation
+    (tree_weighted_mean) over the same survivor set."""
+    num_clients, rounds = 4, 3
+    bp = _base_port()
+    init = {"w": np.zeros(3, np.float32)}
+    spec, seed = "crash:4@1", 1024
+    server = FedAvgServer(init, rounds, num_clients, base_port=bp,
+                          round_deadline=2.0, quorum=2)
+    clients = [_make_client(c, num_clients, bp, spec=spec, seed=seed)
+               for c in range(1, num_clients + 1)]
+    threads = [threading.Thread(target=m.run, daemon=True)
+               for m in [server] + clients]
+    for t in threads:
+        t.start()
+    assert server._done.wait(timeout=90), "chaos protocol stalled"
+    for t in threads:
+        t.join(timeout=15)
+
+    assert len(server.history) == rounds
+    assert server.history[0]["survivors"] == [1, 2, 3, 4]
+    for entry in server.history[1:]:
+        assert entry["survivors"] == [1, 2, 3]
+    assert 4 in server.suspect_clients()
+    # replay the schedule from the seed: identical survivor sets
+    sched = FaultSchedule(parse_fault_spec(spec), seed)
+    survivors = [[c for c in range(1, num_clients + 1)
+                  if not sched.crashed(r, c)] for r in range(rounds)]
+    assert survivors == [e["survivors"] for e in server.history]
+    want = _replay_rounds(init, survivors)
+    np.testing.assert_array_equal(server.params["w"], want["w"])
+    # and the aggregation primitive IS the engine one: a fresh jit of
+    # tree_weighted_mean over the last survivor round agrees bitwise
+    params_in = _replay_rounds(init, survivors[:-1])
+    outs = {c: _toy_train(c)(params_in, rounds - 1)
+            for c in survivors[-1]}
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+        *[outs[s][0] for s in sorted(outs)])
+    ns = jnp.asarray([outs[s][1] for s in sorted(outs)], jnp.float32)
+    engine_agg = jax.jit(tree_weighted_mean)(stacked, ns)
+    np.testing.assert_array_equal(server.params["w"],
+                                  np.asarray(engine_agg["w"]))
+
+
+def test_duplicate_uploads_never_double_count():
+    """dup:1.0 duplicates every protocol message; round-tagged dedup
+    must keep the aggregate identical to the clean run."""
+    num_clients, rounds = 3, 2
+    bp = _base_port()
+    init = {"w": np.zeros(3, np.float32)}
+    server = FedAvgServer(init, rounds, num_clients, base_port=bp)
+    clients = [_make_client(c, num_clients, bp, spec="dup:1.0")
+               for c in range(1, num_clients + 1)]
+    threads = [threading.Thread(target=m.run, daemon=True)
+               for m in [server] + clients]
+    for t in threads:
+        t.start()
+    assert server._done.wait(timeout=60), "dup protocol stalled"
+    for t in threads:
+        t.join(timeout=15)
+    assert len(server.history) == rounds
+    assert all(e["clients"] == num_clients for e in server.history)
+    want = _replay_rounds(init, [[1, 2, 3]] * rounds)
+    np.testing.assert_array_equal(server.params["w"], want["w"])
+
+
+def test_drop_and_disconnect_survivor_rounds():
+    """Client 2's uploads are all lost (drop:1.0 / torn mid-frame by
+    disconnect:1.0). The deadline round completes over the survivor and
+    the server listener survives the torn frames."""
+    for directive in ("drop:1.0", "disconnect:1.0"):
+        num_clients, rounds = 2, 2
+        bp = _base_port()
+        init = {"w": np.zeros(2, np.float32)}
+        server = FedAvgServer(init, rounds, num_clients, base_port=bp,
+                              round_deadline=1.0, quorum=1)
+        # only client 2 is chaotic; client 1 is clean
+        clients = [_make_client(1, num_clients, bp),
+                   _make_client(2, num_clients, bp, spec=directive)]
+        threads = [threading.Thread(target=m.run, daemon=True)
+                   for m in [server] + clients]
+        for t in threads:
+            t.start()
+        assert server._done.wait(timeout=60), f"{directive} stalled"
+        server_thread = threads[0]
+        server_thread.join(timeout=15)
+        assert len(server.history) == rounds
+        for e in server.history:
+            assert e["survivors"] == [1], (directive, server.history)
+        want = _replay_rounds(init, [[1]] * rounds)
+        np.testing.assert_array_equal(server.params["w"], want["w"])
+        # the chaotic client never crashed — tear its loop down
+        for cl in clients:
+            cl.com_manager.stop_receive_message()
+        for t in threads[1:]:
+            t.join(timeout=15)
+
+
+class _NullComm:
+    """Transport stub for handler-level unit tests (no sockets)."""
+
+    def send_message(self, msg, **kw):
+        pass
+
+    def add_observer(self, obs):
+        pass
+
+    def remove_observer(self, obs):
+        pass
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        pass
+
+
+def test_stale_round_upload_rejected_unit():
+    """Direct handler-level pin: an upload tagged with a wrong round (a
+    straggler finishing after its round closed, or a re-delivered frame)
+    never enters the aggregate."""
+    server = FedAvgServer({"w": np.zeros(2, np.float32)}, 5, 2,
+                          comm=_NullComm())
+    server.register_message_receive_handlers()
+    for c in (1, 2):
+        reg = M.Message(M.MSG_TYPE_C2S_REGISTER, c, 0)
+        server._on_register(reg)
+    assert server._started and server.round_idx == 0
+
+    def upload(c, round_tag, value, n):
+        msg = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, c, 0)
+        msg.add(M.ARG_MODEL_PARAMS, {"w": np.full(2, value, np.float32)})
+        msg.add(M.ARG_NUM_SAMPLES, float(n))
+        msg.add(M.ARG_ROUND_IDX, round_tag)
+        server._on_model(msg)
+
+    upload(1, 0, 1.0, 10.0)
+    upload(1, 0, 99.0, 10.0)   # duplicate: ignored
+    upload(2, 3, 99.0, 10.0)   # stale/future round: ignored
+    assert server.round_idx == 0 and len(server._updates) == 1
+    upload(2, 0, 3.0, 30.0)    # completes the round
+    assert server.round_idx == 1
+    # aggregate = (10*1 + 30*3)/40 = 2.5 — the 99-valued frames never
+    # double-counted
+    np.testing.assert_allclose(server.params["w"],
+                               np.full(2, 2.5, np.float32), rtol=1e-6)
+
+
+def test_heartbeat_flags_killed_client_within_bound():
+    """A client that registers, beats, then goes silent is marked
+    suspect within ~heartbeat_timeout + poll; the monitor's suspicion
+    lets rounds complete without it (quorum floor holds)."""
+    num_clients, rounds = 2, 2
+    hb_timeout = 0.5
+    bp = _base_port()
+    init = {"w": np.zeros(2, np.float32)}
+    server = FedAvgServer(init, rounds, num_clients, base_port=bp,
+                          quorum=1, heartbeat_timeout=hb_timeout)
+    live = _make_client(1, num_clients, bp, hb=0.1)
+    # rank 2: a zombie — real listener, registers, beats briefly, dies
+    zombie_comm = SocketCommManager(2, num_clients + 1, base_port=bp)
+    threads = [threading.Thread(target=m.run, daemon=True)
+               for m in (server, live)]
+    for t in threads:
+        t.start()
+    reg = M.Message(M.MSG_TYPE_C2S_REGISTER, 2, 0)
+    zombie_comm.send_message(reg)
+    for _ in range(3):
+        zombie_comm.send_message(M.Message(M.MSG_TYPE_C2S_HEARTBEAT, 2, 0))
+        time.sleep(0.05)
+    t_silent = time.monotonic()
+    deadline = t_silent + 10 * hb_timeout
+    while time.monotonic() < deadline:
+        if 2 in server.suspect_clients():
+            break
+        time.sleep(0.02)
+    t_flag = time.monotonic()
+    assert 2 in server.suspect_clients(), "killed client never flagged"
+    assert t_flag - t_silent <= 6 * hb_timeout, (
+        f"suspicion took {t_flag - t_silent:.2f}s for a "
+        f"{hb_timeout}s timeout")
+    assert server._done.wait(timeout=30), "monitor-driven rounds stalled"
+    for e in server.history:
+        assert e["survivors"] == [1]
+    zombie_comm.stop_receive_message()
+    for t in threads:
+        t.join(timeout=15)
+
+
+def test_late_rejoin_via_reregister():
+    """A crashed client's replacement re-registers mid-federation; the
+    server ships it the current round state and it contributes again
+    (suspicion cleared, survivors grow back)."""
+    num_clients, rounds = 2, 8
+    bp = _base_port()
+    init = {"w": np.zeros(2, np.float32)}
+    server = FedAvgServer(init, rounds, num_clients, base_port=bp,
+                          round_deadline=0.5, quorum=1)
+
+    def slow_train(params, round_idx):  # keep rounds >= 0.3s so the
+        time.sleep(0.3)                 # rejoin lands before FINISH
+        return _toy_train(1)(params, round_idx)
+
+    c1 = _make_client(1, num_clients, bp, train=slow_train)
+    c2 = _make_client(2, num_clients, bp, spec="crash:2@1", seed=0)
+    threads = [threading.Thread(target=m.run, daemon=True)
+               for m in (server, c1, c2)]
+    for t in threads:
+        t.start()
+    # wait until the crash bit: some round completed without client 2
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if any(e.get("survivors") == [1] for e in server.history):
+            break
+        time.sleep(0.05)
+    assert any(e.get("survivors") == [1] for e in server.history), \
+        "client 2 never dropped out"
+    # a fresh healthy process takes over rank 2 and re-registers
+    c2b = _make_client(2, num_clients, bp)
+    t2b = threading.Thread(target=c2b.run, daemon=True)
+    t2b.start()
+    assert server._done.wait(timeout=60), "rejoin federation stalled"
+    assert any(e.get("survivors") == [1, 2]
+               for e in server.history[1:]), (
+        f"rejoined client never contributed: {server.history}")
+    for t in threads + [t2b]:
+        t.join(timeout=15)
+
+
+# ------------------------------------------------- broker-transport chaos
+
+
+def test_faulty_comm_wraps_broker_transport():
+    """The wrapper is transport-agnostic: duplicates over the pub/sub
+    broker are deduped by the round tag exactly as over sockets."""
+    from neuroimagedisttraining_tpu.distributed.broker import (
+        BrokerCommManager, MessageBroker,
+    )
+
+    num_clients, rounds = 2, 2
+    broker = MessageBroker()
+    init = {"w": np.zeros(2, np.float32)}
+    server = FedAvgServer(
+        init, rounds, num_clients,
+        comm=BrokerCommManager("127.0.0.1", broker.port, client_id=0,
+                               client_num=num_clients))
+    sched = FaultSchedule(parse_fault_spec("dup:1.0"), seed=3)
+    clients = []
+    for c in (1, 2):
+        inner = BrokerCommManager("127.0.0.1", broker.port, client_id=c,
+                                  client_num=num_clients)
+        clients.append(FedAvgClientProc(
+            c, num_clients, _toy_train(c),
+            comm=FaultyCommManager(inner, sched, c)))
+    threads = [threading.Thread(target=m.run, daemon=True)
+               for m in [server] + clients]
+    for t in threads:
+        t.start()
+    assert server._done.wait(timeout=60), "broker chaos stalled"
+    for t in threads:
+        t.join(timeout=15)
+    assert len(server.history) == rounds
+    want = _replay_rounds(init, [[1, 2]] * rounds)
+    np.testing.assert_array_equal(server.params["w"], want["w"])
+    broker.stop()
+
+
+# ------------------------------------------------- multiprocess chaos run
+
+
+def _chaos_client(rank, num_clients, base_port, seed, spec, hb):
+    # separate OS process: a simulated crash kills the whole process
+    from neuroimagedisttraining_tpu.distributed.comm import (
+        SocketCommManager,
+    )
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        FedAvgClientProc,
+    )
+    from neuroimagedisttraining_tpu.faults import (
+        FaultSchedule, FaultyCommManager, parse_fault_spec,
+    )
+
+    comm = SocketCommManager(rank, num_clients + 1, base_port=base_port)
+    comm = FaultyCommManager(
+        comm, FaultSchedule(parse_fault_spec(spec), seed), rank)
+
+    def train_fn(params, round_idx):
+        p = {k: np.asarray(v, np.float32) * np.float32(0.5) + rank
+             for k, v in params.items()}
+        return p, float(rank)
+
+    FedAvgClientProc(rank, num_clients, train_fn, base_port=base_port,
+                     comm=comm, heartbeat_interval=hb).run()
+
+
+def test_multiprocess_chaos_one_of_four_killed():
+    """THE acceptance scenario: a 4-silo multiprocess FedAvg federation
+    with client 3 killed mid-run (seeded schedule -> its process exits)
+    completes all rounds; the survivor-weighted aggregate bitwise-equals
+    the jitted engine aggregation replay over the same survivor sets;
+    the fault schedule replays identically from the config seed."""
+    num_clients, rounds, seed, spec = 4, 3, 1024, "crash:3@1"
+    bp = _base_port()
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_chaos_client,
+                         args=(r, num_clients, bp, seed, spec, 0.2),
+                         daemon=True)
+             for r in range(1, num_clients + 1)]
+    for p in procs:
+        p.start()
+    init = {"w": np.zeros(3, np.float32)}
+    server = FedAvgServer(init, rounds, num_clients, base_port=bp,
+                          round_deadline=30.0, quorum=2,
+                          heartbeat_timeout=3.0)
+    t = threading.Thread(target=server.run, daemon=True)
+    t.start()
+    assert server._done.wait(timeout=240), "chaos federation stalled"
+    t.join(timeout=15)
+    for p in procs:
+        p.join(timeout=30)
+
+    assert len(server.history) == rounds
+    sched = FaultSchedule(parse_fault_spec(spec), seed)
+    survivors = [[c for c in range(1, num_clients + 1)
+                  if not sched.crashed(r, c)] for r in range(rounds)]
+    assert survivors == [e["survivors"] for e in server.history], \
+        "survivor sets did not replay from the config seed"
+    assert 3 in server.suspect_clients()
+
+    # bitwise replay: survivors train (p*0.5 + rank), jitted engine
+    # aggregation over the survivor set each round
+    params = dict(init)
+    for r, surv in enumerate(survivors):
+        outs = {c: ({"w": np.asarray(params["w"], np.float32)
+                     * np.float32(0.5) + c}, float(c)) for c in surv}
+        senders = sorted(outs)
+        params = survivor_weighted_mean(
+            [outs[s][0] for s in senders], [outs[s][1] for s in senders])
+    np.testing.assert_array_equal(server.params["w"], params["w"])
+
+
+# ----------------------------------------------- secure server + dropout
+
+
+def _secure_toy(rank, lr=0.5):
+    def fn(params, round_idx):
+        p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+        p["w"] = p["w"] + np.float32(lr) * (np.float32(rank) - p["w"])
+        return p, 10.0 * rank
+    return fn
+
+
+def test_secure_server_requires_all_clients_without_quorum():
+    """Pins the pre-tolerance contract: with no deadline/quorum the
+    secure server blocks the round until EVERY client reports — a single
+    missing client stalls the federation (the behavior ISSUE 2 calls
+    out; the quorum test below pins the fix)."""
+    num_clients = 3
+    bp = _base_port()
+    init = {"w": np.zeros(2, np.float32)}
+    server = SecureFedAvgServer(init, 1, num_clients, base_port=bp)
+    clients = [SecureFedAvgClientProc(c, num_clients, _secure_toy(c),
+                                      n_shares=3, mpc_seed=c, base_port=bp)
+               for c in (1, 2)]  # client 3 never starts
+    threads = [threading.Thread(target=m.run, daemon=True)
+               for m in [server] + clients]
+    for t in threads:
+        t.start()
+    assert not server._done.wait(timeout=3.0), (
+        "secure server completed without all clients — the strict "
+        "contract this test pins has changed")
+    assert len(server.history) == 0
+    for m in [server] + clients:
+        m.com_manager.stop_receive_message()
+    for t in threads:
+        t.join(timeout=15)
+
+
+def test_secure_server_quorum_dropout_reweighted():
+    """With deadline+quorum, a client crashing mid-run drops out of the
+    secure aggregate cleanly: survivors' share sets fold, the missing
+    client contributes NOTHING (atomic discard), and the dequantized
+    aggregate is re-weighted to a true mean over the survivors."""
+    num_clients, rounds, lr = 3, 3, 0.5
+    bp = _base_port()
+    init = {"w": np.zeros(2, np.float32)}
+    server = SecureFedAvgServer(init, rounds, num_clients, base_port=bp,
+                                round_deadline=1.5, quorum=2)
+    clients = []
+    for c in (1, 2, 3):
+        comm = SocketCommManager(c, num_clients + 1, base_port=bp)
+        if c == 3:
+            comm = FaultyCommManager(
+                comm, FaultSchedule(parse_fault_spec("crash:3@1"), 0), c)
+        clients.append(SecureFedAvgClientProc(
+            c, num_clients, _secure_toy(c, lr), n_shares=3, mpc_seed=c,
+            base_port=bp, comm=comm))
+    threads = [threading.Thread(target=m.run, daemon=True)
+               for m in [server] + clients]
+    for t in threads:
+        t.start()
+    assert server._done.wait(timeout=120), "secure dropout stalled"
+    for t in threads:
+        t.join(timeout=15)
+
+    assert len(server.history) == rounds
+    assert server.history[0]["survivors"] == [1, 2, 3]
+    for e in server.history[1:]:
+        assert e["survivors"] == [1, 2]
+    # plaintext replay with survivor re-weighting (fixed-point tolerance)
+    params = {"w": np.zeros(2, np.float64)}
+    for r, surv in enumerate([[1, 2, 3], [1, 2], [1, 2]][:rounds]):
+        outs = {c: _secure_toy(c, lr)(params, r) for c in surv}
+        w = np.asarray([outs[c][1] for c in sorted(outs)], np.float64)
+        w = w / w.sum()
+        params = {"w": sum(wi * np.asarray(outs[c][0]["w"], np.float64)
+                           for wi, c in zip(w, sorted(outs)))}
+    np.testing.assert_allclose(server.params["w"], params["w"], atol=1e-3)
+
+
+def test_secure_stale_share_upload_discarded_atomically():
+    """Handler-level pin of the atomic-discard contract: a share upload
+    tagged with a stale round (or from a client with no weight this
+    round) never folds into the slot accumulator — not even partially."""
+    server = SecureFedAvgServer({"w": np.zeros(2, np.float32)}, 5, 2,
+                                comm=_NullComm())
+    server.register_message_receive_handlers()
+    for c in (1, 2):
+        server._on_register(M.Message(M.MSG_TYPE_C2S_REGISTER, c, 0))
+    # phase A: both clients report n_c -> weights go out, phase flips
+    for c, n in ((1, 10.0), (2, 30.0)):
+        msg = M.Message(M.MSG_TYPE_C2S_NUM_SAMPLES, c, 0)
+        msg.add(M.ARG_NUM_SAMPLES, n)
+        msg.add(M.ARG_ROUND_IDX, 0)
+        server._on_num_samples(msg)
+    assert server._phase == "B" and set(server._weights_sent) == {1, 2}
+
+    shares = {"w": np.arange(6, dtype=np.int64).reshape(3, 2)}
+    stale = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    stale.add(M.ARG_MODEL_PARAMS, shares)
+    stale.add(M.ARG_ROUND_IDX, 4)       # wrong round
+    server._on_model(stale)
+    assert server._slot_acc is None and server._folded == set()
+
+    ok = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    ok.add(M.ARG_MODEL_PARAMS, shares)
+    ok.add(M.ARG_ROUND_IDX, 0)
+    server._on_model(ok)
+    assert server._folded == {1}
+    dup = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    dup.add(M.ARG_MODEL_PARAMS, shares)
+    dup.add(M.ARG_ROUND_IDX, 0)
+    server._on_model(dup)               # duplicate: no second fold
+    np.testing.assert_array_equal(server._slot_acc["w"], shares["w"])
+
+
+def test_secure_phase_b_dropout_rescale_unit():
+    """A client that reported n_c (so got a weight) but died before
+    uploading shares: the deadline fires, the survivors' dequantized sum
+    is w-deficient, and the server re-weights by 1 / (sum of survivor
+    weights) — recovering a true weighted mean over the survivors."""
+    from neuroimagedisttraining_tpu.ops import mpc
+
+    server = SecureFedAvgServer({"w": np.zeros(2, np.float32)}, 5, 2,
+                                comm=_NullComm(), round_deadline=60.0,
+                                quorum=1)
+    server.register_message_receive_handlers()
+    for c in (1, 2):
+        server._on_register(M.Message(M.MSG_TYPE_C2S_REGISTER, c, 0))
+    for c, n in ((1, 10.0), (2, 30.0)):  # -> w_1 = 0.25, w_2 = 0.75
+        msg = M.Message(M.MSG_TYPE_C2S_NUM_SAMPLES, c, 0)
+        msg.add(M.ARG_NUM_SAMPLES, n)
+        msg.add(M.ARG_ROUND_IDX, 0)
+        server._on_num_samples(msg)
+    assert server._phase == "B"
+    x = np.asarray([1.5, -2.0], np.float64)  # client 1's trained params
+    shares = {"w": mpc.additive_shares(
+        mpc.quantize(0.25 * x), 3, rng=np.random.default_rng(0))}
+    up = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    up.add(M.ARG_MODEL_PARAMS, shares)
+    up.add(M.ARG_ROUND_IDX, 0)
+    server._on_model(up)
+    # client 2 never uploads; quorum=1 holds at the deadline
+    server._on_deadline(0, server._deadline_gen)
+    if server._timer is not None:
+        server._timer.cancel()
+    assert server.round_idx == 1
+    assert server.history[0]["survivors"] == [1]
+    assert 2 in server.suspect_clients()
+    # dequantize(0.25 * x) / 0.25 == x to fixed-point precision
+    np.testing.assert_allclose(server.params["w"], x, atol=1e-3)
+
+
+# --------------------------------------------- engine-side unification
+
+
+def _make_engine(tmp_path, cohort, algorithm="fedavg", **fed_kw):
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import federate_cohort
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm=algorithm,
+        data=DataConfig(dataset="synthetic", partition_method="site"),
+        optim=OptimConfig(lr=5e-4, batch_size=8, epochs=1),
+        fed=FedConfig(**{"client_num_in_total": 4, "comm_round": 3,
+                         **fed_kw}),
+        log_dir=str(tmp_path))
+    mesh = make_mesh(shape=())
+    fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh)
+    model = create_model(cfg.model, num_classes=1)
+    trainer = LocalTrainer(model, cfg.optim, num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    return create_engine(algorithm, cfg, fed, trainer, mesh=mesh,
+                         logger=log)
+
+
+def test_engine_sampling_excludes_crashed_clients(tmp_path,
+                                                  synthetic_cohort):
+    """One seed drives both worlds: the simulated engine's cohort
+    filtering uses the SAME schedule as the multiprocess federation
+    (engine client index c == rank c + 1)."""
+    eng = _make_engine(tmp_path, synthetic_cohort, fault_spec="crash:2@1")
+    np.testing.assert_array_equal(eng.client_sampling(0), np.arange(4))
+    np.testing.assert_array_equal(eng.client_sampling(1),
+                                  np.asarray([0, 2, 3]))
+    clean = _make_engine(tmp_path, synthetic_cohort)
+    assert clean.fault_schedule is None
+    np.testing.assert_array_equal(clean.client_sampling(1), np.arange(4))
+
+
+def test_engine_survivor_round_is_frac_sampled_round(tmp_path,
+                                                     synthetic_cohort):
+    """Survivor-reweight parity: the faulty engine's jitted round over
+    the survivor set is the SAME program a clean engine runs for a
+    frac-sampled round with that cohort — bitwise-identical outputs."""
+    eng_f = _make_engine(tmp_path, synthetic_cohort,
+                         fault_spec="crash:2@1")
+    eng_c = _make_engine(tmp_path, synthetic_cohort)
+    surv = eng_f.client_sampling(1)
+    gs = eng_c.init_global_state()
+    rngs = eng_c.per_client_rngs(1, surv)
+    args = (gs.params, gs.batch_stats)
+    out_f = eng_f._round_jit(*args, eng_f.data, jnp.asarray(surv), rngs,
+                             eng_f.round_lr(1))
+    out_c = eng_c._round_jit(*args, eng_c.data, jnp.asarray(surv), rngs,
+                             eng_c.round_lr(1))
+    for a, b in zip(jax.tree.leaves(out_f), jax.tree.leaves(out_c)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dispfl_active_draw_crash_gating(tmp_path, synthetic_cohort):
+    """DisPFL's activity draw now routes through the unified schedule:
+    without faults it is bit-identical to the legacy stream; with a
+    crash directive the dead client is forced inactive."""
+    eng = _make_engine(tmp_path, synthetic_cohort, algorithm="dispfl",
+                       active=0.7)
+    for r in (0, 1, 5):
+        want = np.zeros(eng.num_clients, bool)
+        want[: eng.real_clients] = activity_mask(
+            eng.cfg.seed, r, eng.real_clients, 0.7)
+        np.testing.assert_array_equal(eng.active_draw(r), want)
+    eng_f = _make_engine(tmp_path, synthetic_cohort, algorithm="dispfl",
+                         active=1.0, fault_spec="crash:2@1")
+    assert eng_f.active_draw(0)[: eng_f.real_clients].all()
+    a1 = eng_f.active_draw(1)
+    assert not a1[1] and a1[0] and a1[2] and a1[3]
+
+
+def test_config_roundtrips_fault_fields():
+    from neuroimagedisttraining_tpu.config import ExperimentConfig
+    import json
+
+    cfg = ExperimentConfig.from_dict({
+        "fed": {"fault_spec": "crash:3@1,drop:0.1",
+                "round_deadline": 12.5, "quorum": 2,
+                "heartbeat_interval": 0.5, "heartbeat_timeout": 5.0}})
+    assert cfg.fed.fault_spec == "crash:3@1,drop:0.1"
+    assert cfg.fed.round_deadline == 12.5 and cfg.fed.quorum == 2
+    back = ExperimentConfig.from_dict(json.loads(cfg.to_json()))
+    assert back.fed.fault_spec == cfg.fed.fault_spec
+    assert back.fed.heartbeat_timeout == 5.0

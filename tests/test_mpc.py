@@ -6,6 +6,7 @@ and the secure-aggregation engine matching plain FedAvg
 import numpy as np
 
 from neuroimagedisttraining_tpu.ops import mpc
+import pytest
 
 P = mpc.P_DEFAULT
 
@@ -195,6 +196,7 @@ def test_secure_sum_device_slots_are_masked():
             "slot total equals the plain quantized sum"
 
 
+@pytest.mark.slow
 def test_turboaggregate_host_backend_still_works(tmp_path,
                                                  synthetic_cohort):
     """mpc_backend='host' keeps the boundary-modeling numpy path alive."""
@@ -219,6 +221,7 @@ def test_key_agreement_symmetric():
         mpc.key_agreement(sk_b, pk_a, p, g)
 
 
+@pytest.mark.slow
 def test_turboaggregate_engine_matches_fedavg(tmp_path, synthetic_cohort):
     """Secure aggregation must equal plain FedAvg up to fixed-point
     rounding: train 2 rounds with each, compare final params."""

@@ -8,6 +8,7 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.utils import pytree as pt
+import pytest
 
 
 def _tree(rng, scale=1.0):
@@ -83,6 +84,7 @@ def test_defense_unknown_raises():
         pass
 
 
+@pytest.mark.slow
 def test_fedavg_with_defense_runs(tmp_path, synthetic_cohort):
     from tests.test_fedavg import _make_engine
 

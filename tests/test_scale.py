@@ -90,6 +90,7 @@ def test_fedavg_100clients_resident(tmp_path, scale_cohort):
     assert np.isfinite(result["final_global"]["loss"])
 
 
+@pytest.mark.slow
 def test_fedavg_100clients_streaming_matches_resident(tmp_path,
                                                       scale_cohort):
     """The streamed padded round (10 real + 6 zero-weight pads to tile the
@@ -122,6 +123,7 @@ def test_fedavg_100clients_streaming_matches_resident(tmp_path,
         st.stream.close()
 
 
+@pytest.mark.slow
 def test_salientgrads_100clients_resident_and_streaming(tmp_path,
                                                         scale_cohort):
     """The flagship at the north-star shape: phase-1 over all 100 clients,
@@ -267,6 +269,7 @@ def test_subavg_100clients_streamed_round_matches_resident(tmp_path,
         st.stream.close()
 
 
+@pytest.mark.slow
 def test_dispfl_100clients_consensus_path_and_round(tmp_path,
                                                     scale_cohort):
     """DisPFL at 100 clients: the reference-default random adjacency at

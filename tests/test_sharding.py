@@ -197,6 +197,7 @@ def test_salientgrads_round_identical_on_flat_and_two_level_mesh(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_ditto_round_identical_on_flat_and_two_level_mesh(tmp_path):
     """Ditto's global track likewise routes silo-aware (VERDICT r4 #1)."""
     from neuroimagedisttraining_tpu.data.synthetic import generate_synthetic_abcd

@@ -132,6 +132,7 @@ def test_tiny_imagenet_folder_reader(tmp_path):
     np.testing.assert_array_equal(yte, [0, 1])
 
 
+@pytest.mark.slow
 def test_salientgrads_on_vision_smoke(tmp_path):
     """The flagship algorithm on the public data path (SURVEY hard-part #5:
     CIFAR is the parity cross-check the private cohort can't provide):
@@ -168,6 +169,7 @@ def test_salientgrads_on_vision_smoke(tmp_path):
     assert np.isfinite(res["history"][-1]["train_loss"])
 
 
+@pytest.mark.slow
 def test_federated_vision_end_to_end(tmp_path):
     """2D CNN federation over the synthetic vision cohort: accuracy beats
     chance after a few FedAvg rounds (public cross-check path,
