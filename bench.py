@@ -25,6 +25,12 @@ Reported extras: analytic GFLOP/sample (ops/flops.py), sustained TFLOP/s,
 and MFU against the visible chip's bf16 peak (device-kind table; "mfu" is
 null when the chip is unknown).
 
+Wire-codec cell (ISSUE 3): encodes a real client upload from the shipped
+round program with the cross-silo wire codec — fedavg delta+quant and
+masked sparse+quant against the phase-2 SNIP mask — reporting frame
+bytes vs the dense msgpack wire, encode/decode ms, and the overhead as a
+fraction of the measured round wall time (acceptance: < 10%).
+
 Phase 3 — one-round timings for every other engine program, now including
 the flagship's steady-state MASKED round (salientgrads phase 2), ditto
 (dual-track: ~2x compute/sample), fedprox, local, and turboaggregate
@@ -348,6 +354,53 @@ def main() -> None:
     else:
         turbo_mpc_ms = None
 
+    # ---- wire codec cell (ISSUE 3): bytes/round + encode/decode ms ----
+    # Encodes a REAL client upload — one more shipped-engine round from
+    # the current params (BENCH_CLIENTS=1 => the round output IS the
+    # client's trained model) — as the cross-silo wire would ship it:
+    # fedavg delta+quant (dense engine) and the flagship's masked
+    # sparse+quant against the phase-2 SNIP mask (mask handoff, no
+    # bitmap). Reports true frame bytes vs the dense msgpack wire and
+    # host encode/decode wall time; overhead_frac relates encode+decode
+    # to the measured round wall time (acceptance: < 10%).
+    from neuroimagedisttraining_tpu.codec import (
+        decode_update, encode_update, frame_nbytes, parse_wire_spec,
+    )
+
+    ref_host = {"params": jax.tree.map(np.asarray, params),
+                "batch_stats": jax.tree.map(np.asarray, bstats)}
+    p2, b2, loss2 = one_round(params, bstats, n_rounds + 1)
+    float(loss2)
+    upd_host = {"params": jax.tree.map(np.asarray, p2),
+                "batch_stats": jax.tree.map(np.asarray, b2)}
+    masks_host = {"params": jax.tree.map(np.asarray, masks),
+                  "batch_stats": jax.tree.map(
+                      lambda x: np.ones_like(np.asarray(x)),
+                      bstats)}
+    dense_bytes = frame_nbytes(upd_host)
+    round_s = samples / (n_rounds * max(sps, 1e-9))  # one round's wall time
+    codec_cell = {"dense_bytes": dense_bytes}
+    for key, spec_str, m in (
+            ("fedavg_delta_quant", "delta+quant", None),
+            ("salientgrads_mask_sparse_quant", "delta+sparse+quant",
+             masks_host)):
+        spec = parse_wire_spec(spec_str)
+        t0 = time.perf_counter()
+        frame, _ = encode_update(spec, upd_host, reference=ref_host,
+                                 masks=m, mask_on_wire=False)
+        enc_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        decode_update(frame, like=upd_host, reference=ref_host, masks=m)
+        dec_s = time.perf_counter() - t0
+        nbytes = frame_nbytes(frame)
+        codec_cell[key] = {
+            "bytes": nbytes,
+            "reduction_x": round(dense_bytes / nbytes, 2),
+            "encode_ms": round(enc_s * 1e3, 1),
+            "decode_ms": round(dec_s * 1e3, 1),
+            "overhead_frac_of_round": round((enc_s + dec_s) / round_s, 4),
+        }
+
     scores = jax.random.uniform(jax.random.key(5), (1 << 22,))
     on_tpu = jax.default_backend() == "tpu"
     thr_pallas = kth_largest(scores, 1 << 21, use_pallas=on_tpu)
@@ -382,6 +435,7 @@ def main() -> None:
             for k, v in algo_round_s.items()} or None,
         "turboaggregate_mpc_ms": (round(turbo_mpc_ms, 1)
                                   if turbo_mpc_ms is not None else None),
+        "wire_codec": codec_cell,
         "pallas_topk_ms_4m": round(topk_ms, 1) if topk_ms else None,
         "pallas_threshold_matches_xla": pallas_ok,
         "timing": f"best of {reps} repeats (shared-chip noise, PROFILE.md)",

@@ -75,6 +75,22 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "— crashed clients leave the sampled cohort "
                              "(survivor-reweighted rounds); the same seed "
                              "drives the multiprocess federation")
+    parser.add_argument("--wire_codec", type=str, default="none",
+                        help="model-update wire codec (codec/): '+'-"
+                             "joined stages from {delta, sparse, quant, "
+                             "quant16}, e.g. delta+sparse+quant; the "
+                             "simulated round applies the codec's lossy "
+                             "transform to client updates before "
+                             "aggregation (jitted) and accounts encoded "
+                             "vs dense bytes in stat_info — parity with "
+                             "what distributed.run ships on real "
+                             "sockets")
+    parser.add_argument("--wire_topk_ratio", type=float, default=0.25,
+                        help="wire codec sparse stage for dense engines: "
+                             "magnitude top-k keep fraction (per-client "
+                             "error feedback re-injects dropped mass "
+                             "next round); masked engines use their own "
+                             "mask instead")
     parser.add_argument("--round_deadline", type=float, default=0.0,
                         help="cross-silo per-round deadline seconds "
                              "(distributed.run); recorded in the config "
@@ -204,6 +220,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             client_num_in_total=args.client_num_in_total, frac=args.frac,
             comm_round=args.comm_round, cs=args.cs, active=args.active,
             fault_spec=args.fault_spec,
+            wire_codec=args.wire_codec,
+            wire_topk_ratio=args.wire_topk_ratio,
             round_deadline=args.round_deadline, quorum=args.quorum,
             heartbeat_interval=args.heartbeat_interval,
             heartbeat_timeout=args.heartbeat_timeout,

@@ -1,0 +1,23 @@
+"""Composable model-update wire codec (delta / mask-sparse / quantized).
+
+``wire.py`` — spec parsing, the tagged frame format, and the NumPy host
+encode/decode the OS-process federation runs without a device.
+``device.py`` — the same math as jitted XLA ops (top-k via the Pallas
+histogram select) plus ``lossy_roundtrip``, the pure value transform the
+simulated engines apply so in-process rounds aggregate exactly what a
+cross-silo federation would.
+"""
+
+from neuroimagedisttraining_tpu.codec.wire import (  # noqa: F401
+    FRAME_KEY,
+    FRAME_VERSION,
+    WireSpec,
+    decode_update,
+    encode_update,
+    frame_nbytes,
+    is_codec_frame,
+    parse_wire_spec,
+)
+from neuroimagedisttraining_tpu.codec.device import (  # noqa: F401
+    lossy_roundtrip,
+)

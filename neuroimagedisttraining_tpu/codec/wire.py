@@ -1,0 +1,402 @@
+"""Model-update wire codec: delta + mask/top-k sparse + int8/bf16 quant.
+
+The cross-silo plane historically shipped every model message as a dense
+float32 flax-msgpack pytree (~4 bytes/param; distributed/message.py). But
+the flagship algorithm's uploads are top-k sparse BY CONSTRUCTION
+(SalientGrads' global SNIP mask), DisPFL/Sub-FedAvg train under explicit
+masks, and every upload is a small-magnitude residual of the round's
+broadcast reference — so the wire can carry far fewer bytes without
+changing what the server aggregates (Bonawitz et al. 2017 shows the
+aggregation contract survives an encoded transport; FedProx frames
+cross-silo FL as bandwidth-bound).
+
+Three composable stages, each optional (``parse_wire_spec``):
+
+- **delta** — the payload becomes ``update - reference`` where the
+  reference is the round's broadcast model; the receiver adds it back.
+  Value-exact up to one float32 rounding of ``(u - r) + r``; it
+  concentrates values near zero so the later stages bite harder (and
+  zlib sees low-entropy bytes).
+- **sparse** — two modes. *Mask mode* (``masks`` given): engines that
+  already own a pruning/saliency mask ship only the surviving values,
+  plus a packed bitmap frame — or no bitmap at all when the receiver
+  provably holds the same mask (``mask_on_wire=False``: SalientGrads'
+  phase-1 mask is computed server-side and broadcast, so both endpoints
+  own it — the "mask handoff"). *Top-k mode* (no masks): dense engines
+  opt into magnitude top-k over the whole update with a per-client
+  error-feedback accumulator — the dropped mass (and quantization error)
+  is carried into the next round's residual, so no gradient signal is
+  permanently lost (standard EF-SGD semantics).
+- **quant** — linear quantization of the surviving values with per-leaf
+  scales: ``int8`` (symmetric, scale = amax/127) or ``bf16`` (bit
+  truncation). Non-finite scales are impossible by construction
+  (amax == 0 -> scale 1).
+
+Frame format (the tagged body frame distributed/message.py's envelope
+carries): a dict ``{FRAME_KEY: FRAME_VERSION, "spec", "delta", "z",
+"body"}`` where ``body`` is the per-leaf record table serialized with
+flax msgpack and (when it shrinks) zlib-deflated. A receiver decodes any
+frame without prior configuration — the frame is self-describing except
+for shared-mask mode, which fails loudly when the receiver lacks the
+mask. Anything WITHOUT the magic key is the dense fallback and passes
+through ``decode_update`` untouched, so a dense sender never breaks an
+encoded receiver (or vice versa).
+
+This module is the NumPy host path — no JAX dependency on the hot
+arrays, so the OS-process federation runs without a device.
+``codec/device.py`` holds the jitted encode math and the pure
+``lossy_roundtrip`` the simulated engines use; the two paths produce
+bitwise-identical decoded values (pinned in tests/test_codec.py).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, replace
+from typing import Any
+
+import numpy as np
+
+PyTree = Any
+
+#: frame magic + version: the tagged-body contract. Bump the version on
+#: any incompatible layout change; decoders reject unknown versions
+#: loudly instead of mis-parsing.
+FRAME_KEY = "__nidt_codec__"
+FRAME_VERSION = 1
+
+_QUANT_MODES = ("", "int8", "bf16")
+# sparse-record modes: how the receiver learns the support
+_SP_DENSE = 0      # all values shipped
+_SP_BITMAP = 1     # packed bitmap frame precedes the values
+_SP_SHARED = 2     # receiver holds the same mask (engine mask handoff)
+
+
+@dataclass(frozen=True)
+class WireSpec:
+    """Parsed ``--wire_codec`` value. Hashable (jit-static) and order-
+    insensitive: ``"quant+delta" == "delta+quant"``."""
+
+    delta: bool = False
+    sparse: bool = False
+    quant: str = ""            # "" | "int8" | "bf16"
+    topk_ratio: float = 0.25   # top-k keep fraction when sparse w/o masks
+
+    @property
+    def canonical(self) -> str:
+        parts = ([p for p, on in (("delta", self.delta),
+                                  ("sparse", self.sparse)) if on]
+                 + ([{"int8": "quant", "bf16": "quant16"}[self.quant]]
+                    if self.quant else []))
+        return "+".join(parts) if parts else "none"
+
+    @property
+    def needs_ef(self) -> bool:
+        """Error feedback applies only to lossy TOP-K sparsification;
+        mask-mode sparsity drops entries the engine's own training
+        already pins to zero, so there is no mass to feed back."""
+        return self.sparse
+
+
+def parse_wire_spec(text: str, topk_ratio: float = 0.25) -> WireSpec | None:
+    """``none | delta | sparse | quant | quant16`` joined by ``+`` in any
+    order -> WireSpec, or None for "none"/empty (dense wire)."""
+    text = (text or "none").strip().lower()
+    if text in ("", "none"):
+        return None
+    spec = WireSpec(topk_ratio=float(topk_ratio))
+    for tok in text.split("+"):
+        tok = tok.strip()
+        if tok == "delta":
+            spec = replace(spec, delta=True)
+        elif tok == "sparse":
+            spec = replace(spec, sparse=True)
+        elif tok in ("quant", "int8", "quant8"):
+            spec = replace(spec, quant="int8")
+        elif tok in ("quant16", "bf16"):
+            spec = replace(spec, quant="bf16")
+        elif tok in ("", "none"):
+            raise ValueError(
+                f"--wire_codec {text!r}: 'none' cannot compose with "
+                "other stages")
+        else:
+            raise ValueError(
+                f"--wire_codec {text!r}: unknown stage {tok!r} (have "
+                "delta | sparse | quant | quant16)")
+    if not 0.0 < spec.topk_ratio <= 1.0:
+        raise ValueError(
+            f"wire_topk_ratio ({spec.topk_ratio}) must be in (0, 1]")
+    return spec
+
+
+def is_codec_frame(obj: Any) -> bool:
+    return isinstance(obj, dict) and FRAME_KEY in obj
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> named flat leaves (decode rebuilds against a template tree,
+# so the frame never needs to carry a treedef)
+# ---------------------------------------------------------------------------
+
+def _named_leaves(tree: PyTree) -> list[tuple[str, np.ndarray]]:
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        out.append((name, leaf))
+    return out
+
+
+def _rebuild_like(template: PyTree, by_name: dict[str, np.ndarray]) -> PyTree:
+    import jax
+
+    def build(path, leaf):
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        if name not in by_name:
+            raise ValueError(
+                f"codec frame is missing leaf {name!r} present in the "
+                "template tree — sender/receiver model structures differ")
+        return by_name[name]
+
+    return jax.tree_util.tree_map_with_path(build, template)
+
+
+# ---------------------------------------------------------------------------
+# shared encode math (float32 numpy; codec/device.py mirrors it in jnp —
+# the two must stay bitwise-aligned, tests/test_codec.py pins it)
+# ---------------------------------------------------------------------------
+
+def _topk_threshold_np(absflat: np.ndarray, k: int) -> np.float32:
+    """Exact k-th largest of a 1-D float32 vector — same tie semantics as
+    ops/topk.kth_largest: a ``|x| >= thr`` mask keeps >= k entries."""
+    k = min(max(int(k), 1), absflat.size)
+    return np.partition(absflat, absflat.size - k)[absflat.size - k]
+
+
+def _quant_encode(vals: np.ndarray, quant: str) -> tuple[np.ndarray, float]:
+    """Kept values -> wire values + per-leaf scale (int8 symmetric)."""
+    if quant == "int8":
+        amax = np.float32(np.max(np.abs(vals))) if vals.size else np.float32(0)
+        scale = np.float32(amax / np.float32(127.0)) if amax > 0 \
+            else np.float32(1.0)
+        q = np.clip(np.rint(vals / scale), -127, 127).astype(np.int8)
+        return q, float(scale)
+    if quant == "bf16":
+        import ml_dtypes
+
+        return vals.astype(ml_dtypes.bfloat16).view(np.uint16), 0.0
+    return vals, 0.0
+
+
+def _quant_decode(wire_vals: np.ndarray, quant: str,
+                  scale: float) -> np.ndarray:
+    if quant == "int8":
+        return wire_vals.astype(np.float32) * np.float32(scale)
+    if quant == "bf16":
+        import ml_dtypes
+
+        return np.asarray(wire_vals, np.uint16).view(
+            ml_dtypes.bfloat16).astype(np.float32)
+    return np.asarray(wire_vals, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# encode / decode
+# ---------------------------------------------------------------------------
+
+def encode_update(spec: WireSpec, update: PyTree, *,
+                  reference: PyTree | None = None,
+                  masks: PyTree | None = None,
+                  ef: PyTree | None = None,
+                  mask_on_wire: bool = True,
+                  zlib_level: int = 6,
+                  backend: str = "numpy") -> tuple[dict, PyTree | None]:
+    """Encode one model update into a wire frame.
+
+    Returns ``(frame, new_ef)``. ``new_ef`` is the next round's
+    error-feedback accumulator (top-k mode only; None otherwise — pass it
+    back in on the next call). ``reference`` is required when
+    ``spec.delta`` (the round's broadcast model the receiver also holds);
+    ``masks`` switches the sparse stage to mask mode; ``mask_on_wire``
+    False elides the bitmap frame for masks the receiver provably owns
+    (engine mask handoff — the frame then flags shared-mask mode and the
+    receiver must supply the identical mask to ``decode_update``).
+    ``backend="jax"`` runs the residual/EF/top-k math as one jitted
+    program (codec/device.py — the Pallas histogram select on TPU) and
+    keeps only the variable-length packing on the host; "numpy" is the
+    device-free fallback the OS-process federation uses. Both produce
+    byte-identical frames.
+    """
+    from flax import serialization
+
+    if spec.delta and reference is None:
+        raise ValueError("wire codec: delta stage needs the round's "
+                         "broadcast reference tree")
+    upd = _named_leaves(update)
+    refs = dict(_named_leaves(reference)) if reference is not None else {}
+    mask_by = dict(_named_leaves(masks)) if masks is not None else {}
+    track_ef = spec.sparse and masks is None
+
+    keep_by: dict[str, np.ndarray] = {}
+    new_ef: dict[str, np.ndarray] = {}
+    if backend == "jax":
+        from neuroimagedisttraining_tpu.codec import device as D
+
+        x_tree, keep_tree, ef_tree_dev = D.encode_math(
+            spec, update, reference=reference, masks=masks, ef=ef)
+        residuals = {name: np.asarray(v)
+                     for name, v in _named_leaves(x_tree)}
+        if keep_tree is not None:
+            keep_by = {name: np.asarray(v)
+                       for name, v in _named_leaves(keep_tree)}
+        if ef_tree_dev is not None:
+            new_ef = {name: np.asarray(v)
+                      for name, v in _named_leaves(ef_tree_dev)}
+    else:
+        ef_by = dict(_named_leaves(ef)) if ef is not None else {}
+        # residuals (+ error feedback) per leaf, then the GLOBAL top-k
+        # threshold across every leaf (cross-layer, like the SNIP mask)
+        residuals = {}
+        for name, leaf in upd:
+            x = np.asarray(leaf, np.float32)
+            if spec.delta:
+                x = x - np.asarray(refs[name], np.float32)
+            if track_ef and name in ef_by:
+                x = x + np.asarray(ef_by[name], np.float32)
+            residuals[name] = x
+        if spec.sparse:
+            if masks is not None:
+                keep_by = {name: np.asarray(m) > 0
+                           for name, m in mask_by.items()}
+            else:
+                flat = np.concatenate([np.abs(v).reshape(-1)
+                                       for v in residuals.values()])
+                k = max(1, int(np.ceil(spec.topk_ratio * flat.size)))
+                thr = _topk_threshold_np(flat, k)
+                keep_by = {name: np.abs(v) >= thr
+                           for name, v in residuals.items()}
+
+    leaves: dict[str, dict] = {}
+    for name, leaf in upd:
+        x = residuals[name]
+        rec: dict[str, Any] = {"sh": list(x.shape), "dt": str(
+            np.asarray(leaf).dtype)}
+        if spec.sparse:
+            keep = keep_by[name]
+            if masks is not None:
+                rec["sp"] = _SP_SHARED if not mask_on_wire else _SP_BITMAP
+                # mask-zero semantics: the engine's training pins
+                # off-mask entries to exact zero, so the decoder must
+                # reconstruct 0 there — not the delta reference (round
+                # 0's dense init would otherwise survive off-mask)
+                rec["mz"] = 1
+            else:
+                rec["sp"] = _SP_BITMAP
+            if rec["sp"] == _SP_BITMAP:
+                if keep.all():
+                    rec["sp"] = _SP_DENSE  # bitmap would be pure overhead
+                else:
+                    rec["bm"] = np.packbits(keep.reshape(-1))
+            kept = x.reshape(-1)[keep.reshape(-1)]
+        else:
+            keep = None
+            kept = x.reshape(-1)
+        wire_vals, scale = _quant_encode(kept, spec.quant)
+        rec["q"] = spec.quant
+        if spec.quant == "int8":
+            rec["sc"] = scale
+        rec["v"] = wire_vals
+        leaves[name] = rec
+        if track_ef and backend != "jax":  # jax backend computed EF on device
+            deq = np.zeros(x.size, np.float32)
+            pos = keep.reshape(-1) if keep is not None else slice(None)
+            deq[pos] = _quant_decode(wire_vals, spec.quant, scale)
+            new_ef[name] = x - deq.reshape(x.shape)
+
+    body = serialization.msgpack_serialize({"leaves": leaves})
+    packed = zlib.compress(body, zlib_level)
+    z = 1 if len(packed) < len(body) else 0
+    frame = {FRAME_KEY: FRAME_VERSION, "spec": spec.canonical,
+             "delta": int(spec.delta), "z": z,
+             "body": np.frombuffer(packed if z else body, np.uint8)}
+    ef_tree = (_rebuild_like(update, new_ef) if track_ef else None)
+    return frame, ef_tree
+
+
+def decode_update(obj: Any, *, like: PyTree,
+                  reference: PyTree | None = None,
+                  masks: PyTree | None = None) -> PyTree:
+    """Decode a wire frame back into a pytree shaped like ``like``.
+
+    Dense fallback: anything without the frame magic passes through
+    unchanged, so a receiver never needs to know the sender's codec
+    config. ``reference`` is required for delta frames; ``masks`` for
+    shared-mask frames (both fail loudly when absent).
+    """
+    from flax import serialization
+
+    if not is_codec_frame(obj):
+        return obj  # dense fallback: always decodable
+    ver = obj[FRAME_KEY]
+    if int(ver) != FRAME_VERSION:
+        raise ValueError(f"wire codec frame version {ver} != supported "
+                         f"{FRAME_VERSION}")
+    raw = np.asarray(obj["body"], np.uint8).tobytes()
+    if int(obj.get("z", 0)):
+        raw = zlib.decompress(raw)
+    leaves = serialization.msgpack_restore(raw)["leaves"]
+    delta = bool(int(obj.get("delta", 0)))
+    if delta and reference is None:
+        raise ValueError("wire codec: delta frame needs the round's "
+                         "broadcast reference to decode")
+    refs = dict(_named_leaves(reference)) if reference is not None else {}
+    mask_by = dict(_named_leaves(masks)) if masks is not None else {}
+
+    out: dict[str, np.ndarray] = {}
+    for name, rec in leaves.items():
+        shape = tuple(int(s) for s in rec["sh"])
+        size = int(np.prod(shape)) if shape else 1
+        vals = _quant_decode(rec["v"], rec.get("q", ""),
+                             float(rec.get("sc", 0.0)))
+        sp = int(rec.get("sp", _SP_DENSE))
+        if sp == _SP_DENSE:
+            flat = vals.astype(np.float32)
+            keep = None
+        else:
+            if sp == _SP_SHARED:
+                if name not in mask_by:
+                    raise ValueError(
+                        f"wire codec: frame for leaf {name!r} uses "
+                        "shared-mask mode but the receiver holds no mask "
+                        "— configure the same engine mask on both "
+                        "endpoints (mask handoff)")
+                keep = (np.asarray(mask_by[name]) > 0).reshape(-1)
+            else:
+                keep = np.unpackbits(np.asarray(rec["bm"], np.uint8),
+                                     count=size).astype(bool)
+            flat = np.zeros(size, np.float32)
+            flat[keep] = vals
+        x = flat.reshape(shape)
+        if delta:
+            ref = np.asarray(refs[name], np.float32)
+            if keep is not None and int(rec.get("mz", 0)):
+                x = np.where(keep.reshape(shape), x + ref, np.float32(0.0))
+            else:
+                x = x + ref
+        out[name] = x.astype(rec.get("dt", "float32"))
+    return _rebuild_like(like, out)
+
+
+def frame_nbytes(frame: dict) -> int:
+    """Exact on-the-wire size of a frame (or dense tree) once the message
+    envelope serializes it — the codec A/B's numerator/denominator."""
+    from flax import serialization
+
+    import jax
+
+    as_np = jax.tree.map(
+        lambda v: np.asarray(v) if hasattr(v, "shape") else v, frame)
+    return len(serialization.msgpack_serialize(as_np))

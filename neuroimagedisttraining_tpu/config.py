@@ -116,6 +116,15 @@ class FedConfig:
     # config seed replays the identical fault trace in the simulated
     # engines AND the multiprocess federation.
     fault_spec: str = ""
+    # Model-update wire codec (codec/, ISSUE 3): stages joined by '+'
+    # from {delta, sparse, quant, quant16} or "none" (dense wire). In
+    # the simulated engines the codec's lossy value transform is applied
+    # to client updates BEFORE aggregation (jitted, codec/device.py) so
+    # an in-process run aggregates exactly what a cross-silo federation
+    # shipping encoded frames would; bytes ride stat_info
+    # ("sum_comm_bytes" encoded vs "sum_comm_bytes_dense").
+    wire_codec: str = "none"
+    wire_topk_ratio: float = 0.25  # top-k keep fraction for dense engines
     round_deadline: float = 0.0    # s; >0 arms the cross-silo per-round deadline
     quorum: int = 0                # min uploads to aggregate at deadline; 0 = all
     heartbeat_interval: float = 0.0  # s; >0 makes silo clients beat liveness

@@ -234,6 +234,7 @@ class BrokerCommManager(QueueDispatchMixin, BaseCommManager):
                 self._stop_dispatch()
                 return
             try:
+                self._count_recv(len(frame[2]))
                 self._enqueue(Message.from_bytes(frame[2]))
             except Exception as e:  # noqa: BLE001 — framing is intact, so
                 # a bad payload is droppable without desyncing the stream
@@ -247,9 +248,10 @@ class BrokerCommManager(QueueDispatchMixin, BaseCommManager):
             t = f"{self._topic}0_{msg.receiver_id}"
         else:
             t = f"{self._topic}{self.client_id}"
+        raw = msg.to_bytes()
         with self._send_lock:
             try:
-                _write_frame(self._conn, _OP_PUB, t, msg.to_bytes())
+                _write_frame(self._conn, _OP_PUB, t, raw)
             except OSError:
                 # a failed/timed-out sendall may have written a PARTIAL
                 # frame — the stream is desynced and must not be reused;
@@ -259,6 +261,10 @@ class BrokerCommManager(QueueDispatchMixin, BaseCommManager):
                 except OSError:
                     pass
                 raise
+        # count only frames that actually reached the wire (parity with
+        # the socket transport's after-sendall accounting): chaos-killed
+        # sends must not inflate the A/B byte numbers
+        self._count_sent(len(raw))
 
     def stop_receive_message(self) -> None:
         self._stop_dispatch()

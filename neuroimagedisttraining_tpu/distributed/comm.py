@@ -69,13 +69,41 @@ class QueueDispatchMixin:
     """Shared receive-side machinery for every transport: observer list,
     blocking message queue, sentinel shutdown. Subclasses feed the queue
     from their listener thread via ``_enqueue`` and call ``_stop_dispatch``
-    on teardown."""
+    on teardown.
+
+    Also owns the transport-agnostic BYTE ACCOUNTING the wire-codec A/B
+    reads (`scripts/run_wire_bench.sh`): subclasses report each frame's
+    on-the-wire size via ``_count_sent``/``_count_recv`` (listener thread
+    and sender threads race, hence the dedicated lock) and
+    ``byte_stats()`` returns the totals."""
 
     _STOP = object()
 
     def _init_dispatch(self) -> None:
         self._observers: list[Observer] = []
         self._q: queue.Queue = queue.Queue()
+        self._stats_lock = threading.Lock()
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.frames_sent = 0
+        self.frames_recv = 0
+
+    def _count_sent(self, n: int) -> None:
+        with self._stats_lock:
+            self.bytes_sent += int(n)
+            self.frames_sent += 1
+
+    def _count_recv(self, n: int) -> None:
+        with self._stats_lock:
+            self.bytes_recv += int(n)
+            self.frames_recv += 1
+
+    def byte_stats(self) -> dict[str, int]:
+        with self._stats_lock:
+            return {"bytes_sent": self.bytes_sent,
+                    "bytes_recv": self.bytes_recv,
+                    "frames_sent": self.frames_sent,
+                    "frames_recv": self.frames_recv}
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -149,6 +177,7 @@ class SocketCommManager(QueueDispatchMixin, BaseCommManager):
                     raw = _recv_exact(conn, length)
                     if raw is None:
                         continue
+                self._count_recv(length + 8)
                 self._enqueue(Message.from_bytes(raw))
             except Exception as e:  # noqa: BLE001 — any bad peer data
                 # (wrong schema -> TypeError/KeyError, msgpack OutOfData,
@@ -188,6 +217,7 @@ class SocketCommManager(QueueDispatchMixin, BaseCommManager):
             try:
                 with socket.create_connection(addr, timeout=10.0) as conn:
                     conn.sendall(struct.pack("!Q", len(raw)) + raw)  # nidt: allow[lock-send] -- conn is a fresh per-frame connection local to this call; no concurrent writer exists
+                self._count_sent(len(raw) + 8)
                 return
             except OSError as e:
                 last_err = e

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from neuroimagedisttraining_tpu.codec import wire as codec
 from neuroimagedisttraining_tpu.distributed import message as M
 from neuroimagedisttraining_tpu.distributed.managers import (
     ClientManager, ServerManager,
@@ -103,14 +104,30 @@ class FedAvgServer(ServerManager):
     - a suspect client that re-registers is shipped the current round's
       model directly (late rejoin) and leaves the suspect set; a fresh
       upload or heartbeat also clears suspicion.
+
+    Wire codec (ISSUE 3): uploads may arrive as tagged codec frames
+    (codec/wire.py) instead of dense pytrees; ``_on_model`` decodes them
+    BEFORE the weighted aggregation, against ``self.params`` — the
+    round's broadcast model, which the round-tag accept gate guarantees
+    is the delta reference the sender used. The DOWNLINK sync stays
+    dense by design: a late-rejoining or deadline-skipped client has no
+    agreed delta reference, and a dense broadcast means the reference
+    chain can never desync under chaos (drops/dups/restarts).
+    ``wire_masks`` is the engine mask handoff for shared-mask frames —
+    the same pruning mask the encoding silos hold (e.g. SalientGrads'
+    phase-1 global mask), letting them ship surviving values with no
+    bitmap at all.
     """
 
     def __init__(self, init_params, comm_round: int, num_clients: int,
                  world_size: int | None = None, round_deadline: float = 0.0,
-                 quorum: int = 0, heartbeat_timeout: float = 0.0, **kw):
+                 quorum: int = 0, heartbeat_timeout: float = 0.0,
+                 wire_masks=None, **kw):
         super().__init__(rank=0, world_size=world_size or num_clients + 1,
                          **kw)
         self.params = _to_numpy_tree(init_params)
+        self.wire_masks = (_to_numpy_tree(wire_masks)
+                           if wire_masks is not None else None)
         self.comm_round = comm_round
         self.num_clients = num_clients
         self.round_deadline = float(round_deadline)
@@ -199,9 +216,30 @@ class FedAvgServer(ServerManager):
         with self._rlock:
             if self._done.is_set() or not self._accept_update(msg):
                 return
+            # decode BEFORE aggregation: self.params is still the round's
+            # broadcast model here (it only advances in
+            # _aggregate_and_advance), so it IS the sender's delta
+            # reference; the accept gate above already rejected any frame
+            # from another round. Dense uploads pass through untouched.
+            try:
+                decoded = codec.decode_update(msg.get(M.ARG_MODEL_PARAMS),
+                                              like=self.params,
+                                              reference=self.params,
+                                              masks=self.wire_masks)
+            except Exception as e:  # noqa: BLE001 — an undecodable frame
+                # (version skew, mask-config mismatch, zlib.error /
+                # msgpack OutOfData from bit rot the transport let
+                # through) is a DROPPED upload, not a dead dispatch
+                # thread — the deadline/quorum machinery treats the
+                # sender like any other straggler. Narrow catches here
+                # would let a malformed body kill server.run() (the
+                # dispatch loop has no guard of its own).
+                log.warning("server: dropping undecodable upload from %d "
+                            "(round %d): %s", msg.sender_id,
+                            self.round_idx, e)
+                return
             self._updates[msg.sender_id] = (
-                msg.get(M.ARG_MODEL_PARAMS),
-                float(msg.get(M.ARG_NUM_SAMPLES)))
+                decoded, float(msg.get(M.ARG_NUM_SAMPLES)))
             self._last_beat[msg.sender_id] = time.monotonic()
             self._suspect.discard(msg.sender_id)
             self._maybe_complete()
@@ -396,6 +434,19 @@ class SecureFedAvgServer(FedAvgServer):
     def __init__(self, init_params, comm_round: int, num_clients: int,
                  frac_bits: int = 16, n_aggregators: int = 0,
                  record_trace: bool = False, **kw):
+        if kw.get("wire_masks") is not None:
+            # Secure aggregation stays DENSE by design: each upload is a
+            # tree of additive share slots — uniformly random GF(p)
+            # residues. Delta/quantization would destroy the share
+            # algebra (the slots must sum mod p to the quantized
+            # weighted update), and any sparsification would leak the
+            # client's mask support, the very structure the additive
+            # masking hides. The wire codec therefore never composes
+            # with --secure (distributed/run.py rejects the flag combo).
+            raise ValueError(
+                "SecureFedAvgServer is incompatible with the wire codec "
+                "(shares are uniform field elements; encoding them would "
+                "break the share algebra or leak mask support)")
         super().__init__(init_params, comm_round, num_clients,
                          world_size=num_clients + 1 + n_aggregators, **kw)
         self.frac_bits = frac_bits
@@ -662,11 +713,22 @@ class FedAvgClientProc(ClientManager):
     ``heartbeat_interval`` > 0 starts a liveness thread beating to the
     server every interval — the signal the server's suspicion machinery
     (``heartbeat_timeout``) consumes. Uploads echo the sync's round
-    index so the server can reject stale/duplicate frames."""
+    index so the server can reject stale/duplicate frames.
+
+    ``wire_codec`` encodes every model upload (codec/wire.py): delta vs
+    the sync just received, mask-sparse against ``wire_masks`` (shipped
+    bitmap-free — the server holds the same mask via its own
+    ``wire_masks``, the engine mask handoff), or top-k sparse with this
+    silo's persistent error-feedback accumulator ``_wire_ef`` threaded
+    across rounds (dropped mass and quantization error re-enter the next
+    round's residual, EF-SGD semantics). A dropped upload loses one
+    round's kept mass like any dense upload would; the EF state itself
+    never desyncs because it lives entirely on this sender."""
 
     def __init__(self, rank: int, num_clients: int,
                  train_fn: Callable, world_size: int | None = None,
-                 heartbeat_interval: float = 0.0, **kw):
+                 heartbeat_interval: float = 0.0, wire_codec: str = "none",
+                 wire_masks=None, wire_topk_ratio: float = 0.25, **kw):
         super().__init__(rank=rank, world_size=world_size or num_clients + 1,
                          **kw)
         self.num_clients = num_clients
@@ -674,6 +736,10 @@ class FedAvgClientProc(ClientManager):
         self.heartbeat_interval = float(heartbeat_interval)
         self.final_params = None
         self._hb_stop = threading.Event()
+        self._wire_spec = codec.parse_wire_spec(wire_codec, wire_topk_ratio)
+        self.wire_masks = (_to_numpy_tree(wire_masks)
+                           if wire_masks is not None else None)
+        self._wire_ef = None  # per-silo error-feedback accumulator
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -717,8 +783,17 @@ class FedAvgClientProc(ClientManager):
         params = msg.get(M.ARG_MODEL_PARAMS)
         round_idx = int(msg.get(M.ARG_ROUND_IDX))
         new_params, n = self.train_fn(params, round_idx)
+        payload = _to_numpy_tree(new_params)
+        if self._wire_spec is not None:
+            # the delta reference is the sync we JUST trained from — the
+            # server holds the identical tree for this round tag
+            payload, self._wire_ef = codec.encode_update(
+                self._wire_spec, payload,
+                reference=_to_numpy_tree(params),
+                masks=self.wire_masks, ef=self._wire_ef,
+                mask_on_wire=False)
         out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
-        out.add(M.ARG_MODEL_PARAMS, _to_numpy_tree(new_params))
+        out.add(M.ARG_MODEL_PARAMS, payload)
         out.add(M.ARG_NUM_SAMPLES, float(n))
         out.add(M.ARG_ROUND_IDX, round_idx)
         self.send_message(out)
@@ -743,6 +818,13 @@ class SecureFedAvgClientProc(FedAvgClientProc):
             raise ValueError(
                 f"n_aggregators ({n_aggregators}) must equal n_shares "
                 f"({n_shares}): slot j routes to aggregator j")
+        if kw.get("wire_codec", "none") != "none" or \
+                kw.get("wire_masks") is not None:
+            raise ValueError(
+                "SecureFedAvgClientProc is incompatible with the wire "
+                "codec: share slots must ride the wire dense (see "
+                "SecureFedAvgServer — encoding breaks the GF(p) share "
+                "algebra or leaks mask support)")
         super().__init__(rank, num_clients, train_fn,
                          world_size=num_clients + 1 + n_aggregators, **kw)
         self.n_shares = n_shares

@@ -11,6 +11,17 @@ dict plus a flax-msgpack body for array payloads — so a 2.6 M-param model is
 Message-type constants keep the reference protocol contract
 (SURVEY.md §5.8): init/broadcast params -> local train -> upload update ->
 aggregate, plus register/finish lifecycle.
+
+Wire codec (ISSUE 3): an ``ARG_MODEL_PARAMS`` value may be either the
+dense pytree (the format above — always understood) or a TAGGED BODY
+FRAME produced by ``codec/wire.py``: a dict carrying the magic key
+``codec.FRAME_KEY`` with a version int, a spec string, and one
+zlib-deflated msgpack blob of per-leaf records (delta residuals,
+mask-sparse packed values + bitmap, int8/bf16 quantized values with
+per-leaf scales). The frame rides this envelope unchanged — msgpack
+serializes the dict like any payload — and receivers route through
+``codec.decode_update``, which passes dense trees through untouched, so
+a dense sender and an encoded sender interoperate on one control plane.
 """
 
 from __future__ import annotations

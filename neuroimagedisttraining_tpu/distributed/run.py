@@ -42,10 +42,19 @@ from ``--seed``; ``--round_deadline``/``--quorum`` let the server
 aggregate survivor subsets instead of hanging on a dead silo, and
 ``--heartbeat_interval``/``--heartbeat_timeout`` drive the suspicion
 machinery. ``scripts/run_chaos_smoke.sh`` exercises the kill-k scenario
-end-to-end on both transports. This is the cross-silo deployment shape: bulk
-per-silo compute on each silo's own accelerator(s), small model payloads
-on the control plane (on a TPU pod, prefer --multihost_coordinator on
-the main CLI so bulk tensors ride ICI/DCN collectives instead).
+end-to-end on both transports.
+
+Wire codec (ISSUE 3): ``--wire_codec delta+sparse+quant`` makes every
+silo upload a tagged codec frame (codec/) — delta vs the round's sync,
+sparse packing, int8/bf16 quantization — which the server decodes before
+aggregation; ``--wire_mask_density 0.5`` additionally emulates the
+masked-engine deployment (every rank derives the same seeded mask, silos
+train masked, frames ship bitmap-free). ``scripts/run_wire_bench.sh``
+A/Bs the bytes-on-wire against the dense format using the transports'
+byte counters. This is the cross-silo deployment shape: bulk per-silo
+compute on each silo's own accelerator(s), small model payloads on the
+control plane (on a TPU pod, prefer --multihost_coordinator on the main
+CLI so bulk tensors ride ICI/DCN collectives instead).
 """
 
 from __future__ import annotations
@@ -92,9 +101,65 @@ def _build_shard(args, rank: int):
     return X, y, len(idx)
 
 
+def _seed_init_state(args):
+    """``(trainer, init ClientState)`` — every rank derives the identical
+    model from ``--seed``, so init broadcast, delta references, and wire
+    masks agree across processes with no extra exchange."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.config import OptimConfig
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.models import create_model
+
+    trainer = LocalTrainer(
+        create_model(args.model, num_classes=args.num_classes),
+        OptimConfig(), num_classes=args.num_classes)
+    if args.dataset == "synthetic":
+        shape = (1,) + tuple(args.synthetic_shape)
+    else:
+        from neuroimagedisttraining_tpu.data.hdf5 import load_abcd_hdf5
+
+        X0 = load_abcd_hdf5(args.data_dir, lazy=True)
+        shape = (1,) + tuple(X0["X"].shape[1:])
+        X0["file"].close()
+    gs = trainer.init_client_state(jax.random.key(args.seed),
+                                   jnp.zeros(shape, jnp.float32))
+    return trainer, gs
+
+
+def _build_wire_masks(args, gs=None):
+    """Deterministic shared pruning mask for ``--wire_mask_density``: the
+    masked-engine deployment shape (SalientGrads ships its phase-1 global
+    mask to every silo) emulated with a seeded uniform mask every rank
+    derives identically — the codec's mask handoff then packs uploads
+    bitmap-free (codec/wire.py shared-mask mode). Pass ``gs`` when the
+    caller already derived the seed-deterministic init state (the server
+    does — no second model build/jit)."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.ops import masks as Mk
+
+    if gs is None:
+        _, gs = _seed_init_state(args)
+    sp = Mk.calculate_sparsities(gs.params, "uniform",
+                                 dense_ratio=args.wire_mask_density)
+    pm = Mk.init_masks(jax.random.key(args.seed + 97), gs.params, sp)
+    tree = {"params": pm,
+            "batch_stats": jax.tree.map(jnp.ones_like, gs.batch_stats)}
+    return jax.tree.map(np.asarray, tree)
+
+
 def _make_train_fn(args):
-    """Silo-local training closure: jitted LocalTrainer epochs on this
-    silo's shard (fedavg my_model_trainer semantics, round-decayed lr)."""
+    """``(train_fn, wire_masks)``: the silo-local training closure —
+    jitted LocalTrainer epochs on this silo's shard (fedavg
+    my_model_trainer semantics, round-decayed lr) — plus the shared wire
+    mask when ``--wire_mask_density`` is set, derived from THIS
+    trainer's seed-deterministic init (one model build per client, not
+    two). With a mask the silo trains MASKED (post-step re-mask, the
+    SalientGrads/DisPFL client shape) so its uploads are sparse by
+    construction — the deployment the codec's mask-sparse stage packs."""
     import jax
     import jax.numpy as jnp
 
@@ -108,7 +173,18 @@ def _make_train_fn(args):
     trainer = LocalTrainer(create_model(args.model,
                                         num_classes=args.num_classes),
                            optim, num_classes=args.num_classes)
+    wire_masks = None
+    if args.wire_mask_density > 0:
+        # derive the shared mask from THIS trainer's init state (the
+        # seed-deterministic params every rank agrees on) instead of a
+        # second model build + jitted init inside _build_wire_masks
+        gs = trainer.init_client_state(
+            jax.random.key(args.seed),
+            jnp.zeros((1,) + X.shape[1:], jnp.float32))
+        wire_masks = _build_wire_masks(args, gs)
     Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    mask_d = (jax.tree.map(jnp.asarray, wire_masks["params"])
+              if wire_masks is not None else None)
 
     @jax.jit
     def step(params, bstats, rng, lr):
@@ -116,7 +192,8 @@ def _make_train_fn(args):
                          opt_state=trainer.opt.init(params), rng=rng)
         cs, loss = trainer.local_train(
             cs, Xd, yd, n, lr, epochs=optim.epochs,
-            batch_size=optim.batch_size, max_samples=Xd.shape[0])
+            batch_size=optim.batch_size, max_samples=Xd.shape[0],
+            mask=mask_d)
         return cs.params, cs.batch_stats, loss
 
     def train_fn(params_np, round_idx):
@@ -132,7 +209,7 @@ def _make_train_fn(args):
         return {"params": jax.tree.map(np.asarray, p),
                 "batch_stats": jax.tree.map(np.asarray, b)}, float(n)
 
-    return train_fn
+    return train_fn, wire_masks
 
 
 def _make_comm(args, rank: int, host_map):
@@ -243,6 +320,23 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat_timeout", type=float, default=0.0,
                     help="server: mark a client suspect once its "
                          "heartbeat is older than this (0 = off)")
+    ap.add_argument("--wire_codec", type=str, default="none",
+                    help="model-update wire codec (codec/): stages "
+                         "joined by '+', e.g. none | delta | sparse | "
+                         "quant | delta+sparse+quant (quant16 = bf16). "
+                         "Uploads ride as tagged frames the server "
+                         "decodes before aggregation; the downlink sync "
+                         "stays dense (reference-chain safety)")
+    ap.add_argument("--wire_topk_ratio", type=float, default=0.25,
+                    help="sparse stage without masks: keep fraction for "
+                         "magnitude top-k (error-feedback accumulated "
+                         "per silo)")
+    ap.add_argument("--wire_mask_density", type=float, default=0.0,
+                    help="> 0 emulates a masked engine deployment: every "
+                         "rank derives the same seeded pruning mask at "
+                         "this density, silos train masked, and the "
+                         "codec's sparse stage packs uploads bitmap-free "
+                         "(mask handoff). 0 = dense training")
     ap.add_argument("--secure", action="store_true",
                     help="TurboAggregate additive-share aggregation over "
                          "the control plane")
@@ -286,6 +380,23 @@ def main(argv=None) -> int:
         ap.error("--transport broker routes messages through the MQTT "
                  "topic scheme (server <-> client only); the grouped "
                  "multi-aggregator deployment needs --transport socket")
+    if args.secure and (args.wire_codec != "none"
+                        or args.wire_mask_density > 0):
+        ap.error("--secure shares must ride the wire dense: the codec "
+                 "would break the GF(p) share algebra or leak mask "
+                 "support (see cross_silo.SecureFedAvgServer)")
+    if not 0.0 <= args.wire_mask_density < 1.0:
+        ap.error(f"--wire_mask_density ({args.wire_mask_density}) must "
+                 "be in [0, 1)")
+    try:
+        # fail fast on EVERY rank: only clients parse the spec at
+        # runtime, and a typo'd spec crashing the clients would leave
+        # the server blocked forever in the registration barrier
+        from neuroimagedisttraining_tpu.codec import parse_wire_spec
+
+        parse_wire_spec(args.wire_codec, args.wire_topk_ratio)
+    except ValueError as e:
+        ap.error(str(e))
     if args.round_deadline > 0 and args.quorum == 0:
         args.quorum = args.num_clients // 2 + 1  # simple majority
     if args.heartbeat_timeout > 0 and not (
@@ -323,31 +434,18 @@ def main(argv=None) -> int:
     if args.role == "server":
         import jax
 
-        from neuroimagedisttraining_tpu.config import OptimConfig
-        from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
-        from neuroimagedisttraining_tpu.models import create_model
-
-        # seed-deterministic init: every process derives the same model
-        trainer = LocalTrainer(
-            create_model(args.model, num_classes=args.num_classes),
-            OptimConfig(), num_classes=args.num_classes)
-        shape = ((1,) + tuple(args.synthetic_shape)
-                 if args.dataset == "synthetic" else None)
-        if shape is None:
-            from neuroimagedisttraining_tpu.data.hdf5 import load_abcd_hdf5
-
-            X0 = load_abcd_hdf5(args.data_dir, lazy=True)
-            shape = (1,) + tuple(X0["X"].shape[1:])
-            X0["file"].close()
-        import jax.numpy as jnp
-
-        gs = trainer.init_client_state(jax.random.key(args.seed),
-                                       jnp.zeros(shape, jnp.float32))
+        # seed-deterministic init: every process derives the same model;
+        # the wire mask (when configured) derives from the SAME state —
+        # one model build, one jitted init
+        _, gs = _seed_init_state(args)
+        wire_masks = (_build_wire_masks(args, gs)
+                      if args.wire_mask_density > 0 else None)
         init = {"params": jax.tree.map(np.asarray, gs.params),
                 "batch_stats": jax.tree.map(np.asarray, gs.batch_stats)}
         cls = SecureFedAvgServer if args.secure else FedAvgServer
         kw = ({"frac_bits": args.mpc_frac_bits,
-               "n_aggregators": args.n_aggregators} if args.secure else {})
+               "n_aggregators": args.n_aggregators} if args.secure
+              else {"wire_masks": wire_masks})
         comm, broker = _make_comm(args, 0, host_map)
         server = cls(init, args.comm_round, args.num_clients,
                      base_port=args.base_port, host_map=host_map,
@@ -363,19 +461,26 @@ def main(argv=None) -> int:
         norm = float(np.sqrt(sum(
             float(np.sum(np.asarray(v, np.float64) ** 2))
             for v in jax.tree.leaves(server.params))))
+        stats = server.com_manager.byte_stats()
         print(json.dumps({"rounds_completed": len(server.history),
                           "clients": args.num_clients,
                           "secure": bool(args.secure),
                           "transport": args.transport,
+                          "wire_codec": args.wire_codec,
+                          "wire_mask_density": args.wire_mask_density,
                           "suspects": sorted(server.suspect_clients()),
-                          "final_param_norm": round(norm, 6)}), flush=True)
+                          "final_param_norm": round(norm, 6),
+                          **stats}), flush=True)
         return 0
 
-    train_fn = _make_train_fn(args)
+    train_fn, wire_masks = _make_train_fn(args)
     cls = SecureFedAvgClientProc if args.secure else FedAvgClientProc
     kw = ({"n_shares": args.mpc_n_shares, "frac_bits": args.mpc_frac_bits,
            "mpc_seed": args.seed,
-           "n_aggregators": args.n_aggregators} if args.secure else {})
+           "n_aggregators": args.n_aggregators} if args.secure
+          else {"wire_codec": args.wire_codec,
+                "wire_masks": wire_masks,
+                "wire_topk_ratio": args.wire_topk_ratio})
     comm, _ = _make_comm(args, args.rank, host_map)
     client = cls(args.rank, args.num_clients, train_fn,
                  base_port=args.base_port, host_map=host_map, comm=comm,

@@ -70,6 +70,17 @@ class DisPFLEngine(FederatedEngine):
     # host-fetched per chunk — per-client results are independent, so the
     # chunked composition equals the fused resident program.
     supports_streaming = True
+    #: current per-client masks (client-stacked), tracked for the wire
+    #: codec mask handoff
+    _masks_local = None
+
+    def wire_masks(self):
+        """Mask handoff (codec/): the CURRENT per-client masks, stacked
+        [C, ...]. Unlike SalientGrads' static global mask these evolve
+        every round (fire/regrow), so a cross-silo deployment ships the
+        bitmap frame alongside the surviving values — the receiver
+        cannot assume it holds the sender's latest mask."""
+        return self._masks_local
 
     # ---------- init ----------
 
@@ -422,6 +433,13 @@ class DisPFLEngine(FederatedEngine):
                     per_params, per_bstats, masks_local, masks_shared,
                     self.data, A, rngs, self.round_lr(round_idx),
                     jnp.float32(round_idx), plan_arrays)
+            self._masks_local = masks_local
+            if not cfg.sparsity.static:
+                # NaN-poisoned-mask diagnosability (ADVICE r5): surface
+                # an all-False evolved mask immediately instead of
+                # letting it silently zero this client's comm volume and
+                # consensus contribution from here on
+                self.warn_if_masks_collapsed(masks_local, round_idx)
             real = self.real_clients
             # comm = actual gossip edges: client c receives each neighbor
             # j != c's sparse model (nnz of j's mask + dense leaves)

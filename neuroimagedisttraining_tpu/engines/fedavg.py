@@ -24,20 +24,31 @@ import numpy as np
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.utils import pytree as pt
 
 
 class FedAvgEngine(FederatedEngine):
     name = "fedavg"
     supports_streaming = True
+    supports_wire_codec = True  # _round_body runs the codec roundtrip
 
     def _prox_kwargs(self, global_params) -> dict:
         """Extra ``local_train`` kwargs tying the local objective to the
         round's incoming global model; FedProx overrides."""
         return {}
 
-    def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr):
+    def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr, efs=None):
         """One FedAvg round over pre-gathered sampled-client shards; shared
-        by the device-resident and streaming paths."""
+        by the device-resident and streaming paths.
+
+        With ``--wire_codec`` set, every client's trained params pass
+        through the codec's jitted lossy roundtrip (delta vs the round's
+        broadcast ``params``, optional top-k with the ``efs``
+        error-feedback rows threaded per sampled client, int8/bf16
+        quantization) BEFORE defense + aggregation — the in-sim round
+        aggregates exactly what a cross-silo server would decode. The
+        extra outputs are (new_efs|None, u0 = client 0's decoded upload
+        for the host-side byte accounting)."""
         trainer = self.trainer
         o = self.cfg.optim
         S = Xs.shape[0]
@@ -61,25 +72,56 @@ class FedAvgEngine(FederatedEngine):
 
         cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
         w = ns.astype(jnp.float32)
+        client_params = cs.params
+        client_bstats = cs.batch_stats
+        new_efs = u0 = None
+        if self.wire_spec is not None:
+            from neuroimagedisttraining_tpu.codec import device as codec_dev
+
+            spec = self.wire_spec
+            # the WHOLE upload payload rides the codec — {params,
+            # batch_stats}, the exact tree FedAvgClientProc encodes
+            # (distributed/run.py), so with delta+sparse+quant the global
+            # top-k threshold sees BN running-stat residuals competing
+            # for the k slots just like the real wire, and the simulated
+            # aggregate matches the socket federation's decode
+            upload = {"params": client_params,
+                      "batch_stats": client_bstats}
+            ref = {"params": params, "batch_stats": bstats}
+            if spec.needs_ef:
+                dec, new_efs = jax.vmap(
+                    lambda u, e: codec_dev.lossy_roundtrip(
+                        spec, u, reference=ref, ef=e))(upload, efs)
+            else:
+                dec, _ = jax.vmap(
+                    lambda u: codec_dev.lossy_roundtrip(
+                        spec, u, reference=ref))(upload)
+            client_params = dec["params"]
+            client_bstats = dec["batch_stats"]
+            u0 = jax.tree.map(lambda x: x[0], dec)
         # robust defenses (norm-diff clipping / weak DP) between local train
         # and aggregation; batch_stats are never clipped (structural parity
         # with is_weight_param, robust_aggregation.py:28-29)
         f = self.cfg.fed
         client_params = robust.defend_stacked(
-            cs.params, params, defense=f.defense_type,
+            client_params, params, defense=f.defense_type,
             norm_bound=f.norm_bound, stddev=f.stddev, rngs=cs.rng)
         new_params = self.aggregate(client_params, w)
-        new_bstats = self.aggregate(cs.batch_stats, w)
+        new_bstats = self.aggregate(client_bstats, w)
         mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        if self.wire_spec is not None:
+            return new_params, new_bstats, mean_loss, new_efs, u0
         return new_params, new_bstats, mean_loss
 
     @functools.cached_property
     def _round_jit(self):
-        def round_fn(params, bstats, data, sampled_idx, rngs, lr):
+        def round_fn(params, bstats, data, sampled_idx, rngs, lr,
+                     efs=None):
             Xs = jnp.take(data.X_train, sampled_idx, axis=0)
             ys = jnp.take(data.y_train, sampled_idx, axis=0)
             ns = jnp.take(data.n_train, sampled_idx, axis=0)
-            return self._round_body(params, bstats, Xs, ys, ns, rngs, lr)
+            return self._round_body(params, bstats, Xs, ys, ns, rngs, lr,
+                                    efs)
 
         return jax.jit(round_fn)
 
@@ -137,14 +179,42 @@ class FedAvgEngine(FederatedEngine):
             gs = self.init_global_state()
             params, bstats = gs.params, gs.batch_stats
             history = []
+        codec_on = self.wire_spec is not None
+        if codec_on and self.wire_spec.needs_ef:
+            # per-client error-feedback accumulators over the FULL upload
+            # payload (params + batch_stats — what the wire encodes),
+            # threaded across rounds: rows for the sampled set ride into
+            # the jitted round and the updated rows scatter back (pads
+            # dropped)
+            self._wire_ef = jax.tree.map(
+                lambda x: jnp.zeros((self.num_clients,) + x.shape,
+                                    jnp.float32),
+                {"params": params, "batch_stats": bstats})
         for round_idx in range(start, cfg.fed.comm_round):
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
             rngs = self.per_client_rngs(round_idx, sampled)
-            params, bstats, loss = self._round_jit(
-                params, bstats, self.data, jnp.asarray(sampled), rngs,
-                self.round_lr(round_idx))
+            if codec_on:
+                ref_host = jax.tree.map(np.asarray, {"params": params,
+                                                     "batch_stats": bstats})
+                efs = (pt.tree_stack_index(self._wire_ef,
+                                           np.asarray(sampled))
+                       if self.wire_spec.needs_ef else None)
+                params, bstats, loss, new_efs, u0 = self._round_jit(
+                    params, bstats, self.data, jnp.asarray(sampled), rngs,
+                    self.round_lr(round_idx), efs)
+                if new_efs is not None:
+                    real = jnp.asarray(self._n_train_host[sampled] > 0)
+                    self._wire_ef = self.scatter_sampled_rows(
+                        self._wire_ef, new_efs, jnp.asarray(sampled),
+                        real)
+                self.account_wire_bytes(jax.tree.map(np.asarray, u0),
+                                        ref_host, None, len(sampled))
+            else:
+                params, bstats, loss = self._round_jit(
+                    params, bstats, self.data, jnp.asarray(sampled), rngs,
+                    self.round_lr(round_idx))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 m = self.eval_global(params, bstats)

@@ -45,6 +45,16 @@ class SalientGradsEngine(FederatedEngine):
     # per-batch lazy HDF5 fetch (my_model_trainer.py:185-199) done at
     # round granularity, same as FedAvg's streaming path.
     supports_streaming = True
+    supports_wire_codec = True  # masked roundtrip inside _round_body
+    #: the phase-1 global mask once generated (wire_masks handoff)
+    _wire_masks = None
+
+    def wire_masks(self):
+        """Mask handoff (codec/): the phase-1 global SNIP mask — static
+        across rounds and owned by BOTH endpoints (the server computed
+        and broadcast it), so the wire codec packs uploads against it
+        with no bitmap frame."""
+        return self._wire_masks
 
     # ---------- phase 1: the global mask ----------
 
@@ -157,13 +167,41 @@ class SalientGradsEngine(FederatedEngine):
 
         cs, losses = jax.vmap(local, in_axes=(0, 0, 0, 0))(cs, Xs, ys, ns)
         w = ns.astype(jnp.float32)
+        client_params = cs.params
+        client_bstats = cs.batch_stats
+        u0 = None
+        if self.wire_spec is not None:
+            # wire-codec roundtrip with MASK HANDOFF (codec/device.py)
+            # over the WHOLE upload payload {params, batch_stats} — the
+            # exact tree a cross-silo silo encodes (distributed/run.py),
+            # with all-ones masks on the (never-pruned) batch stats.
+            # Uploads are top-k sparse by construction (the phase-1
+            # global mask both endpoints hold), so the sparse stage packs
+            # against ``masks`` bitmap-free and delta/quant apply on the
+            # surviving values — aggregation sees what a cross-silo
+            # server would decode. Personal models stay the client's own
+            # untouched local result (they never cross the wire).
+            from neuroimagedisttraining_tpu.codec import device as codec_dev
+
+            spec = self.wire_spec
+            masks_full = {"params": masks,
+                          "batch_stats": jax.tree.map(jnp.ones_like,
+                                                      bstats)}
+            ref = {"params": params, "batch_stats": bstats}
+            dec, _ = jax.vmap(
+                lambda u: codec_dev.lossy_roundtrip(
+                    spec, u, reference=ref, masks=masks_full))(
+                {"params": client_params, "batch_stats": client_bstats})
+            client_params = dec["params"]
+            client_bstats = dec["batch_stats"]
+            u0 = jax.tree.map(lambda x: x[0], dec)
         # silo-aware aggregation (base.aggregate): on a two-level
         # (silos, clients) mesh the masked FedAvg reduces silo-first over
         # ICI with ONE aggregate per silo across DCN; flat weighted mean
         # otherwise — identical result either way (tests/test_sharding.py),
         # cross-silo layout parity with ABCD/data_loader.py:216-315
-        new_params = self.aggregate(cs.params, w)
-        new_bstats = self.aggregate(cs.batch_stats, w)
+        new_params = self.aggregate(client_params, w)
+        new_bstats = self.aggregate(client_bstats, w)
         # personal models <- this round's local results; pad entries from
         # stream_sampling are dropped, never written (base.scatter_sampled_rows)
         real = ns > 0
@@ -172,6 +210,9 @@ class SalientGradsEngine(FederatedEngine):
         per_bstats = self.scatter_sampled_rows(per_bstats, cs.batch_stats,
                                                sampled_idx, real)
         mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        if self.wire_spec is not None:
+            return (new_params, new_bstats, per_params, per_bstats,
+                    mean_loss, u0)
         return new_params, new_bstats, per_params, per_bstats, mean_loss
 
     @functools.cached_property
@@ -201,6 +242,10 @@ class SalientGradsEngine(FederatedEngine):
         else:
             masks, thr = self.generate_global_mask(params, bstats)
         density = float(mask_density(masks))
+        # mask handoff: the wire codec (and any cross-silo deployment of
+        # this engine) packs uploads against this mask — both endpoints
+        # own it, phase 1 computed it server-side and broadcast it
+        self._wire_masks = masks
         self.log.info("global SNIP mask density = %.4f (target %.4f)",
                       density, cfg.sparsity.dense_ratio)
         self.stat_info["mask_density"] = density
@@ -251,11 +296,28 @@ class SalientGradsEngine(FederatedEngine):
                     self.round_lr(round_idx))
             else:
                 rngs = self.per_client_rngs(round_idx, sampled)
-                (params, bstats, per_params, per_bstats,
-                 loss) = self._round_jit(
-                    params, bstats, per_params, per_bstats, self.data,
-                    masks, jnp.asarray(sampled), rngs,
-                    self.round_lr(round_idx))
+                if self.wire_spec is not None:
+                    ref_host = jax.tree.map(
+                        np.asarray, {"params": params,
+                                     "batch_stats": bstats})
+                    (params, bstats, per_params, per_bstats, loss,
+                     u0) = self._round_jit(
+                        params, bstats, per_params, per_bstats, self.data,
+                        masks, jnp.asarray(sampled), rngs,
+                        self.round_lr(round_idx))
+                    masks_host = {
+                        "params": jax.tree.map(np.asarray, masks),
+                        "batch_stats": jax.tree.map(
+                            np.ones_like, ref_host["batch_stats"])}
+                    self.account_wire_bytes(
+                        jax.tree.map(np.asarray, u0), ref_host,
+                        masks_host=masks_host, n_uploads=len(sampled))
+                else:
+                    (params, bstats, per_params, per_bstats,
+                     loss) = self._round_jit(
+                        params, bstats, per_params, per_bstats, self.data,
+                        masks, jnp.asarray(sampled), rngs,
+                        self.round_lr(round_idx))
             n_samples = float(np.sum(self._n_train_host[sampled]))
             self.stat_info["sum_training_flops"] += (
                 flops_per_sample * cfg.optim.epochs * n_samples)

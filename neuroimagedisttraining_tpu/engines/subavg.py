@@ -55,6 +55,15 @@ class SubFedAvgEngine(FederatedEngine):
     # data shards (same shape as FedAvg's streaming round); per-client masks
     # and the global model stay device-resident.
     supports_streaming = True
+    #: current per-client personal masks, tracked for the codec handoff
+    _mask_pers = None
+
+    def wire_masks(self):
+        """Mask handoff (codec/): the per-client personal masks, stacked
+        [C, ...]. They evolve by pruning (monotone entry loss) on
+        accepted rounds, so a cross-silo deployment ships the bitmap
+        frame with the surviving values (as DisPFL)."""
+        return self._mask_pers
 
     def _round_body(self, params, bstats, mask_pers, Xs, ys, ns,
                     sampled_idx, rngs, lr):
@@ -244,6 +253,12 @@ class SubFedAvgEngine(FederatedEngine):
                  up_nnz) = self._round_jit(
                     params, bstats, mask_pers, self.data,
                     jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+            self._mask_pers = mask_pers
+            # NaN-poisoned-mask diagnosability (ADVICE r5): a NaN in the
+            # trained params poisons fake_prune's percentile into an
+            # all-False m2; if the accept-test then fires, the client's
+            # personal mask collapses — make it visible immediately
+            self.warn_if_masks_collapsed(mask_pers, round_idx)
             n_samples = float(np.sum(self._n_train_host[sampled]))
             self.stat_info["sum_training_flops"] += (
                 flops_per_sample * cfg.optim.epochs * n_samples)

@@ -43,6 +43,13 @@ class TurboAggregateEngine(FedAvgEngine):
     # loop itself is inherited from FedAvgEngine._train_streaming via
     # _round_stream_jit below.
     supports_streaming = True
+    # Inherits FedAvgEngine's train loop but NOT its codec branch: its
+    # round replaces the plain aggregation with the MPC share pipeline,
+    # whose GF(p) field embedding the codec's delta/top-k/quant stages
+    # would corrupt (same incompatibility as cross_silo's
+    # SecureFedAvgServer, and the inherited codec call path would pass
+    # this engine's 6-arg round program 7 args anyway).
+    supports_wire_codec = False
 
     def _train_only_body(self, params, bstats, Xs, ys, ns, rngs, lr):
         """Local training WITHOUT the in-program aggregation: returns the

@@ -36,8 +36,24 @@ def quantize_device(x: jax.Array, p: int = P_DEFAULT,
     quantize's embedding, ops/mpc.py:220-224). Exact for
     |x| * 2^frac_bits < p/2 — scaled magnitudes also stay well inside
     float32's 2^24 exact-integer range for every update this framework
-    ships (unit-ish weighted deltas)."""
-    v = jnp.rint(x.astype(jnp.float32) * (1 << frac_bits)).astype(jnp.int32)
+    ships (unit-ish weighted deltas).
+
+    Range guard (ADVICE r5): scaled magnitudes are CLAMPED to the
+    largest float32 BELOW p/2 before the int32 cast. Without it, a float
+    beyond int32 range saturates to 2^31-1 == p in XLA's cast — an
+    out-of-field "residue" the host path (int64 mod p) never produces,
+    so device and host would silently diverge; and a naive float32(p//2)
+    limit ROUNDS UP past p/2, flipping the sign of positive overflows at
+    dequantize. Saturating at the fixed-point range edge is a defined,
+    sign-preserving overflow; values that large are already outside the
+    |x|*2^frac_bits < p/2 exactness contract either way."""
+    import numpy as _np  # static limit math only, never on traced values
+
+    lim = _np.float32((p - 1) // 2)
+    if int(lim) > (p - 1) // 2:  # float32 rounded UP past the field edge
+        lim = _np.nextafter(lim, _np.float32(0.0))
+    scaled = jnp.rint(x.astype(jnp.float32) * (1 << frac_bits))
+    v = jnp.clip(scaled, -lim, lim).astype(jnp.int32)
     return jnp.where(v < 0, v + p, v).astype(jnp.uint32)
 
 
@@ -67,6 +83,12 @@ def secure_sum_device(stack: jax.Array, key: jax.Array, n_shares: int,
     With ``return_slots`` the per-slot totals (the only server-visible
     intermediates) are also returned so tests can assert they are
     uniformly-random masked material, not any client's plaintext.
+
+    All three reductions (masking-row sum, per-slot client sum, cross-
+    slot total) run as ``lax.fori_loop`` so the trace is O(1) in clients
+    and shares instead of the O(S x n_shares x leaves) unrolled program
+    ADVICE r5 flagged — same ascending accumulation order, so the output
+    is bitwise-equal to the unrolled path (pinned in tests/test_mpc.py).
     """
     if n_shares < 2:
         raise ValueError(
@@ -74,29 +96,26 @@ def secure_sum_device(stack: jax.Array, key: jax.Array, n_shares: int,
             "with a single share there is no masking material and the "
             "'secure' aggregation would be the plaintext sum")
     S = stack.shape[0]
+    pp = jnp.uint32(p)
     q = quantize_device(stack, p=p, frac_bits=frac_bits)       # [S, ...]
     # masking material: n_shares-1 uniform draws per client element; the
     # final share is determined (q - sum of the others)
     r = jax.random.randint(key, (n_shares - 1,) + q.shape, 0, p,
                            dtype=jnp.int32).astype(jnp.uint32)
-    rsum = r[0]
-    for j in range(1, n_shares - 1):
-        rsum = _addmod(rsum, r[j], jnp.uint32(p))
-    last = _addmod(q, jnp.uint32(p) - rsum, jnp.uint32(p))     # q - rsum
-    # slot-major accumulation over the client axis
-    def client_sum(slot):  # [S, ...] -> [...] mod-p sum, ascending client
-        acc = slot[0]
-        for c in range(1, S):
-            acc = _addmod(acc, slot[c], jnp.uint32(p))
-        return acc
-    slots = [client_sum(r[j]) for j in range(n_shares - 1)]
-    slots.append(client_sum(last))
-    total = slots[0]
-    for j in range(1, n_shares):
-        total = _addmod(total, slots[j], jnp.uint32(p))
+    rsum = jax.lax.fori_loop(1, n_shares - 1,
+                             lambda j, acc: _addmod(acc, r[j], pp), r[0])
+    last = _addmod(q, pp - rsum, pp)                           # q - rsum
+    shares = jnp.concatenate([r, last[None]])      # [n_shares, S, ...]
+    # slot-major accumulation over the client axis, ascending client
+    # order per slot — every slot advances one client per iteration, so
+    # no two slots combine before each has folded all S clients
+    slots = jax.lax.fori_loop(
+        1, S, lambda c, acc: _addmod(acc, shares[:, c], pp), shares[:, 0])
+    total = jax.lax.fori_loop(
+        1, n_shares, lambda j, acc: _addmod(acc, slots[j], pp), slots[0])
     out = dequantize_device(total, p=p, frac_bits=frac_bits)
     if return_slots:
-        return out, jnp.stack(slots)
+        return out, slots
     return out
 
 

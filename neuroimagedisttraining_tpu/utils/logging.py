@@ -55,6 +55,9 @@ class ExperimentLogger:
     def info(self, msg: str, *args: Any) -> None:
         self._log.info(msg, *args)
 
+    def warning(self, msg: str, *args: Any) -> None:
+        self._log.warning(msg, *args)
+
     def metrics(self, round_idx: int, **values: Any) -> None:
         """Append one structured metrics record for a round."""
         rec: dict[str, Any] = {"round": int(round_idx),

@@ -16,10 +16,12 @@ else
     echo "== ruff not installed; skipping the generic lint tier ==" >&2
 fi
 
-# nidtlint walks the whole package, including faults/ — the
-# lock-discipline rules cover distributed/ AND faults/ (the chaos
-# wrapper writes raw frames), and the determinism rules hold the fault
-# schedule to the same seeded-stream contract as the engines.
+# nidtlint walks the whole package, including faults/ AND codec/ — the
+# lock-discipline rules cover distributed/ and faults/ (the chaos
+# wrapper writes raw frames), the determinism rules hold the fault
+# schedule to the same seeded-stream contract as the engines, and the
+# trace-safety rules apply to codec/device.py's jitted encode math
+# (lossy_roundtrip runs inside every codec-enabled engine round).
 echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1

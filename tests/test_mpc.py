@@ -197,6 +197,73 @@ def test_secure_sum_device_slots_are_masked():
 
 
 @pytest.mark.slow
+def test_secure_sum_device_fori_bitwise_equals_unrolled():
+    """ADVICE r5: the fori_loop reductions (trace size O(1) in clients
+    and shares) must be BITWISE-equal to the historical Python-unrolled
+    accumulation — same ascending order, same _addmod lattice."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    def unrolled(stack, key, n_shares, p=mpc.P_DEFAULT):
+        q = D.quantize_device(stack)
+        r = jax.random.randint(key, (n_shares - 1,) + q.shape, 0, p,
+                               dtype=jnp.int32).astype(jnp.uint32)
+        rsum = r[0]
+        for j in range(1, n_shares - 1):
+            rsum = D._addmod(rsum, r[j], jnp.uint32(p))
+        last = D._addmod(q, jnp.uint32(p) - rsum, jnp.uint32(p))
+
+        def client_sum(slot):
+            acc = slot[0]
+            for c in range(1, stack.shape[0]):
+                acc = D._addmod(acc, slot[c], jnp.uint32(p))
+            return acc
+
+        slots = [client_sum(r[j]) for j in range(n_shares - 1)]
+        slots.append(client_sum(last))
+        total = slots[0]
+        for j in range(1, n_shares):
+            total = D._addmod(total, slots[j], jnp.uint32(p))
+        return (D.dequantize_device(total), jnp.stack(slots))
+
+    rng = np.random.default_rng(0)
+    for S, n_shares in ((1, 2), (2, 3), (5, 2), (4, 6)):
+        stack = (rng.normal(size=(S, 17)) * 0.7).astype(np.float32)
+        key = jax.random.key(S * 10 + n_shares)
+        got, gslots = D.secure_sum_device(stack, key, n_shares,
+                                          return_slots=True)
+        want, wslots = unrolled(stack, key, n_shares)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_array_equal(np.asarray(gslots),
+                                      np.asarray(wslots))
+
+
+def test_quantize_device_overflow_boundary_guard():
+    """ADVICE r5: |x|*2^frac_bits beyond int32 range must SATURATE
+    sign-preservingly inside the field instead of XLA's cast-to-2^31-1
+    (== p, an out-of-field residue the host path never produces)."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    # in-range boundary neighborhood: device == host embedding exactly
+    xs = np.asarray([16383.0, -16383.0, 1.0, -1.0, 0.0], np.float32)
+    dev = np.asarray(jax.jit(D.quantize_device)(jnp.asarray(xs)))
+    host = mpc.quantize(np.asarray(xs, np.float64))
+    np.testing.assert_array_equal(dev, host)
+    # overflow: residues stay strictly inside the field with the sign
+    # preserved through dequantize (no silent wrap/flip)
+    big = np.asarray([1e9, -1e9], np.float32)  # * 2^16 >> 2^31
+    q = np.asarray(jax.jit(D.quantize_device)(jnp.asarray(big)))
+    assert (q < mpc.P_DEFAULT).all()
+    dq = np.asarray(D.dequantize_device(jnp.asarray(q)))
+    assert dq[0] > 0 and dq[1] < 0, "saturation must preserve sign"
+
+
+@pytest.mark.slow
 def test_turboaggregate_host_backend_still_works(tmp_path,
                                                  synthetic_cohort):
     """mpc_backend='host' keeps the boundary-modeling numpy path alive."""
