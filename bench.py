@@ -48,9 +48,17 @@ GFLOP/sample x V100 fp32 roofline x assumed Conv3d MFU range);
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
+Fused-dispatch cell (ISSUE 4): ``rounds_per_dispatch`` times K
+sequential single-round dispatches against ONE K-round ``lax.scan``
+program (the ``--rounds_per_dispatch`` driver mode; bitwise equality of
+the two is pinned in tests/test_dispatch.py) and reports the speedup —
+the dispatch-amortization win PROFILE.md round 2 measured at 2.4x.
+
 Env knobs: BENCH_BATCH (default 128), BENCH_CLIENTS (1), BENCH_LOCAL
 (512), BENCH_ROUNDS (3), BENCH_REPS (3 — best-of-N timed repeats; the
-harness chip is time-shared, PROFILE.md round 2), BENCH_SHAPE /
+harness chip is time-shared, PROFILE.md round 2), BENCH_DISPATCH_K
+(4; <= 1 skips the fused-dispatch cell), NIDT_COMPILE_CACHE (persistent
+compile cache dir; off by default for the bench), BENCH_SHAPE /
 BENCH_MODEL (CPU smoke runs of the harness itself).
 """
 
@@ -97,7 +105,16 @@ def main() -> None:
     from neuroimagedisttraining_tpu.models import create_model
     from neuroimagedisttraining_tpu.ops import flops as flops_ops
     from neuroimagedisttraining_tpu.ops.topk import kth_largest
+    from neuroimagedisttraining_tpu.utils.compile_cache import (
+        enable_compile_cache,
+    )
     from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    # NIDT_COMPILE_CACHE: reuse compiled round programs across bench
+    # invocations (the ~30 s 3D-CNN compile is paid once per machine);
+    # opt-in for the bench — warmup already excludes compile from the
+    # timed region, so the cache only speeds startup
+    enable_compile_cache(None, default="")
 
     batch = int(os.environ.get("BENCH_BATCH", 128))
     n_clients = int(os.environ.get("BENCH_CLIENTS", 1))
@@ -175,8 +192,70 @@ def main() -> None:
     peak = _chip_peak_tflops(jax.devices()[0])
     mfu = (sustained / (peak * 1e12)) if peak else None
 
+    # ---- fused multi-round dispatch cell (ISSUE 4) ----
+    # K single-round dispatches (the shipped K=1 loop) vs ONE K-round
+    # lax.scan program (--rounds_per_dispatch K), same host-precomputed
+    # sampling/rng/lr per round — the bitwise-equality of the two is
+    # pinned in tests/test_dispatch.py; this cell measures the dispatch
+    # amortization. Donation is live on both paths, so every timed rep
+    # consumes fresh copies of the starting state (the copy is µs against
+    # a multi-second round).
+    K_disp = int(os.environ.get("BENCH_DISPATCH_K", 4))
+    dispatch_cell = None
+    if K_disp > 1:
+        copy_tree = lambda t: jax.tree.map(jnp.copy, t)
+        samp_list = [engine.client_sampling(r) for r in range(K_disp)]
+        rngs_list = [engine.per_client_rngs(r, s)
+                     for r, s in enumerate(samp_list)]
+        lrs_list = [engine.round_lr(r) for r in range(K_disp)]
+        k_samples = K_disp * n_clients * epochs * steps * batch
+
+        def seq_chain(p, b):
+            for r in range(K_disp):
+                p, b, l = engine._round_jit(
+                    p, b, fed, jnp.asarray(samp_list[r]), rngs_list[r],
+                    lrs_list[r])
+            return float(l)
+
+        seq_chain(copy_tree(params), copy_tree(bstats))  # warm
+        seq_best = float("inf")
+        for _ in range(reps):
+            p, b = copy_tree(params), copy_tree(bstats)
+            t0 = time.perf_counter()
+            seq_chain(p, b)
+            seq_best = min(seq_best, time.perf_counter() - t0)
+
+        fused = engine._fused_round_jit(K_disp)
+        samp_k = jnp.asarray(np.stack(samp_list))
+        rngs_k = jnp.stack(rngs_list)
+        lrs_k = jnp.asarray(lrs_list, jnp.float32)
+
+        def fused_chain(p, b):
+            p, b, losses = fused(p, b, fed, samp_k, rngs_k, lrs_k)
+            return float(losses[-1])
+
+        fused_chain(copy_tree(params), copy_tree(bstats))  # compile+warm
+        fused_best = float("inf")
+        for _ in range(reps):
+            p, b = copy_tree(params), copy_tree(bstats)
+            t0 = time.perf_counter()
+            fused_chain(p, b)
+            fused_best = min(fused_best, time.perf_counter() - t0)
+        dispatch_cell = {
+            "k": K_disp,
+            "sequential_samples_per_sec": round(k_samples / seq_best, 2),
+            "fused_samples_per_sec": round(k_samples / fused_best, 2),
+            "speedup_x": round(seq_best / fused_best, 3),
+        }
+
     # ---- phase 2: SalientGrads mask pipeline + Pallas/XLA agreement ----
+    # (phase-2/3 engines replay the SAME {params, bstats, per-client}
+    # buffers through their round programs across timed repeats, so
+    # donation is disabled on them — it affects memory residency, not
+    # the round math being timed; the donated path is what the phase-1
+    # loop above and the dispatch cell measure)
     sg = create_engine("salientgrads", cfg, fed, trainer, logger=log)
+    sg._donate = False
 
     def _mask_sync(masks):
         # value-sync through EVERY mask leaf (the threshold alone completes
@@ -221,6 +300,7 @@ def main() -> None:
         # DisPFL: masked einsum consensus + local train + fire/regrow
         dp = create_engine("dispfl", dataclasses.replace(
             cfg, algorithm="dispfl"), fed, trainer, logger=log)
+        dp._donate = False
         m_local, _ = dp.init_masks_all(params)
         dper = dp.broadcast_states(
             gs.__class__(params=params, batch_stats=bstats, opt_state=None,
@@ -239,6 +319,7 @@ def main() -> None:
         # D-PSGD: gossip mixing-matrix consensus + local train
         dg = create_engine("dpsgd", dataclasses.replace(
             cfg, algorithm="dpsgd"), fed, trainer, logger=log)
+        dg._donate = False
         M_mix = jnp.asarray(dg.mixing_matrix(1))
 
         def dpsgd_round():
@@ -252,6 +333,7 @@ def main() -> None:
         # overlap-count aggregation
         sa = create_engine("subavg", dataclasses.replace(
             cfg, algorithm="subavg"), fed, trainer, logger=log)
+        sa._donate = False
         from neuroimagedisttraining_tpu.ops.masks import ones_mask
 
         sa_masks = sa.broadcast_states(ones_mask(params), C)
@@ -269,6 +351,7 @@ def main() -> None:
             fed, X_val=fed.X_test, y_val=fed.y_test, n_val=fed.n_test)
         fo = create_engine("fedfomo", dataclasses.replace(
             cfg, algorithm="fedfomo"), fed_val, trainer, logger=log)
+        fo._donate = False
         A_fo = np.zeros((C, C), np.float32)
         for c in range(fo.real_clients):
             A_fo[c, np.unique(fo.benefit_choose(1, c, np.ones(C)))] = 1.0
@@ -301,6 +384,7 @@ def main() -> None:
         # incoming global (engines/fedprox.py; BASELINE.json configs[3])
         fp = create_engine("fedprox", dataclasses.replace(
             cfg, algorithm="fedprox"), fed, trainer, logger=log)
+        fp._donate = False
 
         def fedprox_round():
             out = fp._round_jit(params, bstats, fed, sampled, rngs_s, lr)
@@ -312,6 +396,7 @@ def main() -> None:
         # ~2x the FedAvg compute per sample by construction)
         dt = create_engine("ditto", dataclasses.replace(
             cfg, algorithm="ditto"), fed, trainer, logger=log)
+        dt._donate = False
 
         def ditto_round():
             out = dt._round_jit(params, bstats, dper.params,
@@ -323,6 +408,7 @@ def main() -> None:
         # Local-only: vmapped per-client training, no aggregation
         lo = create_engine("local", dataclasses.replace(
             cfg, algorithm="local"), fed, trainer, logger=log)
+        lo._donate = False
 
         def local_round():
             out = lo._round_jit(dper.params, dper.batch_stats, fed,
@@ -338,6 +424,7 @@ def main() -> None:
         # timed alone
         ta = create_engine("turboaggregate", dataclasses.replace(
             cfg, algorithm="turboaggregate"), fed, trainer, logger=log)
+        ta._donate = False
 
         def turbo_round():
             out = ta._round_jit(params, bstats, fed, sampled, rngs_s, lr)
@@ -428,6 +515,7 @@ def main() -> None:
         "peak_tflops_assumed": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "salientgrads_mask_ms": round(mask_ms, 1),
+        "rounds_per_dispatch": dispatch_cell,
         "algo_round_s": {k: round(v, 3) for k, v in algo_round_s.items()}
         or None,
         "algo_round_samples_per_sec": {

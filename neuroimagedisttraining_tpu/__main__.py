@@ -192,10 +192,21 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", type=str, default="",
                         help="capture a jax.profiler trace of training "
                              "into this dir (TensorBoard-loadable)")
-    parser.add_argument("--compile_cache_dir", type=str,
-                        default="/tmp/nidt_jax_cache",
-                        help="persistent XLA compile cache (repeat "
-                             "experiments skip recompiles); empty disables")
+    parser.add_argument("--compile_cache", "--compile_cache_dir",
+                        dest="compile_cache_dir", type=str, default=None,
+                        help="persistent XLA compile cache dir (repeat "
+                             "experiments skip the ~30s 3D-CNN round "
+                             "compile); unset falls back to "
+                             "$NIDT_COMPILE_CACHE, then "
+                             "/tmp/nidt_jax_cache; empty string disables")
+    parser.add_argument("--rounds_per_dispatch", type=int, default=1,
+                        help="fuse up to K rounds into ONE lax.scan "
+                             "dispatch when the federation is resident "
+                             "and host-free between rounds (sampling/rng/"
+                             "lr precomputed per round; eval/checkpoint "
+                             "hooks fire at window boundaries); engines "
+                             "that cross the host each round fall back "
+                             "to 1 with a logged reason")
     return parser
 
 
@@ -230,6 +241,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             mpc_frac_bits=args.mpc_frac_bits, mpc_backend=args.mpc_backend,
             defense_type=args.defense_type,
             norm_bound=args.norm_bound, stddev=args.stddev,
+            rounds_per_dispatch=args.rounds_per_dispatch,
             frequency_of_the_test=args.frequency_of_the_test,
             ci=bool(args.ci)),
         sparsity=SparsityConfig(
@@ -380,12 +392,10 @@ def main(argv: list[str] | None = None) -> int:
         init_multihost(args.multihost_coordinator, args.num_processes,
                        args.process_id)
 
-    if args.compile_cache_dir:
-        import jax
-
-        jax.config.update("jax_compilation_cache_dir",
-                          args.compile_cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    from neuroimagedisttraining_tpu.utils.compile_cache import (
+        enable_compile_cache,
+    )
+    enable_compile_cache(args.compile_cache_dir)
 
     # deterministic seeding (main_sailentgrads.py:264-268)
     random.seed(args.seed)

@@ -1,0 +1,313 @@
+"""Donation-discipline rules: round programs donate, callers never reread.
+
+Buffer donation (``jax.jit(..., donate_argnums=...)``) is the round
+driver's HBM contract (ISSUE 4): every round/consensus program consumes
+its state pytrees in place, and the runtime DELETES donated buffers at
+dispatch — a later host-side read raises ``Array has been deleted`` at
+best, and at worst only on hardware where donation is implemented. Two
+lexical rules keep the tree honest:
+
+- ``donation-missing`` — a ``jax.jit`` of a function whose name matches
+  ``*round*``/``*consensus*`` (the repo's round-program naming
+  convention: ``round_fn``, ``_round_body``, ``fused_round_fn``,
+  ``_consensus``) must pass a ``donate_argnums`` keyword. Declaring
+  ``donate_argnums=self._donate_argnums(...)`` counts (the engine-level
+  gate); programs that legitimately cannot donate take a pragma.
+- ``donation-use-after-donate`` — inside one function body, a variable
+  passed in a donated argument position of a known-donating call must
+  not be read on any later line until it is rebound. Donating callables
+  are resolved lexically: direct ``jax.jit(..., donate_argnums=...)``
+  results (assigned or returned), ``self.<prop>`` cached properties and
+  ``self.<factory>(...)`` plan caches whose bodies build such a jit, and
+  module-level defs decorated ``@partial(jax.jit, donate_argnums=...)``.
+
+Both rules are intentionally lexical/straight-line (same limits as the
+trace-safety family): a rebinding on the same statement as the dispatch
+(``params, ... = self._round_jit(params, ...)``) is the blessed driver
+shape, and reads reachable only through loop back-edges are out of
+scope — the tier-1 engine tests execute those paths for real.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from neuroimagedisttraining_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    normalize,
+    register,
+)
+from neuroimagedisttraining_tpu.analysis.trace_safety import (
+    _ancestors,
+    _annotate_parents,
+    _DefIndex,
+)
+
+#: round-program naming convention (ISSUE 4): jits of these must donate
+_ROUND_NAME_RE = re.compile(r"round|consensus")
+_PARTIAL = "functools.partial"
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _target_name(node: ast.AST) -> str | None:
+    """Best-effort name of a jit target: ``round_fn``, ``self._round_body``
+    -> ``_round_body``, lambdas -> None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _unwrap_partial_call(node: ast.AST, aliases: dict) -> ast.AST:
+    if (isinstance(node, ast.Call)
+            and normalize(dotted_name(node.func), aliases) == _PARTIAL
+            and node.args):
+        return _unwrap_partial_call(node.args[0], aliases)
+    return node
+
+
+def _donate_kwarg(call: ast.Call) -> ast.keyword | None:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return kw
+    return None
+
+
+def _donated_indices(kw: ast.keyword) -> tuple[int, ...]:
+    """Integer argument positions named by a ``donate_argnums`` value:
+    a literal int/tuple, or the int literals of a gating call like
+    ``self._donate_argnums(0, 1, 6)``. Unknown shapes yield () — the
+    declaration still satisfies ``donation-missing``."""
+    v = kw.value
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        return (v.value,)
+    if isinstance(v, (ast.Tuple, ast.List)):
+        out = []
+        for el in v.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return tuple(out)
+    if isinstance(v, ast.Call):
+        return tuple(a.value for a in v.args
+                     if isinstance(a, ast.Constant)
+                     and isinstance(a.value, int))
+    return ()
+
+
+def _jit_calls(root: ast.AST, aliases: dict) -> Iterator[ast.Call]:
+    """Every ``jax.jit(...)`` call lexically inside ``root`` (including
+    through ``functools.partial(jax.jit, ...)``)."""
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        name = normalize(dotted_name(node.func), aliases)
+        if name == "jax.jit":
+            yield node
+        elif name == _PARTIAL and node.args and \
+                normalize(dotted_name(node.args[0]), aliases) == "jax.jit":
+            yield node
+
+
+def _method_donation(index: _DefIndex, at: ast.AST, name: str,
+                     aliases: dict) -> tuple[int, ...] | None:
+    """Donated indices when ``self.<name>`` / local def ``name`` builds a
+    ``jax.jit(..., donate_argnums=...)`` anywhere in its body (covers
+    cached properties, ``_plan_cached`` build closures, and jit-factory
+    methods); None when it builds none."""
+    target = index.resolve_method(at, name) or index.resolve_name(at, name)
+    if target is None:
+        return None
+    found: tuple[int, ...] | None = None
+    for call in _jit_calls(target, aliases):
+        kw = _donate_kwarg(call)
+        if kw is not None:
+            found = tuple(sorted(set((found or ()) + _donated_indices(kw))))
+    return found
+
+
+@register
+class DonationDisciplineRule(Rule):
+    rule_ids = ("donation-missing", "donation-use-after-donate")
+    description = (
+        "jitted *round*/*consensus* programs must declare donate_argnums "
+        "(donation-missing), and a variable passed in a donated argument "
+        "position must not be read again before rebinding "
+        "(donation-use-after-donate)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        _annotate_parents(mod.tree)
+        index = _DefIndex(mod.tree)
+        yield from self._check_missing(mod, index)
+        yield from self._check_use_after(mod, index)
+
+    # ---------- donation-missing ----------
+
+    def _check_missing(self, mod: ModuleInfo,
+                       index: _DefIndex) -> Iterator[Finding]:
+        aliases = mod.aliases
+        for call in _jit_calls(mod.tree, aliases):
+            # partial(jax.jit, ...) decorators: the target is the def
+            if normalize(dotted_name(call.func), aliases) == _PARTIAL:
+                parent = getattr(call, "_nidt_parent", None)
+                tname = (parent.name if isinstance(parent, _FUNCS)
+                         and call in parent.decorator_list else None)
+            else:
+                if not call.args:
+                    continue
+                tname = _target_name(
+                    _unwrap_partial_call(call.args[0], aliases))
+            if tname is None or not _ROUND_NAME_RE.search(tname):
+                continue
+            if _donate_kwarg(call) is None:
+                yield Finding(
+                    mod.path, call.lineno, "donation-missing",
+                    f"jax.jit of round program {tname!r} declares no "
+                    "donate_argnums — the round's consumed state pytrees "
+                    "double-buffer across the dispatch (declare "
+                    "donate_argnums, e.g. via self._donate_argnums(...), "
+                    "or pragma-justify why this program cannot donate)")
+
+    # ---------- donation-use-after-donate ----------
+
+    def _check_use_after(self, mod: ModuleInfo,
+                         index: _DefIndex) -> Iterator[Finding]:
+        aliases = mod.aliases
+        for fn in (n for n in ast.walk(mod.tree) if isinstance(n, _FUNCS)):
+            # only direct statements of THIS function (nested defs are
+            # visited on their own)
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                if self._enclosing_fn(call) is not fn:
+                    continue
+                donated = self._donating_call(call, index, aliases)
+                if not donated:
+                    continue
+                indices, callee = donated
+                yield from self._reads_after(mod, fn, call, indices, callee)
+
+    @staticmethod
+    def _enclosing_fn(node: ast.AST) -> ast.AST | None:
+        for anc in _ancestors(node):
+            if isinstance(anc, _FUNCS + (ast.Lambda,)):
+                return anc
+        return None
+
+    def _donating_call(self, call: ast.Call, index: _DefIndex,
+                       aliases: dict) -> tuple[tuple[int, ...], str] | None:
+        """(donated indices, callee label) when ``call`` dispatches a
+        known-donating jitted callable."""
+        func = call.func
+        # direct: jax.jit(f, donate_argnums=...)(args)
+        if isinstance(func, ast.Call):
+            name = normalize(dotted_name(func.func), aliases)
+            if name == "jax.jit":
+                kw = _donate_kwarg(func)
+                if kw is not None:
+                    idx = _donated_indices(kw)
+                    return (idx, "jax.jit(...)") if idx else None
+            # factory: self._round_jit_for(plan)(args) /
+            # self._fused_round_jit(k)(args)
+            fname = _target_name(func.func)
+            if fname is not None:
+                idx = _method_donation(index, call, fname, aliases)
+                if idx:
+                    return idx, f"{fname}(...)"
+            return None
+        # property/name: self._round_jit(args) or round_prog(args) where
+        # the definition (or a local assignment) builds a donating jit.
+        # NOT when this call is itself immediately invoked — then it is a
+        # jit FACTORY (self._round_jit_for(plan)(...)) and the donated
+        # positions belong to the OUTER call, handled above.
+        parent = getattr(call, "_nidt_parent", None)
+        if isinstance(parent, ast.Call) and parent.func is call:
+            return None
+        name = _target_name(func)
+        if name is None:
+            return None
+        idx = _method_donation(index, call, name, aliases)
+        if idx:
+            return idx, name
+        return None
+
+    def _reads_after(self, mod: ModuleInfo, fn: ast.AST, call: ast.Call,
+                     indices: tuple[int, ...], callee: str
+                     ) -> Iterator[Finding]:
+        stmt = self._enclosing_stmt(call)
+        if stmt is None or stmt.end_lineno is None:
+            return
+        donated_names = []
+        for i in indices:
+            if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                donated_names.append(call.args[i].id)
+        if not donated_names:
+            return
+        # rebinding on the dispatch statement itself (the blessed
+        # driver shape) clears the name immediately
+        rebound_here = self._assigned_names(stmt)
+        tracked = [n for n in donated_names if n not in rebound_here]
+        if not tracked:
+            return
+        # later statements: a load before a rebind is a use-after-donate
+        first_rebind: dict[str, int] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.stmt) or node.lineno <= stmt.end_lineno:
+                continue
+            for n in self._assigned_names(node):
+                if n in tracked:
+                    first_rebind[n] = min(first_rebind.get(n, 1 << 30),
+                                          node.lineno)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in tracked
+                    and node.lineno > stmt.end_lineno
+                    and node.lineno < first_rebind.get(node.id, 1 << 30)):
+                continue
+            yield Finding(
+                mod.path, node.lineno, "donation-use-after-donate",
+                f"{node.id!r} is read after being passed in a donated "
+                f"argument position of {callee} (line {call.lineno}); "
+                "the dispatch deletes donated buffers — snapshot before "
+                "dispatching or rebind the name from the call's result")
+
+    @staticmethod
+    def _enclosing_stmt(node: ast.AST) -> ast.stmt | None:
+        cur: ast.AST | None = node
+        while cur is not None:
+            if isinstance(cur, ast.stmt):
+                return cur
+            cur = getattr(cur, "_nidt_parent", None)
+        return None
+
+    @staticmethod
+    def _assigned_names(stmt: ast.stmt) -> set[str]:
+        out: set[str] = set()
+
+        def collect(t: ast.AST) -> None:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    collect(el)
+            elif isinstance(t, ast.Starred):
+                collect(t.value)
+
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                collect(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            collect(stmt.target)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            collect(stmt.target)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    collect(item.optional_vars)
+        return out

@@ -121,10 +121,17 @@ def lossy_roundtrip(spec: WireSpec, update: PyTree, *,
     return decoded, new_ef
 
 
-@functools.partial(jax.jit, static_argnames=("spec",))
+@functools.partial(jax.jit, static_argnames=("spec",),
+                   donate_argnums=(4,))
 def _encode_math_jit(spec: WireSpec, update: PyTree,
                      reference: PyTree | None, masks: PyTree | None,
                      ef: PyTree | None):
+    # ``ef`` (the sender's error-feedback accumulator) is donated: its
+    # float32 buffers back the returned ``new_ef`` and the caller
+    # contract (wire.encode_update -> cross_silo client) rebinds the
+    # accumulator from the return value every round. ``update`` and
+    # ``reference`` are NOT donated — encode_update rereads the update
+    # leaves for dtype/shape framing after the device math returns.
     """Device half of encode: (residuals, keep masks|None, new_ef|None).
     Quantization happens host-side on the packed values so the wire
     bytes are produced exactly once (idempotent with the host path)."""
@@ -147,4 +154,17 @@ def encode_math(spec: WireSpec, update: PyTree, *,
                 masks: PyTree | None = None, ef: PyTree | None = None):
     """Run the encode-side array math as one jitted program (the
     device-backend option of ``wire.encode_update``)."""
+    if ef is not None:
+        # ``ef`` rides a DONATED argument position: the cross-silo caller
+        # holds it as host numpy, and the numpy->device conversion at a
+        # donated jit boundary (device_put included) can borrow that
+        # memory zero-copy on CPU — the donation would then let XLA
+        # write into, and free, memory numpy still owns. ``jnp.array``
+        # copies numpy leaves into runtime-owned buffers the donation
+        # may safely consume; device-resident leaves pass through.
+        import numpy as _np
+
+        ef = jax.tree.map(
+            lambda x: jnp.array(x) if isinstance(x, _np.ndarray) else x,
+            ef)
     return _encode_math_jit(spec, update, reference, masks, ef)

@@ -129,6 +129,18 @@ class FedConfig:
     quorum: int = 0                # min uploads to aggregate at deadline; 0 = all
     heartbeat_interval: float = 0.0  # s; >0 makes silo clients beat liveness
     heartbeat_timeout: float = 0.0   # s; >0 marks silent clients suspect
+    # Fused multi-round dispatch (ISSUE 4): when > 1 and the federation
+    # is resident, non-streaming, and host-free between rounds, the
+    # driver precomputes up to this many rounds of sampling indices /
+    # per-round rngs / lr schedule on the host and runs them as ONE
+    # lax.scan over the engine's round body — eval/checkpoint/logging
+    # hooks fire at window boundaries (the window planner shrinks so
+    # every hook round lands on a boundary, preserving the sequential
+    # loop's observable behavior bitwise). Engines that cross the host
+    # each round (fedfomo pair lists, turboaggregate MPC, mask/topology
+    # evolution, streaming, --wire_codec byte accounting) transparently
+    # fall back to one round per dispatch with a logged reason.
+    rounds_per_dispatch: int = 1
     # Evaluation cadence
     frequency_of_the_test: int = 1
     ci: bool = False               # CI mode: evaluate client 0 only

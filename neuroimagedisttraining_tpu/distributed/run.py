@@ -66,6 +66,21 @@ import sys
 import numpy as np
 
 
+def dispatch_fallback_note(k: int) -> str | None:
+    """Why ``--rounds_per_dispatch`` collapses to 1 on the distributed
+    transport (logged once at startup; None when k <= 1 — nothing to
+    say). The fused lax.scan driver (ISSUE 4) requires K host-free
+    rounds; the cross-silo protocol is a host round-trip PER ROUND by
+    construction (broadcast -> silo train -> upload -> aggregate over
+    real sockets)."""
+    if k <= 1:
+        return None
+    return (f"rounds_per_dispatch={k} requested; the distributed "
+            "transport dispatches one round at a time (every round "
+            "crosses the control plane: broadcast/upload/aggregate over "
+            "sockets)")
+
+
 def _parse_hosts(spec: str) -> dict[int, str] | None:
     if not spec:
         return None
@@ -359,7 +374,22 @@ def main(argv=None) -> int:
                     help="pin JAX to the CPU backend (e.g. several silo "
                          "processes on one machine sharing a tunneled "
                          "accelerator)")
+    ap.add_argument("--compile_cache", dest="compile_cache", type=str,
+                    default=None,
+                    help="persistent XLA compile cache dir shared by "
+                         "every silo process (each rank pays the model "
+                         "compile once per MACHINE, not per process); "
+                         "unset falls back to $NIDT_COMPILE_CACHE, then "
+                         "/tmp/nidt_jax_cache; empty string disables")
+    ap.add_argument("--rounds_per_dispatch", type=int, default=1,
+                    help="accepted for config parity with the main CLI; "
+                         "the cross-silo control plane synchronizes with "
+                         "every silo each round, so rounds always "
+                         "dispatch one at a time here")
     args = ap.parse_args(argv)
+    if args.rounds_per_dispatch > 1:
+        print(f"[dispatch] {dispatch_fallback_note(args.rounds_per_dispatch)}",
+              flush=True)
     if args.role == "aggregator":
         if args.n_aggregators <= 0:
             ap.error("--role aggregator requires --n_aggregators > 0 "
@@ -406,6 +436,10 @@ def main(argv=None) -> int:
         ap.error("--heartbeat_timeout requires 0 < --heartbeat_interval "
                  f"< timeout (got interval={args.heartbeat_interval}, "
                  f"timeout={args.heartbeat_timeout})")
+    from neuroimagedisttraining_tpu.utils.compile_cache import (
+        enable_compile_cache,
+    )
+    enable_compile_cache(args.compile_cache)
     host_map = _parse_hosts(args.hosts)
     if args.force_cpu:
         from neuroimagedisttraining_tpu.parallel.mesh import (

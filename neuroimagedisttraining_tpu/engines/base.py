@@ -117,6 +117,16 @@ class FederatedEngine:
             "final_masks": [],
         }
         self._dense_upload_nbytes: int | None = None
+        # fused multi-round dispatch (ISSUE 4): engines that cannot fuse
+        # announce the collapse to K=1 ONCE, up front, so a config asking
+        # for amortized dispatch never silently degrades
+        if cfg.fed.rounds_per_dispatch > 1:
+            reason = self.fused_fallback_reason()
+            if reason is not None:
+                self.log.info(
+                    "rounds_per_dispatch=%d requested; dispatching one "
+                    "round at a time: %s",
+                    cfg.fed.rounds_per_dispatch, reason)
 
     # ---------- state init ----------
 
@@ -329,8 +339,120 @@ class FederatedEngine:
             return 0, None
         round_idx, state = loaded
         self.stat_info.update(state.pop("stat_info", {}))
+        # restored leaves arrive as host numpy; COPY them into
+        # runtime-owned device buffers before they reach a round program.
+        # The round programs donate their state arguments (ISSUE 4), and
+        # handing numpy memory into a donated position is memory-unsafe:
+        # the numpy->device conversion (device_put included) can borrow
+        # the numpy buffer zero-copy on CPU, after which the donation
+        # lets XLA write outputs into — and then free — memory that
+        # numpy still owns (silently corrupt resumes, eventually heap
+        # corruption; caught by tests/test_dispatch.py's resume pin).
+        # ``jnp.array`` always copies from numpy, yielding an owned
+        # buffer the donation may consume.
+        state = {k: jax.tree.map(
+            lambda x: jnp.array(x) if isinstance(x, np.ndarray) else x, v)
+            for k, v in state.items()}
         self.log.info("resuming from checkpoint: round %d", round_idx + 1)
         return round_idx + 1, state
+
+    # ---------- buffer donation (ISSUE 4) ----------
+
+    #: Every round/consensus program donates the state pytrees it
+    #: consumes (per-client stacks, broadcast params, EF accumulators),
+    #: so XLA reuses their buffers for the matching outputs instead of
+    #: double-buffering input and output state. The driver contract:
+    #: NOTHING may read a donated argument after the dispatch (the
+    #: runtime deletes the buffers; nidtlint's donation-discipline rules
+    #: check the callers lexically). Tests/benches that replay the same
+    #: buffers through one program twice set ``_donate = False`` BEFORE
+    #: the program's first access (the jits are built lazily and read
+    #: this flag at build time).
+    _donate = True
+
+    def _donate_argnums(self, *nums: int) -> tuple[int, ...]:
+        """``donate_argnums`` for a round/consensus program; ``()`` when
+        donation is disabled on this engine instance."""
+        return tuple(nums) if self._donate else ()
+
+    # ---------- fused multi-round dispatch (ISSUE 4) ----------
+
+    def fused_fallback_reason(self) -> str | None:
+        """Why this engine dispatches one round at a time even when
+        ``--rounds_per_dispatch K`` asks for fused windows — or None when
+        the engine supports the K-round ``lax.scan`` driver. The base
+        answer covers every engine whose driver crosses the host between
+        rounds (per-round topology/mask bookkeeping, pair lists, MPC
+        stages); FedAvg-shaped engines override."""
+        return ("engine has no fused round body (host-side state between "
+                "rounds)")
+
+    def _dispatch_window(self, round_idx: int) -> int:
+        """Length of the fused window starting at ``round_idx``: grows up
+        to ``rounds_per_dispatch`` but stops so that any round with a
+        host-side hook — eval (``frequency_of_the_test``), checkpoint
+        (``checkpoint_every``), the final round — lands on the WINDOW
+        BOUNDARY, where the driver runs the hooks exactly as the
+        sequential loop would have. Interior rounds are hook-free by
+        construction, so fusing changes no observable behavior."""
+        f = self.cfg.fed
+        K = max(1, int(f.rounds_per_dispatch))
+
+        def hooked(r: int) -> bool:
+            return (r % f.frequency_of_the_test == 0
+                    or r == f.comm_round - 1
+                    or (self._ckpt_active()
+                        and (r + 1) % self.cfg.checkpoint_every == 0))
+
+        k = 1
+        while (k < K and round_idx + k < f.comm_round
+               and not hooked(round_idx + k - 1)):
+            k += 1
+        return k
+
+    def _window_sampling(self, round_idx: int, k: int
+                         ) -> tuple[list[np.ndarray], int]:
+        """Host-precomputed per-round cohorts for a fused window,
+        preserving the reference's ``np.random.seed(round_idx)`` sampling
+        contract round by round. The scan needs one static cohort size,
+        so when a fault schedule varies the survivor count mid-window the
+        window shrinks to the maximal equal-size prefix (still fused,
+        still bit-identical cohorts). Returns ``(sampled_per_round, k)``."""
+        sampled = [self.client_sampling(r)
+                   for r in range(round_idx, round_idx + k)]
+        keep = 1
+        while keep < len(sampled) and \
+                len(sampled[keep]) == len(sampled[0]):
+            keep += 1
+        return sampled[:keep], keep
+
+    def _resident_fallback_reason(self) -> str | None:
+        """The fallback conditions shared by every engine that HAS a
+        fused round body (FedAvg-shaped overrides delegate here):
+        streaming and the wire codec both cross the host every round."""
+        if self.stream is not None:
+            return "streaming rounds cross the host for data every round"
+        if self.wire_spec is not None:
+            return ("--wire_codec accounts encoded bytes on the host "
+                    "every round")
+        return None
+
+    def _window_host_inputs(self, round_idx: int, k: int):
+        """Host prologue of a fused window: per-round cohorts (via
+        ``_window_sampling``, which may shrink ``k``), the per-round log
+        lines the sequential loop would have emitted, and the stacked
+        device inputs for the scan. Returns
+        ``(sampled, idx, rngs, lrs, k)``."""
+        sampled, k = self._window_sampling(round_idx, k)
+        for off, s in enumerate(sampled):
+            self.log.info("################ round %d: clients %s (fused "
+                          "window of %d)", round_idx + off, s.tolist(), k)
+        idx = jnp.asarray(np.stack(sampled))
+        rngs = jnp.stack([self.per_client_rngs(round_idx + off, s)
+                          for off, s in enumerate(sampled)])
+        lrs = jnp.asarray([self.round_lr(round_idx + off)
+                           for off in range(k)], jnp.float32)
+        return sampled, idx, rngs, lrs, k
 
     # ---------- helpers ----------
 

@@ -286,7 +286,11 @@ class DisPFLEngine(FederatedEngine):
                 return (new_p, new_b, new_masks, masks_local, dist_self,
                         mean_loss)
 
-            return jax.jit(round_fn)
+            # donation: personal stacks + both mask generations are
+            # consumed (masks_local is returned as the next round's
+            # shared masks — its buffer aliases that output directly)
+            return jax.jit(round_fn,
+                           donate_argnums=self._donate_argnums(0, 1, 2, 3))
 
         return self._plan_cached("_round_jit_cache", plan, build)
 
@@ -305,9 +309,13 @@ class DisPFLEngine(FederatedEngine):
     # ---------- streamed round (data per chunk, state resident) ----------
 
     def _consensus_jit_for(self, plan):
+        # donation: per_params/per_bstats only — the streamed round
+        # rereads masks_local and masks_shared AFTER the consensus (chunk
+        # training + the round tail), so the mask stacks must survive
         return self._plan_cached(
             "_consensus_jit_cache", plan,
-            lambda: jax.jit(functools.partial(self._consensus, plan=plan)))
+            lambda: jax.jit(functools.partial(self._consensus, plan=plan),
+                            donate_argnums=self._donate_argnums(0, 1)))
 
     @property
     def _consensus_jit(self):
@@ -315,7 +323,9 @@ class DisPFLEngine(FederatedEngine):
 
     @functools.cached_property
     def _local_chunk_jit(self):
-        return jax.jit(self._local_and_evolve)
+        # consumes gathered per-chunk copies (fresh each chunk)
+        return jax.jit(self._local_and_evolve,
+                       donate_argnums=self._donate_argnums(0, 1, 2))
 
     @functools.cached_property
     def _round_tail_jit(self):
@@ -369,7 +379,12 @@ class DisPFLEngine(FederatedEngine):
         # (dispfl_api.py:78-82)
         per_params = jax.tree.map(jnp.multiply, per.params, masks_local)
         per_bstats = per.batch_stats
-        masks_shared = masks_local
+        # independent buffers, NOT an alias: both mask generations ride
+        # DONATED argument positions of the round program (ISSUE 4), and
+        # donating one buffer twice is a runtime error ("attempt to
+        # donate the same buffer twice"); every later round returns
+        # distinct stacks, so only this init needs the copy
+        masks_shared = jax.tree.map(jnp.copy, masks_local)
 
         # accounting: per-layer nnz is invariant under fire+regrow, so
         # per-client comm/flops factors are fixed at init

@@ -100,11 +100,16 @@ class DittoEngine(FederatedEngine):
             return self._round_body(params, bstats, per_params, per_bstats,
                                     Xs, ys, ns, sampled_idx, rngs, lr)
 
-        return jax.jit(round_fn)
+        # donation: global model + persistent per-client stacks are
+        # consumed (outputs reuse their buffers); the driver rebinds all
+        # four on return and reads none of the donated inputs after
+        return jax.jit(round_fn,
+                       donate_argnums=self._donate_argnums(0, 1, 2, 3))
 
     @functools.cached_property
     def _round_stream_jit(self):
-        return jax.jit(self._round_body)
+        return jax.jit(self._round_body,
+                       donate_argnums=self._donate_argnums(0, 1, 2, 3))
 
     def train(self):
         cfg = self.cfg

@@ -154,7 +154,10 @@ class DPSGDEngine(FederatedEngine):
                 mean_loss = jnp.sum(losses * real) / denom
                 return new_p, new_b, w_global_p, w_global_b, mean_loss
 
-            return jax.jit(round_fn)
+            # donation: last round's personal stacks are consumed by the
+            # consensus; their buffers back this round's stacks
+            return jax.jit(round_fn,
+                           donate_argnums=self._donate_argnums(0, 1))
 
         return self._plan_cached("_round_jit_cache", plan, build)
 
@@ -163,9 +166,12 @@ class DPSGDEngine(FederatedEngine):
         return self._round_jit_for(None)
 
     def _consensus_jit_for(self, plan):
+        # donation: the streamed round never rereads the pre-consensus
+        # stacks once mixed
         return self._plan_cached(
             "_consensus_jit_cache", plan,
-            lambda: jax.jit(functools.partial(self._consensus, plan=plan)))
+            lambda: jax.jit(functools.partial(self._consensus, plan=plan),
+                            donate_argnums=self._donate_argnums(0, 1)))
 
     @property
     def _consensus_jit(self):
@@ -173,7 +179,9 @@ class DPSGDEngine(FederatedEngine):
 
     @functools.cached_property
     def _block_jit(self):
-        return jax.jit(self._local_block)
+        # consumes the consensus output chunks (gathered fresh per chunk)
+        return jax.jit(self._local_block,
+                       donate_argnums=self._donate_argnums(0, 1))
 
     @functools.cached_property
     def _tail_jit(self):

@@ -231,17 +231,27 @@ class FedFomoEngine(FederatedEngine):
                                   pair_n, data.X_val, data.y_val,
                                   data.n_val, data.n_train)
 
-        return jax.jit(round_fn)
+        # donation: the per-client model stacks and the persistent fomo
+        # state (weights, p_choose) are consumed; the driver rebinds all
+        # four (the next round's benefit_choose reads the NEW p_choose)
+        return jax.jit(round_fn,
+                       donate_argnums=self._donate_argnums(0, 1, 2, 3))
 
     # ---------- streamed round (data per chunk, models resident) ----------
 
     @functools.cached_property
     def _local_chunk_jit(self):
-        return jax.jit(self._local_block)
+        # consumes gathered per-chunk copies (fresh each chunk)
+        return jax.jit(self._local_block,
+                       donate_argnums=self._donate_argnums(0, 1))
 
     @functools.cached_property
     def _agg_jit(self):
-        return jax.jit(self._fomo_agg)
+        # donation: lstrd stacks + fomo state; NOT new_p/new_b (each
+        # output has exactly one donatable source buffer) and NOT the
+        # resident val shards / n_train, which are reused every round
+        return jax.jit(self._fomo_agg,
+                       donate_argnums=self._donate_argnums(0, 1, 5, 6))
 
     # ---------- training loop ----------
 

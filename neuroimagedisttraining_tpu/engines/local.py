@@ -51,11 +51,16 @@ class LocalEngine(FederatedEngine):
             mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
             return new_p, new_b, mean_loss
 
-        return jax.jit(round_fn)
+        # donation: the persistent per-client stacks are consumed; the
+        # driver rebinds them on return
+        return jax.jit(round_fn, donate_argnums=self._donate_argnums(0, 1))
 
     @functools.cached_property
     def _block_jit(self):
-        return jax.jit(self._local_block)
+        # the streamed chunk program consumes gathered per-chunk copies
+        # (stream_map_train_chunks builds them fresh each chunk)
+        return jax.jit(self._local_block,
+                       donate_argnums=self._donate_argnums(0, 1))
 
     def _round_streaming(self, per_params, per_bstats, rngs, lr):
         (new_p, new_b), losses = self.stream_map_train_chunks(

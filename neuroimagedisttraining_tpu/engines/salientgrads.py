@@ -225,11 +225,90 @@ class SalientGradsEngine(FederatedEngine):
             return self._round_body(params, bstats, per_params, per_bstats,
                                     Xs, ys, ns, masks, sampled_idx, rngs, lr)
 
-        return jax.jit(round_fn)
+        # donation: the global model and the [C, ...] per-client personal
+        # stacks are consumed — their buffers back the round's outputs
+        # (the per-client stack is the engine's largest resident state;
+        # without donation XLA holds input AND output copies of it across
+        # the dispatch). ``masks`` is NOT donated: the phase-1 global
+        # mask is reused every round (and by the wire_masks handoff).
+        return jax.jit(round_fn,
+                       donate_argnums=self._donate_argnums(0, 1, 2, 3))
 
     @functools.cached_property
     def _round_stream_jit(self):
-        return jax.jit(self._round_body)
+        return jax.jit(self._round_body,
+                       donate_argnums=self._donate_argnums(0, 1, 2, 3))
+
+    # ---------- fused multi-round dispatch (ISSUE 4) ----------
+
+    def fused_fallback_reason(self) -> str | None:
+        return self._resident_fallback_reason()
+
+    def _fused_round_jit(self, k: int):
+        """K masked rounds as one ``lax.scan`` over the exact round body
+        (same dispatch-amortization shape as FedAvg's); the phase-1 mask
+        and the resident federation ride as loop constants."""
+        def build():
+            def fused_round_fn(params, bstats, per_params, per_bstats, data,
+                         masks, sampled_idx, rngs, lrs):
+                def one_round(carry, xs):
+                    p, b, pp, pb = carry
+                    si, rg, lr = xs
+                    Xs = jnp.take(data.X_train, si, axis=0)
+                    ys = jnp.take(data.y_train, si, axis=0)
+                    ns = jnp.take(data.n_train, si, axis=0)
+                    p, b, pp, pb, loss = self._round_body(
+                        p, b, pp, pb, Xs, ys, ns, masks, si, rg, lr)
+                    return (p, b, pp, pb), loss
+
+                carry, losses = jax.lax.scan(
+                    one_round, (params, bstats, per_params, per_bstats),
+                    (sampled_idx, rngs, lrs))
+                return (*carry, losses)
+
+            return jax.jit(fused_round_fn,
+                           donate_argnums=self._donate_argnums(0, 1, 2, 3))
+
+        return self._plan_cached("_fused_round_jit_cache", k, build)
+
+    def _run_fused_window(self, params, bstats, per_params, per_bstats,
+                          masks, round_idx: int, k: int):
+        """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan;
+        host-side sampling/rng/lr precomputed per round (reference
+        ``np.random.seed(round_idx)`` contract untouched). Returns the
+        new state, per-round sampled sets (for the host-side stat
+        accounting), the boundary round's loss, and the actual window
+        length."""
+        sampled, idx, rngs, lrs, k = self._window_host_inputs(round_idx, k)
+        (params, bstats, per_params, per_bstats,
+         losses) = self._fused_round_jit(k)(
+            params, bstats, per_params, per_bstats, self.data, masks,
+            idx, rngs, lrs)
+        return (params, bstats, per_params, per_bstats, sampled,
+                losses[-1], k)
+
+    def _eval_ckpt_hooks(self, round_idx, params, bstats, per_params,
+                         per_bstats, masks, loss, history):
+        """The sequential loop's per-round hook tail (eval cadence +
+        checkpoint), shared verbatim by the fused windows — which, by the
+        window planner's construction, reach here exactly on the rounds
+        the sequential loop would have evaluated/checkpointed."""
+        cfg = self.cfg
+        if round_idx % cfg.fed.frequency_of_the_test == 0 \
+                or round_idx == cfg.fed.comm_round - 1:
+            m = self._eval_g(params, bstats)
+            mp = self._eval_p(per_params, per_bstats)
+            self.stat_info["global_test_acc"].append(m["acc"])
+            self.stat_info["person_test_acc"].append(mp["acc"])
+            self.log.metrics(round_idx, train_loss=loss, **m,
+                             personal_acc=mp["acc"])
+            history.append({"round": round_idx,
+                            "train_loss": float(loss), **m,
+                            "personal_acc": mp["acc"]})
+        self.maybe_checkpoint(round_idx, {
+            "params": params, "batch_stats": bstats,
+            "per_params": per_params, "per_bstats": per_bstats,
+            "masks": masks, "history": history})
 
     def train(self):
         cfg = self.cfg
@@ -277,7 +356,30 @@ class SalientGradsEngine(FederatedEngine):
             history = restored["history"]
         if self.stream is not None:
             self.stream.prefetch_train(*self.stream_sampling(start))
-        for round_idx in range(start, cfg.fed.comm_round):
+        fuse = (cfg.fed.rounds_per_dispatch > 1
+                and self.fused_fallback_reason() is None)
+        round_idx = start
+        while round_idx < cfg.fed.comm_round:
+            k = self._dispatch_window(round_idx) if fuse else 1
+            if k > 1:
+                (params, bstats, per_params, per_bstats, window_sampled,
+                 loss, k) = self._run_fused_window(
+                    params, bstats, per_params, per_bstats, masks,
+                    round_idx, k)
+                # per-round host-side accounting, identical to the
+                # sequential loop's (host data only — no device sync)
+                for off, s in enumerate(window_sampled):
+                    n_samples = float(np.sum(self._n_train_host[s]))
+                    self.stat_info["sum_training_flops"] += (
+                        flops_per_sample * cfg.optim.epochs * n_samples)
+                    self.stat_info["sum_comm_params"] += (
+                        comm_params_per_client * len(s))
+                round_idx += k - 1  # boundary hooks below
+                self._eval_ckpt_hooks(round_idx, params, bstats,
+                                      per_params, per_bstats, masks, loss,
+                                      history)
+                round_idx += 1
+                continue
             sampled = self.client_sampling(round_idx)
             self.log.info("################ round %d: clients %s",
                           round_idx, sampled.tolist())
@@ -323,21 +425,9 @@ class SalientGradsEngine(FederatedEngine):
                 flops_per_sample * cfg.optim.epochs * n_samples)
             self.stat_info["sum_comm_params"] += (comm_params_per_client
                                                   * len(sampled))
-            if round_idx % cfg.fed.frequency_of_the_test == 0 \
-                    or round_idx == cfg.fed.comm_round - 1:
-                m = self._eval_g(params, bstats)
-                mp = self._eval_p(per_params, per_bstats)
-                self.stat_info["global_test_acc"].append(m["acc"])
-                self.stat_info["person_test_acc"].append(mp["acc"])
-                self.log.metrics(round_idx, train_loss=loss, **m,
-                                 personal_acc=mp["acc"])
-                history.append({"round": round_idx,
-                                "train_loss": float(loss), **m,
-                                "personal_acc": mp["acc"]})
-            self.maybe_checkpoint(round_idx, {
-                "params": params, "batch_stats": bstats,
-                "per_params": per_params, "per_bstats": per_bstats,
-                "masks": masks, "history": history})
+            self._eval_ckpt_hooks(round_idx, params, bstats, per_params,
+                                  per_bstats, masks, loss, history)
+            round_idx += 1
         m_global = self._eval_g(params, bstats)
         m_person = self._eval_p(per_params, per_bstats)
         self.log.metrics(-1, global_=m_global, personal=m_person)

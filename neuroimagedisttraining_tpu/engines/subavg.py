@@ -161,11 +161,15 @@ class SubFedAvgEngine(FederatedEngine):
             return self._round_body(params, bstats, mask_pers, Xs, ys, ns,
                                     sampled_idx, rngs, lr)
 
-        return jax.jit(round_fn)
+        # donation: global model + the persistent per-client mask stack
+        # are consumed; the driver rebinds all three on return
+        return jax.jit(round_fn,
+                       donate_argnums=self._donate_argnums(0, 1, 2))
 
     @functools.cached_property
     def _round_stream_jit(self):
-        return jax.jit(self._round_body)
+        return jax.jit(self._round_body,
+                       donate_argnums=self._donate_argnums(0, 1, 2))
 
     @functools.cached_property
     def _eval_masked_global_jit(self):

@@ -106,11 +106,23 @@ class TurboAggregateEngine(FedAvgEngine):
             return self._train_only_body(params, bstats, Xs, ys, ns, rngs,
                                          lr)
 
-        return jax.jit(round_fn)
+        # donation: bstats only — the [S, ...]-stacked ``weighted`` output
+        # has no input of matching shape, so donating ``params`` would be
+        # an unusable donation (ignored with a warning), and the wrapper
+        # below never rereads either input after dispatch
+        return jax.jit(round_fn, donate_argnums=self._donate_argnums(1))
 
     @functools.cached_property
     def _train_only_stream_jit(self):
-        return jax.jit(self._train_only_body)
+        return jax.jit(self._train_only_body,
+                       donate_argnums=self._donate_argnums(1))
+
+    def fused_fallback_reason(self) -> str | None:
+        # overrides FedAvg's: even the device MPC backend is a host-driven
+        # two-stage dispatch (train program -> share/aggregate program with
+        # a per-round host-side mask seed), and the host backend crosses
+        # the process boundary by design
+        return "the MPC aggregation stage is host-driven between rounds"
 
     @functools.cached_property
     def _secure_agg_jit(self):

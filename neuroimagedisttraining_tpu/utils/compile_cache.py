@@ -1,0 +1,40 @@
+"""Persistent XLA compilation-cache plumbing (ISSUE 4 satellite).
+
+The flagship 3D-CNN round program costs ~30 s to compile; with the
+persistent cache the compile is paid once per machine, not once per
+process — repeat experiments, every silo process of a cross-silo run,
+and bench reruns all hit the disk cache. One resolution order everywhere
+(both CLIs and bench.py): explicit flag value > ``NIDT_COMPILE_CACHE``
+env var > the caller's default. An empty resolved path disables caching.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: shared default for the CLIs ("" = caller opts out by default)
+DEFAULT_CACHE_DIR = "/tmp/nidt_jax_cache"
+
+
+def enable_compile_cache(path: str | None = None,
+                         default: str = DEFAULT_CACHE_DIR) -> str | None:
+    """Point JAX's persistent compilation cache at a directory.
+
+    ``path=None`` means "not specified on the command line": the
+    ``NIDT_COMPILE_CACHE`` env var is consulted, then ``default``.
+    An explicit empty string (or empty resolution) disables the cache.
+    Returns the directory in effect, or None when disabled. Call BEFORE
+    the first compilation — entries written earlier in the process are
+    not retroactively cached."""
+    if path is None:
+        path = os.environ.get("NIDT_COMPILE_CACHE") or default
+    if not path:
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache everything that took meaningfully long to build; the 0.2 s
+    # floor skips trivial op-by-op executables whose disk round-trip
+    # costs more than recompiling
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    return path

@@ -22,7 +22,9 @@ fi
 # schedule to the same seeded-stream contract as the engines, and the
 # trace-safety rules apply to codec/device.py's jitted encode math
 # (lossy_roundtrip runs inside every codec-enabled engine round).
-echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism) =="
+# the donation-discipline family (ISSUE 4) rides along: round programs
+# must declare donate_argnums, and no caller may reread a donated buffer
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
 

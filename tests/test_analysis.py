@@ -57,9 +57,9 @@ def test_trace_resolves_local_def_passed_to_jit():
         import numpy as np
 
         def build():
-            def round_fn(x):
+            def step_fn(x):
                 return np.asarray(x)
-            return jax.jit(round_fn)
+            return jax.jit(step_fn)
         """)
     assert rules_of(fs) == ["trace-host-sync"]
 
@@ -82,17 +82,18 @@ def test_trace_resolves_self_method_and_partial_wrapper():
         import jax
 
         class Engine:
-            def _round_body(self, x):
+            def _step_body(self, x):
                 return jax.device_get(x)
 
             def _consensus(self, x, plan=None):
                 return x.item()
 
-            def _round_jit(self):
-                return jax.jit(self._round_body)
+            def _step_jit(self):
+                return jax.jit(self._step_body)
 
             def _consensus_jit(self, plan):
-                return jax.jit(functools.partial(self._consensus, plan=plan))
+                return jax.jit(functools.partial(self._consensus, plan=plan),
+                               donate_argnums=(0,))
         """)
     assert rules_of(fs) == ["trace-host-sync", "trace-host-sync"]
 
@@ -102,14 +103,14 @@ def test_trace_flags_nested_helper_inside_traced_fn():
         import jax
 
         def build():
-            def round_fn(xs):
+            def step_fn(xs):
                 def per_client(x):
                     return x.tolist()
                 return jax.vmap(per_client)(xs)
-            return jax.jit(round_fn)
+            return jax.jit(step_fn)
         """)
     # per_client is flagged once even though it is doubly traced
-    # (lexically inside round_fn AND passed to vmap)
+    # (lexically inside step_fn AND passed to vmap)
     assert rules_of(fs) == ["trace-host-sync"]
 
 
@@ -168,10 +169,10 @@ def test_trace_ignores_host_code_and_jnp():
         import jax.numpy as jnp
         import numpy as np
 
-        def round_jit():
-            def round_fn(x):
+        def step_jit():
+            def step_fn(x):
                 return jnp.asarray(x) + 1  # jnp is trace-safe
-            return jax.jit(round_fn)
+            return jax.jit(step_fn)
 
         def host_driver(fn, x):
             out = fn(x)                    # calling a jitted fn is fine
@@ -498,3 +499,131 @@ def test_every_shipped_pragma_carries_a_justification():
                 assert pragma.justification, (fp, pragma.line)
                 assert pragma.rule_ids, (fp, pragma.line)
     assert seen >= 10  # the reference-parity shims are annotated
+
+
+# ---------------- donation discipline (ISSUE 4) ----------------
+
+def test_donation_missing_flags_undeclared_round_jit():
+    fs = lint("""
+        import jax
+
+        class E:
+            @property
+            def _round_jit(self):
+                def round_fn(params, bstats):
+                    return params
+                return jax.jit(round_fn)
+        """, rules=["donation-missing"])
+    assert rules_of(fs) == ["donation-missing"]
+
+
+def test_donation_missing_accepts_gating_call_and_pragma():
+    fs = lint("""
+        import jax
+
+        class E:
+            @property
+            def _round_jit(self):
+                def round_fn(params, bstats):
+                    return params
+                return jax.jit(round_fn,
+                               donate_argnums=self._donate_argnums(0, 1))
+
+            @property
+            def _consensus_jit(self):
+                def consensus_fn(per):
+                    return per
+                return jax.jit(consensus_fn)  # nidt: allow[donation-missing] -- outputs alias no input shape
+        """, rules=["donation-missing"])
+    assert fs == []
+
+
+def test_donation_missing_ignores_non_round_jits():
+    fs = lint("""
+        import jax
+
+        def make():
+            def eval_all(params, X):
+                return params
+            return jax.jit(eval_all)
+        """, rules=["donation-missing"])
+    assert fs == []
+
+
+def test_donation_use_after_donate_flags_read():
+    fs = lint("""
+        import jax
+
+        class E:
+            @property
+            def _round_jit(self):
+                def round_fn(params, bstats):
+                    return params, bstats
+                return jax.jit(round_fn, donate_argnums=(0, 1))
+
+            def train(self, params, bstats):
+                out, new_b = self._round_jit(params, bstats)
+                leak = params
+                return out, leak
+        """, rules=["donation-use-after-donate"])
+    assert rules_of(fs) == ["donation-use-after-donate"]
+
+
+def test_donation_use_after_donate_same_statement_rebind_is_clean():
+    fs = lint("""
+        import jax
+
+        class E:
+            @property
+            def _round_jit(self):
+                def round_fn(params, bstats, rngs):
+                    return params, bstats, 0.0
+                return jax.jit(round_fn,
+                               donate_argnums=self._donate_argnums(0, 1))
+
+            def train(self, params, bstats, rngs):
+                for r in range(3):
+                    params, bstats, loss = self._round_jit(params, bstats,
+                                                           rngs)
+                return params, bstats, rngs  # rngs was never donated
+        """, rules=["donation-use-after-donate"])
+    assert fs == []
+
+
+def test_donation_use_after_donate_resolves_jit_factories():
+    fs = lint("""
+        import jax
+
+        class E:
+            def _round_jit_for(self, plan):
+                def round_fn(per, b, M):
+                    return per, b
+                return jax.jit(round_fn, donate_argnums=(0, 1))
+
+            def train(self, per, b, plan, M):
+                out = self._round_jit_for(plan)(per, b, M)
+                stale = per
+                return out, stale
+        """, rules=["donation-use-after-donate"])
+    assert rules_of(fs) == ["donation-use-after-donate"]
+    # ...and the factory's own argument (plan) is NOT treated as donated
+    assert "'per'" in fs[0].message
+
+
+def test_donation_use_after_donate_rebind_then_read_is_clean():
+    fs = lint("""
+        import jax
+
+        class E:
+            @property
+            def _round_jit(self):
+                def round_fn(params):
+                    return params
+                return jax.jit(round_fn, donate_argnums=(0,))
+
+            def train(self, params):
+                out = self._round_jit(params)
+                params = out
+                return params
+        """, rules=["donation-use-after-donate"])
+    assert fs == []

@@ -76,6 +76,7 @@ def test_fedprox_end_to_end_and_prox_pull_direction(tmp_path,
 
     def one_round_drift(algorithm, **fed_kw):
         e = _engine(tmp_path, synthetic_cohort, algorithm, **fed_kw)
+        e._donate = False  # gs.params is reread after the dispatch
         gs = e.init_global_state()
         sampled = jnp.asarray(e.client_sampling(0))
         rngs = e.per_client_rngs(0, np.asarray(sampled))
@@ -102,6 +103,7 @@ def test_fedprox_composes_with_byzantine_clipping(tmp_path,
     def poisoned_round(**fed_kw):
         e = _engine(tmp_path, synthetic_cohort, "fedprox", lamda=0.01,
                     **fed_kw)
+        e._donate = False  # gs.params is reread after the dispatch
         gs = e.init_global_state()
         data = e.data
         Xb = data.X_train.at[0].set(255)

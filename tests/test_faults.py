@@ -10,6 +10,7 @@ same schedule, and SecureFedAvgServer under dropout.
 """
 
 import multiprocessing as mp
+import socket
 import threading
 import time
 
@@ -391,6 +392,20 @@ def test_late_rejoin_via_reregister():
         time.sleep(0.05)
     assert any(e.get("survivors") == [1] for e in server.history), \
         "client 2 never dropped out"
+    # the server's deadline verdict can precede the crash itself: the
+    # crash fires on c2's dispatch thread when it processes the round-1
+    # sync, and under load that thread may lag the 0.5s deadline — so
+    # wait for the crashed listener to actually release the port before
+    # the replacement binds it (EADDRINUSE otherwise)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as probe:
+            probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                probe.bind(("0.0.0.0", bp + 2))
+                break
+            except OSError:
+                time.sleep(0.05)
     # a fresh healthy process takes over rank 2 and re-registers
     c2b = _make_client(2, num_clients, bp)
     t2b = threading.Thread(target=c2b.run, daemon=True)
@@ -720,6 +735,9 @@ def test_engine_survivor_round_is_frac_sampled_round(tmp_path,
     eng_f = _make_engine(tmp_path, synthetic_cohort,
                          fault_spec="crash:2@1")
     eng_c = _make_engine(tmp_path, synthetic_cohort)
+    # the same state tuple rides into BOTH round programs; donation
+    # (ISSUE 4) would delete it at the first dispatch
+    eng_f._donate = eng_c._donate = False
     surv = eng_f.client_sampling(1)
     gs = eng_c.init_global_state()
     rngs = eng_c.per_client_rngs(1, surv)

@@ -164,6 +164,7 @@ def test_dpsgd_ring_round_ppermute_matches_einsum(tmp_path,
                            console=False)
     engine = create_engine("dpsgd", cfg, fed, trainer, mesh=mesh,
                            logger=log)
+    engine._donate = False  # same buffers replayed through both lowerings
     M_np = engine.mixing_matrix(0)
     plan, plan_arrays = engine.gossip_plan(M_np)
     assert plan is not None, "ring @ 8 real clients on 8 devices must plan"
@@ -297,6 +298,7 @@ def test_dpsgd_random_round_sparse_matches_einsum(tmp_path):
                            console=False)
     engine = create_engine("dpsgd", cfg, fed, trainer, mesh=mesh,
                            logger=log)
+    engine._donate = False  # same buffers replayed through both lowerings
     M_np = engine.mixing_matrix(0)
     plan, plan_arrays = engine.gossip_plan(M_np)
     assert isinstance(plan, SparseSpec), "cs=random must take the sparse plan"
@@ -355,6 +357,7 @@ def test_dispfl_random_consensus_sparse_matches_einsum(tmp_path):
                            console=False)
     engine = create_engine("dispfl", cfg, fed, trainer, mesh=mesh,
                            logger=log)
+    engine._donate = False  # same buffers replayed through both lowerings
     A_np = engine.adjacency(0, engine.active_draw(0))
     plan, plan_arrays = engine.gossip_plan(A_np)
     assert isinstance(plan, SparseSpec), "random adjacency must plan sparse"

@@ -105,6 +105,7 @@ def test_fedavg_round_clipping_bounds_byzantine_update(tmp_path,
     from tests.test_fedavg import _make_engine
 
     def poisoned_round(engine):
+        engine._donate = False  # gs.params is reread after the dispatch
         gs = engine.init_global_state()
         data = engine.data
         # client 0's labels adversarially flipped + inputs scaled: huge

@@ -54,6 +54,10 @@ def _cfg(tmp_path, algorithm, **fed_kw):
 
 
 def _scale_engine(tmp_path, cohort, algorithm, streaming=False, **fed_kw):
+    # these tests replay ONE init state through resident and streamed
+    # programs to compare outputs; buffer donation (ISSUE 4) would delete
+    # the shared buffers at the first dispatch, so it is off here (the
+    # donated path is pinned bitwise in tests/test_dispatch.py)
     cfg = _cfg(tmp_path, algorithm, **fed_kw)
     mesh = make_mesh()
     trainer = LocalTrainer(create_model(cfg.model, num_classes=1),
@@ -66,12 +70,16 @@ def _scale_engine(tmp_path, cohort, algorithm, streaming=False, **fed_kw):
         stream = StreamingFederation(np.asarray(cohort["X"]),
                                      np.asarray(cohort["y"]),
                                      train_map, test_map, mesh=mesh)
-        return create_engine(algorithm, cfg, None, trainer, mesh=mesh,
-                             logger=log, stream=stream)
+        eng = create_engine(algorithm, cfg, None, trainer, mesh=mesh,
+                            logger=log, stream=stream)
+        eng._donate = False
+        return eng
     fed, _ = federate_cohort(cohort, partition_method="rescale",
                              client_number=C, mesh=mesh)
-    return create_engine(algorithm, cfg, fed, trainer, mesh=mesh,
-                         logger=log)
+    eng = create_engine(algorithm, cfg, fed, trainer, mesh=mesh,
+                        logger=log)
+    eng._donate = False
+    return eng
 
 
 def test_fedavg_100clients_resident(tmp_path, scale_cohort):

@@ -64,6 +64,21 @@ def test_fetch_rows_unsorted_and_duplicate_indices(h5_cohort):
     lazy["file"].close()
 
 
+def _assert_final_metrics(a, b):
+    """Final-eval parity with float32-ulp slack on the mean losses: the
+    resident and streamed paths of these engines run STRUCTURALLY
+    different programs (one fused round vs consensus/agg + chunked
+    blocks), and buffer donation (ISSUE 4) changes XLA's in-place fusion
+    layout, which can reassociate the scalar loss reductions by an ulp.
+    Count-based metrics (acc/auc) must still match exactly."""
+    assert set(a) == set(b)
+    for k in sorted(a):
+        if k == "loss":
+            np.testing.assert_allclose(b[k], a[k], rtol=1e-6)
+        else:
+            assert a[k] == b[k], (k, a, b)
+
+
 def _run_algo(algo, cohort_or_stream, streaming: bool, tmp_path, tag,
               mesh=None, val_fraction=0.0, **cfg_extra):
     cfg = ExperimentConfig(
@@ -286,7 +301,7 @@ def test_streaming_dpsgd_identical_to_resident(h5_cohort, tmp_path):
                                    rtol=1e-6)
         assert r_res["personal_acc"] == r_st["personal_acc"]
         assert r_res["global_acc"] == r_st["global_acc"]
-    assert res["final_global"] == st["final_global"]
+    _assert_final_metrics(res["final_global"], st["final_global"])
 
 
 def test_streaming_turboaggregate_identical_to_resident(h5_cohort,
@@ -339,11 +354,17 @@ def test_streaming_fedfomo_identical_to_resident(h5_cohort, tmp_path):
         np.testing.assert_allclose(r_st["train_loss"], r_res["train_loss"],
                                    rtol=1e-6)
         assert r_res["personal_acc"] == r_st["personal_acc"]
-    assert res["final_personal"] == st["final_personal"]
-    np.testing.assert_array_equal(np.asarray(res["weights"]),
-                                  np.asarray(st["weights"]))
-    np.testing.assert_array_equal(np.asarray(res["p_choose"]),
-                                  np.asarray(st["p_choose"]))
+    _assert_final_metrics(res["final_personal"], st["final_personal"])
+    # fomo weights divide ulp-scale val-loss gaps by small parameter
+    # distances, so the resident-vs-streamed codegen difference donation
+    # introduces (see _assert_final_metrics) is AMPLIFIED here — the
+    # matrices agree to ~1e-5 relative, not bitwise
+    np.testing.assert_allclose(np.asarray(res["weights"]),
+                               np.asarray(st["weights"]),
+                               rtol=5e-5, atol=5e-5)
+    np.testing.assert_allclose(np.asarray(res["p_choose"]),
+                               np.asarray(st["p_choose"]),
+                               rtol=5e-5, atol=5e-5)
 
 
 def test_streaming_fedfomo_requires_val_map(h5_cohort, tmp_path):
