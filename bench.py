@@ -166,7 +166,7 @@ def main() -> None:
 
     # compile + warmup (value sync: block_until_ready proved unreliable
     # through the remote-TPU tunnel — see PROFILE.md finding 3)
-    params, bstats, loss = one_round(params, bstats, 0)
+    params, bstats, loss, _ = one_round(params, bstats, 0)
     float(loss)
 
     # best-of-N timed repeats: the harness TPU is time-shared and the
@@ -179,7 +179,7 @@ def main() -> None:
     for _ in range(reps):
         t0 = time.perf_counter()
         for r in range(n_rounds):
-            params, bstats, loss = one_round(params, bstats, r + 1)
+            params, bstats, loss, _ = one_round(params, bstats, r + 1)
         # the final loss depends on the final params chain => full sync
         float(loss)
         sps = max(sps, samples / (time.perf_counter() - t0))
@@ -212,7 +212,7 @@ def main() -> None:
 
         def seq_chain(p, b):
             for r in range(K_disp):
-                p, b, l = engine._round_jit(
+                p, b, l, _ = engine._round_jit(
                     p, b, fed, jnp.asarray(samp_list[r]), rngs_list[r],
                     lrs_list[r])
             return float(l)
@@ -231,7 +231,7 @@ def main() -> None:
         lrs_k = jnp.asarray(lrs_list, jnp.float32)
 
         def fused_chain(p, b):
-            p, b, losses = fused(p, b, fed, samp_k, rngs_k, lrs_k)
+            p, b, losses, _ = fused(p, b, fed, samp_k, rngs_k, lrs_k)
             return float(losses[-1])
 
         fused_chain(copy_tree(params), copy_tree(bstats))  # compile+warm
@@ -376,7 +376,7 @@ def main() -> None:
             out = sg._round_jit(params, bstats, dper.params,
                                 dper.batch_stats, fed, masks, sampled,
                                 rngs_s, lr)
-            _sync(out[-1], jax.tree.leaves(out[0])[0])
+            _sync(out[-2], jax.tree.leaves(out[0])[0])
 
         algo_round_s["salientgrads_masked"] = _bestof(salientgrads_round)
 
@@ -388,7 +388,7 @@ def main() -> None:
 
         def fedprox_round():
             out = fp._round_jit(params, bstats, fed, sampled, rngs_s, lr)
-            _sync(out[-1], jax.tree.leaves(out[0])[0])
+            _sync(out[-2], jax.tree.leaves(out[0])[0])
 
         algo_round_s["fedprox"] = _bestof(fedprox_round)
 
@@ -428,11 +428,11 @@ def main() -> None:
 
         def turbo_round():
             out = ta._round_jit(params, bstats, fed, sampled, rngs_s, lr)
-            _sync(out[-1], jax.tree.leaves(out[0])[0])
+            _sync(out[-2], jax.tree.leaves(out[0])[0])
 
         algo_round_s["turboaggregate"] = _bestof(turbo_round)
-        weighted, _, _ = ta._train_only_jit(params, bstats, fed, sampled,
-                                            rngs_s, lr)
+        weighted, _, _, _ = ta._train_only_jit(params, bstats, fed, sampled,
+                                               rngs_s, lr)
         _sync(jax.tree.leaves(weighted)[0])
         jax.block_until_ready(ta.secure_aggregate(weighted, 0))  # warm
         t0 = time.perf_counter()
@@ -456,7 +456,7 @@ def main() -> None:
 
     ref_host = {"params": jax.tree.map(np.asarray, params),
                 "batch_stats": jax.tree.map(np.asarray, bstats)}
-    p2, b2, loss2 = one_round(params, bstats, n_rounds + 1)
+    p2, b2, loss2, _ = one_round(params, bstats, n_rounds + 1)
     float(loss2)
     upd_host = {"params": jax.tree.map(np.asarray, p2),
                 "batch_stats": jax.tree.map(np.asarray, b2)}

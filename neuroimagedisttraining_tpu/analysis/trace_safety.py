@@ -11,7 +11,12 @@ rule marks a function as traced when it is
 - passed (possibly through ``functools.partial``) to ``jax.jit``,
   ``jax.vmap``, ``jax.pmap``, ``pjit`` or ``shard_map`` — resolved
   lexically: local ``def``s by enclosing-scope name lookup, methods by
-  ``self.<name>`` within the class, lambdas in place,
+  ``self.<name>`` within the class, lambdas in place, or
+- CALLED from a traced body by a lexically resolvable name (bare name
+  or ``self.<name>``), transitively — the tracer does not stop at a
+  call boundary, so ``jax.vmap(lambda u: attack(u, ref))`` traces
+  ``attack``'s body too (the faults/adversary.py idiom, ISSUE 5);
+  foreign attributes (``module.fn``) still lint in their own file,
 
 and then flags the calls above anywhere lexically inside it (nested
 helpers included). Calls *of* the traced function, and host code that
@@ -173,6 +178,29 @@ def collect_traced(mod: ModuleInfo) -> list[ast.AST]:
         for idx in TRACERS[normalize(dotted_name(node.func), aliases)]:
             if idx < len(node.args):
                 mark_target(node, node.args[idx])
+
+    # transitive closure (ISSUE 5): a call from inside a traced body to
+    # a lexically resolvable function (bare name / self-method) traces
+    # the callee's body too — jax.vmap(lambda u: attack(u, ref)) runs
+    # attack under the tracer just as surely as attack's own decorator
+    # would. Foreign attributes (module.fn) are not resolvable here and
+    # lint in their own file.
+    work = list(traced.values())
+    while work:
+        root = work.pop()
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = index.resolve_name(node, node.func.id)
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id in ("self", "cls")):
+                callee = index.resolve_method(node, node.func.attr)
+            if isinstance(callee, _FUNCS) and id(callee) not in traced:
+                traced[id(callee)] = callee
+                work.append(callee)
     return list(traced.values())
 
 

@@ -48,6 +48,9 @@ class DataConfig:
     # Synthetic-ABCD knobs (tests / benchmarks without the private cohort).
     synthetic_num_subjects: int = 256
     synthetic_shape: tuple[int, int, int] = (121, 145, 121)
+    synthetic_signal: float = 12.0  # class-blob amplitude vs the fixed
+    # sigma-8 voxel noise — lower it for harder tasks (run_byz_bench.sh
+    # uses a low-signal cohort so a Byzantine slowdown is visible in AUC)
     seed_split: int = 42           # per-site 80/20 split seed (ABCD/data_loader.py:82-86)
     val_fraction: float = 0.0      # >0 adds per-client validation split (FedFomo 9-tuple)
 
@@ -95,10 +98,19 @@ class FedConfig:
     fomo_m: int = 5                # number of models requested per round
     # Robust aggregation (fedml_core/robustness/robust_aggregation.py:32-55;
     # the reference constructs RobustAggregator(args) from defense_type /
-    # norm_bound / stddev flags)
-    defense_type: str = "none"     # none | norm_diff_clipping | weak_dp
+    # norm_bound / stddev flags). Byzantine-robust aggregators (ISSUE 5,
+    # core/robust.py): trimmed_mean | median | krum | multi_krum |
+    # geometric_median replace the weighted mean with an order statistic
+    # tolerating up to byz_f arbitrary (value-faulty) clients.
+    defense_type: str = "none"     # none | norm_diff_clipping | weak_dp |
+    # trimmed_mean | median | krum | multi_krum | geometric_median
     norm_bound: float = 5.0        # clip threshold for the update-norm diff
     stddev: float = 0.05           # weak-DP Gaussian noise stddev
+    byz_f: int = 1                 # assumed Byzantine count f: trim depth
+    # per side (trimmed_mean), Krum's score neighborhood (needs the
+    # sampled cohort n >= f + 3; trimmed_mean/median need 2f < n)
+    geomed_iters: int = 8          # fixed Weiszfeld iterations
+    # (geometric_median; trace-static so fused dispatch stays one program)
     # TurboAggregate secure aggregation (additive shares over GF(p))
     mpc_n_shares: int = 3          # shares per client update (paper: one
     # per neighbor group)

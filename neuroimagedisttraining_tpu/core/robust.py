@@ -16,16 +16,60 @@ under Byzantine clients") defines the behavior contract. Defenses compose as
 pure functions on stacked client pytrees, applied between local training and
 ``tree_weighted_mean`` — inside the jitted round program, so the per-client
 clip norms reduce over the client mesh axis on ICI.
+
+Byzantine-robust aggregators (ISSUE 5). Clipping bounds an update's
+*magnitude* but not its *direction*: a sign-flipped update inside the
+norm bound passes untouched and still drags the mean. The order-
+statistic family closes that gap with provable breakdown points:
+
+- ``trimmed_mean`` / ``median`` — coordinate-wise trimmed mean and
+  median (Yin et al. 2018): per coordinate, sort the client values,
+  drop the ``byz_f`` smallest and largest (median: keep the middle),
+  average the rest. Tolerates any f < n/2 arbitrary clients.
+- ``krum`` / ``multi_krum`` — Krum (Blanchard et al. 2017): score each
+  client by the summed squared distances to its n−f−2 nearest peers,
+  select the lowest-scoring client (multi-Krum: the best n−f−2) and
+  average the selection. Requires n ≥ f + 3.
+- ``geometric_median`` — the classical robust center, approximated by a
+  fixed-iteration Weiszfeld loop (``lax.fori_loop``, trace-static
+  iteration count so fused K-round windows stay one compiled program).
+
+Unlike the clip family these REPLACE the weighted mean rather than
+preceding it: ``aggregate_with_defense`` is the single dispatch the
+engines and the cross-silo server call — clip-family defenses run
+per-client and fall through to ``mean_fn`` (the engine's silo-aware
+weighted mean), order-statistic defenses consume the stacked updates
+whole. Weighting stays consistent with ``tree_weighted_mean``: surviving
+coordinates/selections are combined with the clients' sample-count
+weights renormalized over the survivors (the unweighted coordinate
+median is the one exception — a weighted order statistic has no exact
+streaming form; documented at the function).
 """
 
 from __future__ import annotations
+
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
 
 from neuroimagedisttraining_tpu.utils import pytree as pt
 
-DEFENSES = ("none", "norm_diff_clipping", "weak_dp")
+#: clip-family defenses: per-client transforms BEFORE the weighted mean
+CLIP_DEFENSES = ("none", "norm_diff_clipping", "weak_dp")
+#: order-statistic defenses: replace the weighted mean outright
+ROBUST_AGGREGATORS = ("trimmed_mean", "median", "krum", "multi_krum",
+                      "geometric_median")
+DEFENSES = CLIP_DEFENSES + ROBUST_AGGREGATORS
+
+
+def validate_defense(name: str) -> str:
+    """Fail loudly at STARTUP on an unknown defense name (an unknown
+    ``--defense`` must never surface as a mid-round trace error)."""
+    if name not in DEFENSES:
+        raise ValueError(
+            f"unknown defense {name!r}; one of {', '.join(DEFENSES)}")
+    return name
 
 
 def norm_diff_clip(local_params, global_params, norm_bound):
@@ -57,11 +101,15 @@ def defend_stacked(stacked_params, global_params, *, defense: str,
     ``weak_dp``: clipping + per-client Gaussian noise (the weak-DP defense
     uses the clipped update as its sensitivity bound, so noise composes on
     top of clipping). ``rngs``: [C] stacked PRNG keys, required for weak_dp.
+
+    Order-statistic defenses (``ROBUST_AGGREGATORS``) pass through
+    UNCHANGED — they act at aggregation time (``robust_aggregate``), not
+    per client; ``aggregate_with_defense`` is the dispatch that runs
+    both stages in the right order.
     """
-    if defense == "none":
+    validate_defense(defense)
+    if defense == "none" or defense in ROBUST_AGGREGATORS:
         return stacked_params
-    if defense not in DEFENSES:
-        raise ValueError(f"unknown defense {defense!r}; one of {DEFENSES}")
     clipped = jax.vmap(lambda p: norm_diff_clip(p, global_params, norm_bound)
                        )(stacked_params)
     if defense == "weak_dp":
@@ -70,3 +118,289 @@ def defend_stacked(stacked_params, global_params, *, defense: str,
         clipped = jax.vmap(
             lambda p, r: add_weak_dp_noise(p, r, stddev))(clipped, rngs)
     return clipped
+
+
+# ---------------------------------------------------------------------------
+# non-finite upload guard (ISSUE 5 satellite): a single NaN/Inf client
+# poisons tree_weighted_mean (0·NaN = NaN), so rounds sanitize BEFORE
+# any aggregation — independent of the --defense flag
+# ---------------------------------------------------------------------------
+
+def finite_per_client(stacked) -> jax.Array:
+    """[C] bool: client c's row is finite in EVERY leaf."""
+    def per_client(tree):
+        flags = [jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+                 for x in jax.tree.leaves(tree)]
+        return jnp.stack(flags).all() if flags else jnp.bool_(True)
+
+    return jax.vmap(per_client)(stacked)
+
+
+def replace_nonfinite_clients(stacked, reference, finite: jax.Array):
+    """Swap each non-finite client's row for the round's broadcast
+    ``reference`` (a no-op update — neutral for the order-statistic
+    defenses, and exactly what a client that never trained would have
+    uploaded). Callers also zero the client's aggregation weight, so
+    under the weighted mean the row contributes nothing at all."""
+    def leaf(x, r):
+        keep = finite.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.where(keep, x,
+                         r.astype(x.dtype)[None] if hasattr(r, "dtype")
+                         else r)
+
+    return jax.tree.map(leaf, stacked, reference)
+
+
+# ---------------------------------------------------------------------------
+# order-statistic aggregators (jitted; stacked [C, ...] pytrees in, one
+# aggregate tree out)
+# ---------------------------------------------------------------------------
+
+def _client_count(stacked) -> int:
+    leaves = jax.tree.leaves(stacked)
+    if not leaves:
+        raise ValueError("robust aggregation over an empty pytree")
+    return int(leaves[0].shape[0])
+
+
+def _check_f(n: int, f: int, defense: str) -> int:
+    f = int(f)
+    if f < 0:
+        raise ValueError(f"byz_f must be >= 0, got {f}")
+    if defense in ("krum", "multi_krum"):
+        # n >= f+3 is the MECHANICAL floor (the score sums distances to
+        # n-f-2 >= 1 nearest peers); Blanchard et al.'s (f,lambda)-
+        # resilience theorem needs n >= 2f+3 — between the two the
+        # selection is defined but f COLLUDING attackers can win it
+        # (effective_defense warns there; PAPERS.md states the bound)
+        if n < f + 3:
+            raise ValueError(
+                f"{defense} needs n >= byz_f + 3 sampled clients "
+                f"(n={n}, byz_f={f}): the score sums distances to the "
+                "n-f-2 nearest peers (the provable Blanchard guarantee "
+                "additionally needs n >= 2*byz_f + 3)")
+    elif 2 * f >= n:
+        raise ValueError(
+            f"{defense} breakdown point exceeded: needs 2*byz_f < n "
+            f"(n={n}, byz_f={f})")
+    return f
+
+
+def trimmed_mean(stacked, weights: jax.Array, f: int):
+    """Coordinate-wise f-trimmed weighted mean (Yin et al. 2018): per
+    coordinate, sort the voting client values, discard the f smallest
+    and f largest, and average the survivors with the clients' weights
+    (renormalized over the survivors — ``tree_weighted_mean`` over the
+    per-coordinate surviving set).
+
+    Zero-weight rows — streaming mesh pads, non-finite uploads
+    sanitized to the broadcast reference — are not client updates at
+    all and vote here like in ``coordinate_median``: excluded outright
+    (pushed past the voting window in the sort) rather than kept at
+    weight 0, where a trim window landing on only zero-weight rows
+    would 0/eps-collapse the coordinate to 0. The trim depth shrinks to
+    ``(k-1)//2`` per side when the voting cohort k is too small for the
+    configured f (fault-schedule shrinkage past the startup check), so
+    the kept window is never empty."""
+    C = _client_count(stacked)
+    _check_f(C, f, "trimmed_mean")
+    w = weights.astype(jnp.float32)
+    valid = w > 0
+    # pathological all-zero cohort (every client sanitized/padded):
+    # degrade to the uniform trimmed mean over all rows, like krum's
+    # all-zero-selection fallback
+    any_valid = jnp.any(valid)
+    valid = valid | ~any_valid
+    wv = jnp.where(valid, jnp.where(any_valid, w, 1.0), 0.0)
+    k = jnp.sum(valid)  # voting rows (traced scalar)
+    lo = jnp.minimum(jnp.int32(int(f)), (k - 1) // 2)
+    hi = k - lo
+
+    def leaf(x):
+        x32 = x.astype(jnp.float32)
+        vb = valid.reshape((-1,) + (1,) * (x32.ndim - 1))
+        order = jnp.argsort(jnp.where(vb, x32, jnp.inf), axis=0)
+        xs = jnp.take_along_axis(x32, order, axis=0)
+        wb = jnp.broadcast_to(
+            wv.reshape((-1,) + (1,) * (x32.ndim - 1)), x32.shape)
+        ws = jnp.take_along_axis(wb, order, axis=0)
+        keep = ((jnp.arange(C) >= lo) & (jnp.arange(C) < hi)).reshape(
+            (-1,) + (1,) * (x32.ndim - 1))
+        ws = ws * keep
+        num = jnp.sum(xs * ws, axis=0)
+        den = jnp.maximum(jnp.sum(ws, axis=0), 1e-12)
+        return (num / den).astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked)
+
+
+def coordinate_median(stacked, weights: jax.Array | None = None):
+    """Coordinate-wise median (Yin et al. 2018). UNWEIGHTED among the
+    voting rows by design: a sample-weighted order statistic has no
+    exact closed form, and the breakdown-point guarantee (any f < n/2
+    arbitrary clients) is stated for the plain median — documented
+    deviation from the weighted-mean contract.
+
+    ``weights`` (optional) only gates WHO votes: zero-weight rows —
+    streaming mesh pads, non-finite uploads sanitized to the broadcast
+    reference — are not client updates at all and must not drag the
+    median toward the reference, so they are excluded outright (pushed
+    past the voting window in the sort) before the order statistic."""
+    if weights is None:
+        return jax.tree.map(
+            lambda x: jnp.median(x.astype(jnp.float32), axis=0).astype(
+                x.dtype), stacked)
+    valid = weights.astype(jnp.float32) > 0
+    # pathological all-zero cohort (every client sanitized/padded):
+    # degrade to the plain median over all rows like trimmed_mean/krum
+    # (masking EVERY row to +inf would return inf and destroy the model)
+    valid = valid | ~jnp.any(valid)
+    k = jnp.sum(valid)  # voting rows (traced scalar, >= 1)
+    lo, hi = (k - 1) // 2, k // 2
+
+    def leaf(x):
+        x32 = x.astype(jnp.float32)
+        keep = valid.reshape((-1,) + (1,) * (x32.ndim - 1))
+        xs = jnp.sort(jnp.where(keep, x32, jnp.inf), axis=0)
+        med = 0.5 * (jnp.take(xs, lo, axis=0) + jnp.take(xs, hi, axis=0))
+        return med.astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked)
+
+
+def _stacked_matrix(stacked) -> jax.Array:
+    """[C, D] float32 flatten-concat of every client's update vector."""
+    leaves = jax.tree.leaves(stacked)
+    C = leaves[0].shape[0]
+    return jnp.concatenate(
+        [x.astype(jnp.float32).reshape(C, -1) for x in leaves], axis=1)
+
+
+def krum_select(stacked, weights: jax.Array, f: int, m: int) -> jax.Array:
+    """[m] client indices with the lowest Krum scores. Score_i = sum of
+    squared distances to i's n−f−2 nearest OTHER clients (Blanchard et
+    al. 2017). Zero-weight clients (non-finite uploads sanitized to the
+    reference row, streaming mesh pads) are pushed out of the selection
+    with an additive penalty — they are not updates at all."""
+    V = _stacked_matrix(stacked)
+    C = V.shape[0]
+    sq = jnp.sum(V * V, axis=1)
+    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (V @ V.T), 0.0)
+    srt = jnp.sort(d2, axis=1)  # column 0 is the self-distance (0)
+    closest = max(1, C - int(f) - 2)
+    scores = jnp.sum(srt[:, 1:closest + 1], axis=1)
+    scores = scores + jnp.where(weights > 0, 0.0, jnp.float32(1e30))
+    return jnp.argsort(scores)[:m]
+
+
+def krum(stacked, weights: jax.Array, f: int, multi: bool = False):
+    """(multi-)Krum aggregate: select the lowest-score client (multi:
+    the best n−f−2) and return the selection's sample-weighted mean
+    (weights renormalized over the selection, degenerating to the single
+    selected update for m = 1)."""
+    C = _client_count(stacked)
+    _check_f(C, f, "multi_krum" if multi else "krum")
+    m = max(1, C - int(f) - 2) if multi else 1
+    sel = krum_select(stacked, weights, f, m)
+    chosen = jax.tree.map(lambda x: x[sel], stacked)
+    wsel = weights.astype(jnp.float32)[sel]
+    # all-zero selection weights (pathological: every selected client
+    # was sanitized/padded) fall back to uniform over the selection
+    wsel = jnp.where(jnp.sum(wsel) > 0, wsel, jnp.ones_like(wsel))
+    return pt.tree_weighted_mean(chosen, wsel)
+
+
+def geometric_median(stacked, weights: jax.Array, iters: int = 8):
+    """Weighted geometric median via ``iters`` fixed Weiszfeld steps
+    (``lax.fori_loop`` — trace-static, so the fused K-round scan stays
+    one compiled program). Initialized at the weighted mean; an
+    eps-guarded reweighting 1/max(dist, eps) keeps iterates finite when
+    the estimate lands on a client point."""
+    w = weights.astype(jnp.float32)
+    w = jnp.where(jnp.sum(w) > 0, w, jnp.ones_like(w))
+    z0 = pt.tree_weighted_mean(stacked, w)
+
+    def step(_, z):
+        d2 = jax.vmap(
+            lambda u: pt.tree_dot(pt.tree_sub(u, z), pt.tree_sub(u, z))
+        )(stacked)
+        beta = w / jnp.maximum(jnp.sqrt(jnp.maximum(d2, 0.0)), 1e-8)
+        return pt.tree_weighted_mean(stacked, beta)
+
+    return jax.lax.fori_loop(0, int(iters), step, z0)
+
+
+def effective_defense(defense: str, n: int, f: int,
+                      warn: Callable | None = None) -> str:
+    """The defense a cohort of ``n`` clients can actually run: when an
+    order-statistic defense's breakdown requirement fails over ``n``
+    (fault-schedule crashes or a clamped sampling frac can shrink a
+    round's cohort below what the STARTUP check validated — krum needs
+    n >= f+3, trim/median need 2f < n), fall back to ``"none"`` with a
+    warning rather than dying mid-run — the same availability choice
+    the cross-silo server makes for deadline-truncated survivor sets.
+    ``n`` is trace-static (the stacked client axis), so this resolves
+    at trace time, once per cohort size."""
+    if defense not in ROBUST_AGGREGATORS:
+        return defense
+    try:
+        _check_f(n, f, defense)
+    except ValueError as e:
+        if warn is not None:
+            warn("defense %s infeasible over this round's %d-client "
+                 "cohort (%s) - falling back to the plain weighted "
+                 "mean for rounds at this cohort size", defense, n, e)
+        return "none"
+    if defense in ("krum", "multi_krum") and n < 2 * f + 3 \
+            and warn is not None:
+        warn("%s over a %d-client cohort with byz_f=%d is below the "
+             "provable Blanchard bound n >= 2f+3: the selection runs, "
+             "but %d COLLUDING attackers (mutual distance 0) can win "
+             "it — treat the guarantee as empirical at this size",
+             defense, n, f, f)
+    return defense
+
+
+def robust_aggregate(stacked, weights: jax.Array, *, defense: str,
+                     byz_f: int, geomed_iters: int = 8):
+    """Dispatch one order-statistic aggregator over a stacked update
+    tree. ``defense`` must be in ``ROBUST_AGGREGATORS`` (the clip family
+    and ``none`` go through ``defend_stacked`` + a weighted mean — see
+    ``aggregate_with_defense``)."""
+    if defense == "trimmed_mean":
+        return trimmed_mean(stacked, weights, byz_f)
+    if defense == "median":
+        _check_f(_client_count(stacked), byz_f, "median")
+        return coordinate_median(stacked, weights)
+    if defense == "krum":
+        return krum(stacked, weights, byz_f, multi=False)
+    if defense == "multi_krum":
+        return krum(stacked, weights, byz_f, multi=True)
+    if defense == "geometric_median":
+        return geometric_median(stacked, weights, iters=geomed_iters)
+    validate_defense(defense)
+    raise ValueError(
+        f"defense {defense!r} is not an order-statistic aggregator; "
+        f"have {ROBUST_AGGREGATORS}")
+
+
+def aggregate_with_defense(stacked, reference, weights: jax.Array, *,
+                           defense: str, norm_bound: float = 5.0,
+                           stddev: float = 0.0, rngs=None, byz_f: int = 1,
+                           geomed_iters: int = 8,
+                           mean_fn: Callable | None = None):
+    """THE defended-aggregation entry: clip-family defenses transform
+    per client then reduce with ``mean_fn`` (default
+    ``tree_weighted_mean``; engines pass their silo-aware ``aggregate``),
+    order-statistic defenses consume the stacked tree whole. Trace-safe —
+    the engines call this inside their jitted round bodies, the
+    cross-silo server from a host-level jit."""
+    validate_defense(defense)
+    if defense in ROBUST_AGGREGATORS:
+        return robust_aggregate(stacked, weights, defense=defense,
+                                byz_f=byz_f, geomed_iters=geomed_iters)
+    defended = defend_stacked(stacked, reference, defense=defense,
+                              norm_bound=norm_bound, stddev=stddev,
+                              rngs=rngs)
+    fn = mean_fn if mean_fn is not None else pt.tree_weighted_mean
+    return fn(defended, weights)

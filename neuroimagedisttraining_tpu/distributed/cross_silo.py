@@ -59,6 +59,98 @@ def survivor_weighted_mean(trees: list, ns: list[float]):
     return jax.tree.map(lambda x: np.asarray(x), out)
 
 
+#: one compiled defended-aggregation program per (defense, f, iters,
+#: bound) config — the server aggregates with the SAME jitted
+#: core/robust.py dispatch the simulated engines trace into their round
+#: bodies, so a cross-silo defended round matches an engine round over
+#: the same survivor set
+_defended_jit_cache: dict = {}
+
+
+def survivor_defended_mean(trees: list, ns: list[float], reference, *,
+                           defense: str, byz_f: int = 1,
+                           geomed_iters: int = 8, norm_bound: float = 5.0,
+                           stddev: float = 0.0, rngs=None):
+    """Defended aggregation over whatever subset of clients reported:
+    ``--defense`` dispatches through ``robust.aggregate_with_defense``
+    (clip family per client then the weighted mean; order-statistic
+    family replaces the mean). ``reference`` is the round's broadcast
+    model — the clip/sanitize baseline the engines use. ``weak_dp``
+    additionally needs ``rngs`` ([C] stacked per-client PRNG keys, one
+    per reporting silo) and a noise ``stddev``."""
+    from neuroimagedisttraining_tpu.core import robust
+
+    key = (defense, int(byz_f), int(geomed_iters), float(norm_bound),
+           float(stddev))
+    fn = _defended_jit_cache.get(key)
+    if fn is None:
+        if defense == "weak_dp":
+            def agg(stacked, w, ref, rngs):
+                return robust.aggregate_with_defense(
+                    stacked, ref, w, defense=defense,
+                    norm_bound=norm_bound, stddev=stddev, rngs=rngs,
+                    byz_f=byz_f, geomed_iters=geomed_iters)
+        else:
+            def agg(stacked, w, ref):
+                return robust.aggregate_with_defense(
+                    stacked, ref, w, defense=defense,
+                    norm_bound=norm_bound, byz_f=byz_f,
+                    geomed_iters=geomed_iters)
+
+        fn = _defended_jit_cache[key] = jax.jit(agg)
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *trees)
+    args = (stacked, jnp.asarray(ns, jnp.float32),
+            jax.tree.map(jnp.asarray, reference))
+    if defense == "weak_dp":
+        if rngs is None:
+            raise ValueError("weak_dp needs per-client rngs")
+        args = args + (rngs,)
+    out = fn(*args)
+    return jax.tree.map(lambda x: np.asarray(x), out)
+
+
+def tree_all_finite(tree) -> bool:
+    """Host-side: every leaf of ``tree`` is NaN/Inf-free. The server's
+    hard gate on decoded uploads — one non-finite frame folded into the
+    weighted mean poisons the aggregate for every honest silo."""
+    return all(np.isfinite(np.asarray(x, np.float64)).all()
+               for x in jax.tree.leaves(tree))
+
+
+def update_outlier_flags(trees: list, reference, *,
+                         norm_mult: float = 4.0,
+                         cos_thresh: float = -0.5):
+    """Per-silo anomaly flags over one round's decoded uploads: silo i is
+    flagged when its update delta (vs the round's broadcast
+    ``reference``) has norm > ``norm_mult`` x the cohort median, or
+    cosine < ``cos_thresh`` against the mean delta of the OTHER silos
+    (a sign-flipped upload scores ~-1 there; leave-one-out keeps a big
+    attacker from dragging the comparison direction toward itself).
+    Host numpy float64 — this is control-plane scoring over a handful of
+    silos, not the jitted aggregation. Returns ``(flags, norms)``."""
+    vecs = [np.concatenate([
+        (np.asarray(a, np.float64) - np.asarray(b, np.float64)).ravel()
+        for a, b in zip(jax.tree.leaves(t), jax.tree.leaves(reference))])
+        for t in trees]
+    V = np.stack(vecs)
+    norms = np.linalg.norm(V, axis=1)
+    med = float(np.median(norms))
+    total = V.sum(axis=0)
+    n = len(trees)
+    flags = []
+    for i in range(n):
+        flag = med > 0 and norms[i] > norm_mult * med
+        if not flag and n >= 3 and norms[i] > 0:
+            others = (total - V[i]) / (n - 1)
+            o_norm = np.linalg.norm(others)
+            if o_norm > 0:
+                cos = float(V[i] @ others) / (norms[i] * o_norm)
+                flag = cos < cos_thresh
+        flags.append(bool(flag))
+    return flags, norms
+
+
 def init_multihost(coordinator_address: str, num_processes: int,
                    process_id: int) -> None:
     """Join this process to a multi-host JAX runtime (DCN collectives).
@@ -117,14 +209,70 @@ class FedAvgServer(ServerManager):
     the same pruning mask the encoding silos hold (e.g. SalientGrads'
     phase-1 global mask), letting them ship surviving values with no
     bitmap at all.
+
+    Byzantine robustness (ISSUE 5):
+
+    - decoded uploads that carry NaN/Inf are HARD-REJECTED before they
+      can touch the aggregation (counted in ``byz_stats``, the sender
+      treated like any other straggler by the deadline/quorum path) —
+      this guard is unconditional, independent of ``defense``.
+    - ``defense`` selects the aggregation rule (core/robust.py): the
+      clip family transforms per silo before the weighted mean; the
+      order-statistic family (trimmed_mean/median/krum/multi_krum/
+      geometric_median) replaces the mean and tolerates up to ``byz_f``
+      arbitrary silos. Validated at construction — an unknown name can
+      never surface mid-round. ``defense="none"`` keeps the exact
+      ``survivor_weighted_mean`` path (the engine-parity pin).
+    - ``quarantine_rounds`` > 0 arms server-side DETECTION: every
+      aggregation scores the survivors' update deltas (norm vs the
+      cohort median, cosine vs the leave-one-out mean —
+      ``update_outlier_flags``); flagged silos accrue strikes (one
+      clean round forgives one strike), and ``outlier_threshold``
+      strikes quarantine the silo for ``quarantine_rounds`` rounds —
+      its uploads are dropped at accept time and it leaves the
+      round-completion expected set, the same exclusion path the PR 2
+      heartbeat-suspicion machinery uses for corpses. At most ``byz_f``
+      silos are quarantined at once (the defense's own threat budget);
+      the first sync after a silo's window ends carries
+      ``ARG_EF_RESET``, clearing the silo's codec error-feedback stack
+      (the EF mass it accumulated against dropped frames corresponds to
+      nothing the server ever aggregated).
     """
 
     def __init__(self, init_params, comm_round: int, num_clients: int,
                  world_size: int | None = None, round_deadline: float = 0.0,
                  quorum: int = 0, heartbeat_timeout: float = 0.0,
-                 wire_masks=None, **kw):
+                 wire_masks=None, defense: str = "none", byz_f: int = 1,
+                 geomed_iters: int = 8, norm_bound: float = 5.0,
+                 stddev: float = 0.05, defense_seed: int = 0,
+                 quarantine_rounds: int = 0, outlier_threshold: int = 2,
+                 **kw):
+        from neuroimagedisttraining_tpu.core import robust
+
         super().__init__(rank=0, world_size=world_size or num_clients + 1,
                          **kw)
+        # defense config fails loudly HERE (startup), never mid-round
+        self.defense = robust.validate_defense(defense)
+        self.byz_f = int(byz_f)
+        self.geomed_iters = int(geomed_iters)
+        self.norm_bound = float(norm_bound)
+        self.stddev = float(stddev)
+        #: weak_dp noise stream root: per-round keys fold_in from here so
+        #: the noise is deterministic given (defense_seed, round, silo)
+        self.defense_seed = int(defense_seed)
+        if self.defense in robust.ROBUST_AGGREGATORS:
+            robust._check_f(num_clients, self.byz_f, self.defense)
+        self.quarantine_rounds = int(quarantine_rounds)
+        self.outlier_threshold = int(outlier_threshold)
+        #: value-anomaly strike counters (suspicion for BAD VALUES, the
+        #: analogue of the heartbeat suspicion set for dead silos)
+        self._strikes: dict[int, int] = {}
+        #: client -> first round index AFTER its quarantine window
+        self._quarantine_until: dict[int, int] = {}
+        #: silos owed an ARG_EF_RESET on their next post-window sync
+        self._ef_reset_pending: set[int] = set()
+        self.byz_stats = {"nonfinite_rejected": 0, "outlier_flags": 0,
+                          "quarantines": []}
         self.params = _to_numpy_tree(init_params)
         self.wire_masks = (_to_numpy_tree(wire_masks)
                            if wire_masks is not None else None)
@@ -136,6 +284,12 @@ class FedAvgServer(ServerManager):
         self.round_idx = 0
         self._registered: set[int] = set()
         self._updates: dict[int, tuple] = {}
+        #: silos whose THIS-round upload was hard-rejected (non-finite):
+        #: they have reported — there is nothing to wait for — so they
+        #: leave the round's expected set (without this, a NaN-uploading
+        #: silo with fresh heartbeats deadlocks a no-deadline federation:
+        #: its frame bounces but the round keeps waiting for it forever)
+        self._rejected_round: set[int] = set()
         self.history: list[dict] = []
         self._done = threading.Event()
         #: guards all round state: handlers run on the dispatch thread,
@@ -158,6 +312,62 @@ class FedAvgServer(ServerManager):
     def suspect_clients(self) -> set[int]:
         with self._rlock:
             return set(self._suspect)
+
+    # ---- Byzantine detection / quarantine (ISSUE 5) ----
+
+    def _quarantined_now(self) -> set[int]:
+        """Under ``_rlock``: silos inside an active quarantine window."""
+        return {c for c, until in self._quarantine_until.items()
+                if self.round_idx < until}
+
+    def quarantined_clients(self) -> set[int]:
+        with self._rlock:
+            return self._quarantined_now()
+
+    def _strike(self, c: int, why: str) -> None:
+        """Under ``_rlock``: one value-anomaly strike against silo
+        ``c``; at ``outlier_threshold`` strikes the silo is quarantined
+        — unless the byz_f budget of concurrent quarantines is already
+        spent (quarantining more silos than the threat model's f would
+        let a clever attacker starve the federation of honest silos)."""
+        self._strikes[c] = self._strikes.get(c, 0) + 1
+        self.byz_stats["outlier_flags"] += 1
+        log.warning("server: value-anomaly strike %d/%d against silo %d "
+                    "(%s)", self._strikes[c], self.outlier_threshold, c,
+                    why)
+        if self._strikes[c] < self.outlier_threshold:
+            return
+        if len(self._quarantined_now()) >= max(1, self.byz_f):
+            log.warning("server: silo %d hit the strike threshold but "
+                        "the quarantine budget (byz_f=%d) is spent",
+                        c, self.byz_f)
+            return
+        until = self.round_idx + 1 + self.quarantine_rounds
+        self._quarantine_until[c] = until
+        self._strikes[c] = 0
+        self._ef_reset_pending.add(c)
+        self.byz_stats["quarantines"].append(
+            {"client": c, "from_round": self.round_idx + 1,
+             "until_round": until})
+        log.warning("server: QUARANTINED silo %d for rounds [%d, %d) — "
+                    "its uploads are excluded from aggregation; its "
+                    "first post-window sync will carry ef_reset", c,
+                    self.round_idx + 1, until)
+
+    def _score_survivors(self, senders: list[int], trees: list) -> None:
+        """Under ``_rlock``: norm/cosine outlier scoring over this
+        round's accepted uploads -> strikes. A silo that scores clean
+        this round is forgiven one prior strike (transient turbulence —
+        a bad batch, an lr spike — should not accumulate forever)."""
+        if self.quarantine_rounds <= 0 or len(senders) < 3:
+            return
+        flags, norms = update_outlier_flags(trees, self.params)
+        for c, flag, nrm in zip(senders, flags, norms):
+            if flag:
+                self._strike(c, f"update-delta outlier, |u|={nrm:.3g} "
+                                f"round {self.round_idx}")
+            elif self._strikes.get(c, 0) > 0:
+                self._strikes[c] -= 1
 
     def run(self) -> None:
         if self.heartbeat_timeout > 0:
@@ -210,6 +420,12 @@ class FedAvgServer(ServerManager):
             log.warning("server: dropping duplicate upload from %d "
                         "(round %d)", msg.sender_id, self.round_idx)
             return False
+        if msg.sender_id in self._quarantined_now():
+            log.warning("server: dropping upload from QUARANTINED silo "
+                        "%d (round %d; window ends at round %d)",
+                        msg.sender_id, self.round_idx,
+                        self._quarantine_until[msg.sender_id])
+            return False
         return True
 
     def _on_model(self, msg: M.Message) -> None:
@@ -238,6 +454,25 @@ class FedAvgServer(ServerManager):
                             "(round %d): %s", msg.sender_id,
                             self.round_idx, e)
                 return
+            # non-finite hard gate (unconditional, before any defense):
+            # one NaN/Inf frame folded into the mean poisons every silo.
+            # The sender is treated like a straggler by deadline/quorum,
+            # and the rejection counts as a value-anomaly strike — a
+            # silo shipping NaNs every round earns its quarantine.
+            if not tree_all_finite(decoded):
+                self.byz_stats["nonfinite_rejected"] += 1
+                log.warning("server: REJECTING non-finite (NaN/Inf) "
+                            "upload from silo %d (round %d; %d rejected "
+                            "so far)", msg.sender_id, self.round_idx,
+                            self.byz_stats["nonfinite_rejected"])
+                if self.quarantine_rounds > 0:
+                    self._strike(msg.sender_id, "non-finite upload")
+                # the silo HAS reported — nothing left to wait for this
+                # round; drop it from the expected set so a no-deadline
+                # federation cannot deadlock on its bounced frame
+                self._rejected_round.add(msg.sender_id)
+                self._maybe_complete()
+                return
             self._updates[msg.sender_id] = (
                 decoded, float(msg.get(M.ARG_NUM_SAMPLES)))
             self._last_beat[msg.sender_id] = time.monotonic()
@@ -245,26 +480,91 @@ class FedAvgServer(ServerManager):
             self._maybe_complete()
 
     def _maybe_complete(self) -> None:
-        """Under ``_rlock``: aggregate as soon as every non-suspect
-        client has reported (and the quorum floor holds) — suspects are
-        picked up by the deadline path if they resurface."""
-        expected = set(range(1, self.num_clients + 1)) - self._suspect
+        """Under ``_rlock``: aggregate as soon as every non-suspect,
+        non-quarantined client has reported (and the quorum floor holds)
+        — suspects are picked up by the deadline path if they resurface;
+        quarantined silos' uploads are dropped at accept time, so
+        waiting for them would deadlock the round."""
+        expected = (set(range(1, self.num_clients + 1)) - self._suspect
+                    - self._quarantined_now() - self._rejected_round)
         have = set(self._updates)
+        if not have and not expected and self._rejected_round:
+            # every live silo reported and EVERY upload bounced at the
+            # non-finite gate: nothing to aggregate and nobody left to
+            # wait for — advance with the global model unchanged (the
+            # rejected silos train again from the next sync) instead of
+            # hanging the federation on its own rejection set (with no
+            # deadline nothing else fires: the rejected silos keep
+            # heartbeating, so the suspicion monitor never will)
+            log.warning("server: round %d has ZERO accepted uploads "
+                        "(%d rejected as non-finite) - rebroadcasting "
+                        "the unchanged global model", self.round_idx,
+                        len(self._rejected_round))
+            if self._timer is not None:
+                self._timer.cancel()
+            self._rejected_round.clear()
+            self._complete_round(0, survivors=[])
+            return
         if not have or not expected <= have or len(have) < min(
-                self.quorum, self.num_clients):
+                self.quorum, self._effective_cohort()):
             return
         self._aggregate_and_advance()
 
+    def _effective_cohort(self) -> int:
+        """Under ``_rlock``: cohort size the quorum floor applies to —
+        quarantined silos can never report, and hard-rejected uploads
+        never will be accepted this round, so holding the floor at
+        ``num_clients`` would hang a small federation whose quorum was
+        sized for the full cohort."""
+        return max(1, self.num_clients - len(self._quarantined_now()
+                                             | self._rejected_round))
+
     def _aggregate_and_advance(self) -> None:
-        """Under ``_rlock``: weighted FedAvg over whoever reported
-        (fedavg_api.py:102-117 semantics, jitted engine aggregation)."""
+        """Under ``_rlock``: defended aggregation over whoever reported.
+        ``defense="none"`` keeps the exact jitted
+        ``survivor_weighted_mean`` (fedavg_api.py:102-117 semantics, the
+        engine-parity pin in tests/test_faults.py); any other defense
+        dispatches through the SAME core/robust.py program the simulated
+        engines trace into their round bodies. Outlier scoring runs
+        FIRST, so a silo quarantined this round is excluded from this
+        very aggregation."""
+        from neuroimagedisttraining_tpu.core import robust
+
         if self._timer is not None:
             self._timer.cancel()
         senders = sorted(self._updates)
         trees = [self._updates[s][0] for s in senders]
+        self._score_survivors(senders, trees)
+        q = self._quarantined_now()
+        if q & set(senders):
+            senders = [s for s in senders if s not in q]
+            trees = [self._updates[s][0] for s in senders]
         ws = [self._updates[s][1] for s in senders]
-        self.params = survivor_weighted_mean(trees, ws)
+        # deadline truncation can shrink the survivor set below the
+        # aggregator's breakdown requirement; an undefended round beats
+        # a dead server — the SAME feasibility rule the engines resolve
+        # at trace time (core/robust.py::effective_defense)
+        defense = robust.effective_defense(
+            self.defense, len(senders), self.byz_f, warn=log.warning)
+        if defense == "none":
+            self.params = survivor_weighted_mean(trees, ws)
+        else:
+            rngs = None
+            if defense == "weak_dp":
+                # deterministic per-(seed, round, silo) noise keys, the
+                # same fold_in discipline the attack/engine streams use
+                base = jax.random.fold_in(
+                    jax.random.key(self.defense_seed), self.round_idx)
+                rngs = jax.vmap(
+                    lambda s: jax.random.fold_in(base, s))(
+                    jnp.asarray(senders, jnp.uint32))
+            self.params = survivor_defended_mean(
+                trees, ws, self.params, defense=defense,
+                byz_f=self.byz_f, geomed_iters=self.geomed_iters,
+                norm_bound=self.norm_bound, stddev=self.stddev,
+                rngs=rngs)
         self._updates.clear()
+        self._rejected_round.clear()
         self._complete_round(len(senders), survivors=senders)
 
     # ---- deadline / heartbeat machinery ----
@@ -290,8 +590,10 @@ class FedAvgServer(ServerManager):
     def _mark_missing_suspect(self, have: set[int]) -> None:
         """Under ``_rlock``: clients that missed the deadline become
         suspect — unless their heartbeat is still fresh (a straggler,
-        not a corpse; it may catch up next round)."""
-        for c in set(range(1, self.num_clients + 1)) - have:
+        not a corpse; it may catch up next round) or they are
+        quarantined (their uploads were dropped by design)."""
+        for c in (set(range(1, self.num_clients + 1)) - have
+                  - self._quarantined_now()):
             if self._beat_stale(c):
                 log.warning("server: marking client %d suspect "
                             "(missed round %d deadline)", c, self.round_idx)
@@ -346,6 +648,9 @@ class FedAvgServer(ServerManager):
             entry["survivors"] = list(survivors)
         if self._suspect:
             entry["suspects"] = sorted(self._suspect)
+        q = self._quarantined_now()
+        if q:
+            entry["quarantined"] = sorted(q)
         self.history.append(entry)
         self.round_idx += 1
         if self.round_idx >= self.comm_round:
@@ -390,6 +695,17 @@ class FedAvgServer(ServerManager):
         msg.add(M.ARG_MODEL_PARAMS, self.params)
         msg.add(M.ARG_ROUND_IDX, self.round_idx)
         msg.add(M.ARG_CLIENT_INDEX, c - 1)
+        if (c in self._ef_reset_pending
+                and c not in self._quarantined_now()):
+            # first sync after the quarantine window: the silo's codec
+            # error-feedback accumulated against frames this server
+            # DROPPED — that mass corresponds to nothing aggregated, so
+            # re-injecting it would smear stale quarantine-era residuals
+            # into honest post-window uploads
+            msg.add(M.ARG_EF_RESET, True)
+            self._ef_reset_pending.discard(c)
+            log.info("server: silo %d quarantine window over - sync "
+                     "carries ef_reset", c)
         self._send_tolerant(msg)
 
     def _broadcast_sync(self, msg_type: str) -> None:
@@ -434,6 +750,22 @@ class SecureFedAvgServer(FedAvgServer):
     def __init__(self, init_params, comm_round: int, num_clients: int,
                  frac_bits: int = 16, n_aggregators: int = 0,
                  record_trace: bool = False, **kw):
+        if kw.get("defense", "none") != "none" \
+                or kw.get("quarantine_rounds", 0):
+            # secure aggregation is a LINEAR sum over additive shares:
+            # the server never observes an individual silo's update, so
+            # there is nothing for an order-statistic defense to select
+            # over, nothing for the outlier scorer to score, and even
+            # clipping would have to run client-side (each silo clips
+            # its own update BEFORE sharing — the TurboAggregateEngine
+            # composition). Robustness and secrecy trade off here by
+            # construction; ARCHITECTURE.md "Byzantine robustness"
+            # documents the tension.
+            raise ValueError(
+                "SecureFedAvgServer supports neither --defense nor "
+                "quarantine: additive-share aggregation never reveals "
+                "per-silo updates to defend over (clip client-side "
+                "instead; see ARCHITECTURE.md)")
         if kw.get("wire_masks") is not None:
             # Secure aggregation stays DENSE by design: each upload is a
             # tree of additive share slots — uniformly random GF(p)
@@ -723,12 +1055,23 @@ class FedAvgClientProc(ClientManager):
     across rounds (dropped mass and quantization error re-enter the next
     round's residual, EF-SGD semantics). A dropped upload loses one
     round's kept mass like any dense upload would; the EF state itself
-    never desyncs because it lives entirely on this sender."""
+    never desyncs because it lives entirely on this sender. A sync
+    carrying ``ARG_EF_RESET`` (the server's post-quarantine signal)
+    clears the accumulator before this round trains.
+
+    ``fault_schedule`` + ``seed`` (ISSUE 5): when the schedule carries
+    ``byz:`` value faults, this silo transforms its OWN upload through
+    ``faults/adversary.attack_update`` before any encoding — the
+    attacker controls what its silo encodes, the server defends on what
+    it decodes. The transform is the same jax math the simulated
+    engines vmap over their client axis, keyed by (seed, round, rank),
+    so one seed produces one attack trace in both federations."""
 
     def __init__(self, rank: int, num_clients: int,
                  train_fn: Callable, world_size: int | None = None,
                  heartbeat_interval: float = 0.0, wire_codec: str = "none",
-                 wire_masks=None, wire_topk_ratio: float = 0.25, **kw):
+                 wire_masks=None, wire_topk_ratio: float = 0.25,
+                 fault_schedule=None, seed: int = 0, **kw):
         super().__init__(rank=rank, world_size=world_size or num_clients + 1,
                          **kw)
         self.num_clients = num_clients
@@ -740,6 +1083,10 @@ class FedAvgClientProc(ClientManager):
         self.wire_masks = (_to_numpy_tree(wire_masks)
                            if wire_masks is not None else None)
         self._wire_ef = None  # per-silo error-feedback accumulator
+        #: value-fault schedule (None, or a FaultSchedule whose spec may
+        #: schedule THIS rank to upload Byzantine values)
+        self.fault_schedule = fault_schedule
+        self.seed = int(seed)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -782,16 +1129,39 @@ class FedAvgClientProc(ClientManager):
     def _on_sync(self, msg: M.Message) -> None:
         params = msg.get(M.ARG_MODEL_PARAMS)
         round_idx = int(msg.get(M.ARG_ROUND_IDX))
+        if msg.get(M.ARG_EF_RESET):
+            log.info("silo %d: server requested ef_reset (round %d) - "
+                     "clearing the codec error-feedback accumulator",
+                     self.rank, round_idx)
+            self._wire_ef = None
         new_params, n = self.train_fn(params, round_idx)
         payload = _to_numpy_tree(new_params)
+        if self.fault_schedule is not None:
+            # value-fault hook BEFORE encoding: a Byzantine silo encodes
+            # its attacked update like any honest payload (the defense
+            # runs server-side on the decoded frame)
+            from neuroimagedisttraining_tpu.faults import adversary
+
+            payload = adversary.attack_update(
+                self.fault_schedule, self.seed, round_idx, self.rank,
+                payload, _to_numpy_tree(params))
         if self._wire_spec is not None:
             # the delta reference is the sync we JUST trained from — the
             # server holds the identical tree for this round tag
-            payload, self._wire_ef = codec.encode_update(
+            upload_finite = tree_all_finite(payload)
+            payload, ef_next = codec.encode_update(
                 self._wire_spec, payload,
                 reference=_to_numpy_tree(params),
                 masks=self.wire_masks, ef=self._wire_ef,
                 mask_on_wire=False)
+            # a non-finite upload bounces at the server's hard gate, and
+            # absorbing its NaN residual would park NaN in the EF stack
+            # FOREVER (every later encode consumes it — a one-round
+            # value fault becomes permanent rejection). The consumed EF
+            # corresponds to a frame that was never aggregated, so drop
+            # the stack — the same invariant as the server's
+            # post-quarantine ARG_EF_RESET.
+            self._wire_ef = ef_next if upload_finite else None
         out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
         out.add(M.ARG_MODEL_PARAMS, payload)
         out.add(M.ARG_NUM_SAMPLES, float(n))
@@ -825,6 +1195,14 @@ class SecureFedAvgClientProc(FedAvgClientProc):
                 "codec: share slots must ride the wire dense (see "
                 "SecureFedAvgServer — encoding breaks the GF(p) share "
                 "algebra or leaks mask support)")
+        sched = kw.get("fault_schedule")
+        if sched is not None and sched.spec.any_value_faults:
+            raise ValueError(
+                "byz: value faults cannot be simulated under --secure: "
+                "the secure client's upload path shares BEFORE any "
+                "value hook could run, and the server has no plaintext "
+                "updates to defend — the attack would go both "
+                "uninjected and undefended (see ARCHITECTURE.md)")
         super().__init__(rank, num_clients, train_fn,
                          world_size=num_clients + 1 + n_aggregators, **kw)
         self.n_shares = n_shares

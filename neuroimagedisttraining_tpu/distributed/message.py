@@ -58,6 +58,12 @@ ARG_CLIENT_INDEX = "client_index"
 ARG_ROUND_IDX = "round_idx"
 ARG_AGG_WEIGHT = "agg_weight"
 ARG_SLOT_INDEX = "slot_index"
+#: sync-message flag (ISSUE 5): the receiving silo must RESET its wire-
+#: codec error-feedback accumulator before training this round — sent on
+#: the first sync after a quarantine window ends, because the EF mass the
+#: silo accumulated against frames the server dropped no longer
+#: corresponds to anything the server aggregated
+ARG_EF_RESET = "ef_reset"
 
 _MAGIC = b"NIDT1"
 

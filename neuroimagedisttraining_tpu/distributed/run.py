@@ -103,7 +103,8 @@ def _build_shard(args, rank: int):
         cohort = generate_synthetic_abcd(
             num_subjects=args.synthetic_num_subjects,
             shape=tuple(args.synthetic_shape),
-            num_sites=max(2, args.num_clients), seed=args.seed)
+            num_sites=max(2, args.num_clients), seed=args.seed,
+            signal=args.synthetic_signal)
     else:
         from neuroimagedisttraining_tpu.data.hdf5 import load_abcd_hdf5
 
@@ -318,8 +319,46 @@ def main(argv=None) -> int:
                     help="deterministic chaos schedule applied to client "
                          "ranks via FaultyCommManager: 'crash:RANK@ROUND,"
                          "crash_prob:P,straggle:P:MAX_S,drop:P,dup:P,"
-                         "disconnect:P' — replays identically from "
-                         "--seed on every rank")
+                         "disconnect:P,byz:RANK@ROUND:KIND,"
+                         "byz_prob:P[:KIND]' — replays identically from "
+                         "--seed on every rank; byz silos upload "
+                         "KIND-corrupted values (sign_flip | scale:K | "
+                         "gauss:STD | nonfinite, faults/adversary.py) "
+                         "transformed BEFORE the wire codec")
+    ap.add_argument("--defense", "--defense_type", dest="defense",
+                    type=str, default="none",
+                    help="server aggregation defense (core/robust.py): "
+                         "none | norm_diff_clipping | weak_dp | "
+                         "trimmed_mean | median | krum | multi_krum | "
+                         "geometric_median — the order-statistic family "
+                         "replaces the weighted mean and tolerates up "
+                         "to --byz_f Byzantine silos; validated at "
+                         "startup on every rank")
+    ap.add_argument("--byz_f", type=int, default=1,
+                    help="assumed Byzantine silo count f for the order-"
+                         "statistic defenses (trim depth per side / "
+                         "Krum neighborhood; krum needs num_clients >= "
+                         "f+3, trimmed_mean/median need 2f < n) and the "
+                         "quarantine budget (at most f silos "
+                         "quarantined at once)")
+    ap.add_argument("--geomed_iters", type=int, default=8,
+                    help="geometric_median: fixed Weiszfeld iterations")
+    ap.add_argument("--norm_bound", type=float, default=5.0,
+                    help="clip threshold for norm_diff_clipping/weak_dp")
+    ap.add_argument("--stddev", type=float, default=0.05,
+                    help="weak_dp per-client Gaussian noise stddev "
+                         "(keys derive from --seed per round/silo)")
+    ap.add_argument("--quarantine_rounds", type=int, default=0,
+                    help="server: > 0 arms Byzantine DETECTION — "
+                         "update-norm/cosine outlier scoring feeds "
+                         "strike counters, and --outlier_threshold "
+                         "strikes quarantine a silo for this many "
+                         "rounds (uploads dropped, codec error-"
+                         "feedback reset on release); 0 = off")
+    ap.add_argument("--outlier_threshold", type=int, default=2,
+                    help="value-anomaly strikes before a silo is "
+                         "quarantined (clean rounds forgive one strike "
+                         "each)")
     ap.add_argument("--round_deadline", type=float, default=0.0,
                     help="server: per-round deadline seconds; when it "
                          "fires with >= --quorum uploads the round "
@@ -365,6 +404,7 @@ def main(argv=None) -> int:
     ap.add_argument("--synthetic_num_subjects", type=int, default=64)
     ap.add_argument("--synthetic_shape", type=int, nargs=3,
                     default=[12, 14, 12])
+    ap.add_argument("--synthetic_signal", type=float, default=12.0)
     ap.add_argument("--batch_size", type=int, default=8)
     ap.add_argument("--epochs", type=int, default=1)
     ap.add_argument("--lr", type=float, default=0.01)
@@ -427,6 +467,29 @@ def main(argv=None) -> int:
         parse_wire_spec(args.wire_codec, args.wire_topk_ratio)
     except ValueError as e:
         ap.error(str(e))
+    # Byzantine config (ISSUE 5) fails fast on EVERY rank too: a typo'd
+    # --defense or byz: directive must die at startup, not mid-round
+    try:
+        from neuroimagedisttraining_tpu.core import robust
+        from neuroimagedisttraining_tpu.faults import parse_fault_spec
+
+        robust.validate_defense(args.defense)
+        if args.defense in robust.ROBUST_AGGREGATORS:
+            robust._check_f(args.num_clients, args.byz_f, args.defense)
+        fault_spec = (parse_fault_spec(args.fault_spec)
+                      if args.fault_spec else None)
+    except ValueError as e:
+        ap.error(str(e))
+    if args.secure:
+        if args.defense != "none" or args.quarantine_rounds > 0:
+            ap.error("--secure is incompatible with --defense/"
+                     "--quarantine_rounds: additive-share aggregation "
+                     "never reveals per-silo updates to defend over "
+                     "(see cross_silo.SecureFedAvgServer)")
+        if fault_spec is not None and fault_spec.any_value_faults:
+            ap.error("--secure cannot simulate byz: value faults (the "
+                     "share algebra hides the very values the attack "
+                     "would corrupt; see cross_silo)")
     if args.round_deadline > 0 and args.quorum == 0:
         args.quorum = args.num_clients // 2 + 1  # simple majority
     if args.heartbeat_timeout > 0 and not (
@@ -479,7 +542,13 @@ def main(argv=None) -> int:
         cls = SecureFedAvgServer if args.secure else FedAvgServer
         kw = ({"frac_bits": args.mpc_frac_bits,
                "n_aggregators": args.n_aggregators} if args.secure
-              else {"wire_masks": wire_masks})
+              else {"wire_masks": wire_masks,
+                    "defense": args.defense, "byz_f": args.byz_f,
+                    "geomed_iters": args.geomed_iters,
+                    "norm_bound": args.norm_bound,
+                    "stddev": args.stddev, "defense_seed": args.seed,
+                    "quarantine_rounds": args.quarantine_rounds,
+                    "outlier_threshold": args.outlier_threshold})
         comm, broker = _make_comm(args, 0, host_map)
         server = cls(init, args.comm_round, args.num_clients,
                      base_port=args.base_port, host_map=host_map,
@@ -503,6 +572,10 @@ def main(argv=None) -> int:
                           "wire_codec": args.wire_codec,
                           "wire_mask_density": args.wire_mask_density,
                           "suspects": sorted(server.suspect_clients()),
+                          "defense": getattr(server, "defense", "none"),
+                          "quarantined": sorted(
+                              server.quarantined_clients()),
+                          "byz_stats": server.byz_stats,
                           "final_param_norm": round(norm, 6),
                           **stats}), flush=True)
         return 0
@@ -515,6 +588,14 @@ def main(argv=None) -> int:
           else {"wire_codec": args.wire_codec,
                 "wire_masks": wire_masks,
                 "wire_topk_ratio": args.wire_topk_ratio})
+    if not args.secure and fault_spec is not None \
+            and fault_spec.any_value_faults:
+        # value faults live in the CLIENT, not the transport wrapper:
+        # the silo attacks its own upload (faults/adversary.py) before
+        # any encoding, keyed by the shared (seed, round, rank) schedule
+        from neuroimagedisttraining_tpu.faults import FaultSchedule
+        kw["fault_schedule"] = FaultSchedule(fault_spec, args.seed)
+        kw["seed"] = args.seed
     comm, _ = _make_comm(args, args.rank, host_map)
     client = cls(args.rank, args.num_clients, train_fn,
                  base_port=args.base_port, host_map=host_map, comm=comm,

@@ -21,10 +21,12 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.codec import wire as codec_wire
 from neuroimagedisttraining_tpu.config import ExperimentConfig
+from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.losses import binary_auc
 from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer
 from neuroimagedisttraining_tpu.core.optim import round_lr
 from neuroimagedisttraining_tpu.data.federate import FederatedData
+from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.faults.schedule import (
     FaultSchedule, parse_fault_spec,
 )
@@ -46,6 +48,16 @@ class FederatedEngine:
     #: others must reject --wire_codec loudly instead of silently
     #: training dense while reporting encoded-bytes accounting of 0
     supports_wire_codec = False
+    #: engines whose round program routes client uploads through
+    #: faults/adversary.py when the fault schedule carries ``byz:``
+    #: value faults (ISSUE 5); others must reject such a spec loudly
+    #: instead of silently simulating an attack-free federation
+    supports_byz_faults = False
+    #: defenses this engine's round program can realize; anything else
+    #: in --defense fails at STARTUP, never mid-round (ISSUE 5
+    #: satellite). Base engines aggregate with a plain weighted mean and
+    #: support no defense at all.
+    supported_defenses: tuple = ("none",)
 
     def __init__(self, cfg: ExperimentConfig, fed_data: FederatedData | None,
                  trainer: LocalTrainer, mesh=None,
@@ -86,6 +98,30 @@ class FederatedEngine:
         self.fault_schedule = (FaultSchedule(spec, cfg.seed)
                                if spec is not None and spec.any_faults
                                else None)
+        if spec is not None and spec.any_value_faults \
+                and not self.supports_byz_faults:
+            from neuroimagedisttraining_tpu.engines import ENGINES
+            ok = sorted({c.name for c in ENGINES.values()
+                         if c.supports_byz_faults})
+            raise ValueError(
+                f"algorithm {self.name!r} does not simulate byz: value "
+                "faults (its round program does not route client "
+                "uploads through faults/adversary.py, so the spec "
+                f"would silently run attack-free); supported: {ok}")
+        # defense validation at STARTUP (ISSUE 5 satellite): an unknown
+        # --defense name, or one this engine's round cannot realize,
+        # must fail here — not as a trace error mid-round
+        robust.validate_defense(cfg.fed.defense_type)
+        if cfg.fed.defense_type not in self.supported_defenses:
+            raise ValueError(
+                f"algorithm {self.name!r} does not support --defense "
+                f"{cfg.fed.defense_type!r}; this engine supports: "
+                f"{', '.join(self.supported_defenses)}")
+        if cfg.fed.defense_type in robust.ROBUST_AGGREGATORS:
+            # surface breakdown-point violations (2f >= n, n < f+3)
+            # before any data loads rather than at first-trace time
+            robust._check_f(cfg.fed.client_num_per_round,
+                            cfg.fed.byz_f, cfg.fed.defense_type)
         # wire codec (codec/, ISSUE 3): the lossy value transform the
         # cross-silo wire would apply to this engine's uploads, run
         # in-sim before aggregation so round metrics reflect the encoded
@@ -113,10 +149,14 @@ class FederatedEngine:
         self.stat_info: dict[str, Any] = {
             "sum_comm_params": 0.0, "sum_training_flops": 0.0,
             "sum_comm_bytes": 0.0, "sum_comm_bytes_dense": 0.0,
+            "nonfinite_uploads": 0.0,
             "global_test_acc": [], "person_test_acc": [],
             "final_masks": [],
         }
         self._dense_upload_nbytes: int | None = None
+        #: device-side non-finite-upload counts queued per round; synced
+        #: in one batched device_get at host boundaries (_flush_nonfinite)
+        self._nonfinite_pending: list = []
         # fused multi-round dispatch (ISSUE 4): engines that cannot fuse
         # announce the collapse to K=1 ONCE, up front, so a config asking
         # for amortized dispatch never silently degrades
@@ -441,8 +481,10 @@ class FederatedEngine:
         """Host prologue of a fused window: per-round cohorts (via
         ``_window_sampling``, which may shrink ``k``), the per-round log
         lines the sequential loop would have emitted, and the stacked
-        device inputs for the scan. Returns
-        ``(sampled, idx, rngs, lrs, k)``."""
+        device inputs for the scan — including the [K, C]-stacked
+        Byzantine attack plan when the fault schedule carries value
+        faults (None otherwise). Returns
+        ``(sampled, idx, rngs, lrs, byz, k)``."""
         sampled, k = self._window_sampling(round_idx, k)
         for off, s in enumerate(sampled):
             self.log.info("################ round %d: clients %s (fused "
@@ -452,7 +494,120 @@ class FederatedEngine:
                           for off, s in enumerate(sampled)])
         lrs = jnp.asarray([self.round_lr(round_idx + off)
                            for off in range(k)], jnp.float32)
-        return sampled, idx, rngs, lrs, k
+        byz = None
+        if self._byz_on():
+            plans = [self._byz_round_plan(round_idx + off, s)
+                     for off, s in enumerate(sampled)]
+            byz = tuple(jnp.stack([p[i] for p in plans])
+                        for i in range(4))
+        return sampled, idx, rngs, lrs, byz, k
+
+    # ---------- Byzantine value faults (faults/adversary.py, ISSUE 5) ----------
+
+    def _byz_on(self) -> bool:
+        """True iff the fault schedule can corrupt upload VALUES — the
+        round programs then route client uploads through the adversary
+        transform (an all-honest round rides an identity plan, which
+        ``apply_attack`` passes through bitwise)."""
+        return (self.fault_schedule is not None
+                and self.fault_schedule.spec.any_value_faults)
+
+    def _byz_round_plan(self, round_idx: int, sampled: np.ndarray):
+        """One round's attack plan over the sampled cohort (engine
+        client index c == cross-silo rank c + 1, the faults/ contract):
+        ``(mult[C], std[C], nonfinite[C], keys[C])`` device arrays, or
+        None when the schedule has no value faults at all."""
+        if not self._byz_on():
+            return None
+        ranks = np.asarray(sampled) + 1
+        mult, std, nan = adversary.plan_arrays(self.fault_schedule,
+                                               round_idx, ranks)
+        byzantine = np.flatnonzero((mult != 1.0) | (std != 0.0) | nan)
+        if byzantine.size:
+            self.log.info(
+                "round %d: clients %s upload BYZANTINE values (%s)",
+                round_idx, np.asarray(sampled)[byzantine].tolist(),
+                [self.fault_schedule.byzantine_kind(round_idx,
+                                                    int(r))
+                 for r in ranks[byzantine]])
+        keys = adversary.attack_keys(self.cfg.seed, round_idx, ranks)
+        return (jnp.asarray(mult), jnp.asarray(std), jnp.asarray(nan),
+                keys)
+
+    def _sanitize_and_defend(self, upload, ref, w, losses, rngs=None):
+        """The shared tail of a defended round body (trace-safe; fedavg
+        and salientgrads call it inside their jitted round programs):
+
+        1. non-finite upload guard (runs with or without ``--defense``):
+           a single NaN/Inf client would poison ``tree_weighted_mean``,
+           so its row is swapped for the broadcast ``ref`` and
+           zero-weighted (the count comes back as ``n_bad``);
+        2. defense dispatch (core/robust.py): order-statistic defenses
+           consume the whole upload payload (a Byzantine silo poisons
+           its batch_stats too) and replace the weighted mean; the clip
+           family transforms params per client (batch_stats are never
+           clipped — structural parity with ``is_weight_param``,
+           robust_aggregation.py:28-29) then reduces with the engine's
+           silo-aware ``aggregate``. A cohort too small for the
+           configured aggregator (fault-schedule shrinkage) falls back
+           to the plain mean with a warning — resolved at trace time,
+           the cohort axis is static.
+
+        ``upload``/``ref`` are ``{"params", "batch_stats"}`` dicts
+        (stacked / unstacked); ``rngs`` are the per-client keys weak_dp
+        noise draws from. Returns
+        ``(new_params, new_bstats, mean_loss, n_bad)``."""
+        f = self.cfg.fed
+        finite = robust.finite_per_client(upload)
+        upload = robust.replace_nonfinite_clients(upload, ref, finite)
+        n_bad = jnp.sum(~finite).astype(jnp.int32)
+        w = w * finite.astype(jnp.float32)
+        C = int(jax.tree.leaves(upload)[0].shape[0])
+        defense = robust.effective_defense(f.defense_type, C, f.byz_f,
+                                           warn=self.log.warning)
+        if defense in robust.ROBUST_AGGREGATORS:
+            agg = robust.robust_aggregate(
+                upload, w, defense=defense, byz_f=f.byz_f,
+                geomed_iters=f.geomed_iters)
+            new_params, new_bstats = agg["params"], agg["batch_stats"]
+        else:
+            client_params = robust.defend_stacked(
+                upload["params"], ref["params"], defense=defense,
+                norm_bound=f.norm_bound, stddev=f.stddev, rngs=rngs)
+            new_params = self.aggregate(client_params, w)
+            new_bstats = self.aggregate(upload["batch_stats"], w)
+        safe_losses = jnp.where(jnp.isfinite(losses), losses, 0.0)
+        mean_loss = jnp.sum(safe_losses * w) / jnp.maximum(jnp.sum(w),
+                                                           1e-9)
+        return new_params, new_bstats, mean_loss, n_bad
+
+    # ---------- non-finite upload guard (ISSUE 5 satellite) ----------
+
+    def _note_nonfinite(self, n_bad) -> None:
+        """Queue a round's device-side count of rejected non-finite
+        client uploads. Deliberately NOT synced here: a per-round
+        ``device_get`` would serialize every dispatch; the queue drains
+        in one batched transfer at the next host boundary."""
+        self._nonfinite_pending.append(n_bad)
+
+    def _flush_nonfinite(self, round_idx: int) -> None:
+        """Drain the queued counts (one batched device_get) and emit the
+        counted warning when any upload was rejected. Call at host-sync
+        boundaries — eval rounds and end of training — where the driver
+        already blocks on device results."""
+        if not self._nonfinite_pending:
+            return
+        counts = jax.device_get(self._nonfinite_pending)
+        self._nonfinite_pending.clear()
+        total = int(sum(np.sum(np.asarray(c)) for c in counts))
+        if total:
+            self.stat_info["nonfinite_uploads"] += total
+            self.log.warning(
+                "rounds <= %d: rejected %d non-finite (NaN/Inf) client "
+                "upload(s) before aggregation — the offending clients "
+                "were zero-weighted for their rounds (%d rejected so "
+                "far this run)", round_idx, total,
+                int(self.stat_info["nonfinite_uploads"]))
 
     # ---------- helpers ----------
 

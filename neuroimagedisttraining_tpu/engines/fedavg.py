@@ -24,6 +24,7 @@ import numpy as np
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.utils import pytree as pt
 
 
@@ -31,15 +32,31 @@ class FedAvgEngine(FederatedEngine):
     name = "fedavg"
     supports_streaming = True
     supports_wire_codec = True  # _round_body runs the codec roundtrip
+    supports_byz_faults = True  # _round_body routes uploads through the
+    # adversary transform when the schedule carries byz: value faults
+    supported_defenses = robust.DEFENSES
 
     def _prox_kwargs(self, global_params) -> dict:
         """Extra ``local_train`` kwargs tying the local objective to the
         round's incoming global model; FedProx overrides."""
         return {}
 
-    def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr, efs=None):
+    def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr, efs=None,
+                    byz=None):
         """One FedAvg round over pre-gathered sampled-client shards; shared
         by the device-resident and streaming paths.
+
+        ``byz`` (faults/adversary.py plan ``(mult, std, nonfinite,
+        keys)``, [C] each) transforms the scheduled clients' uploads
+        into Byzantine values BEFORE the wire codec — the attacker
+        controls what its silo encodes, the server defends on what it
+        decodes. Every round then sanitizes: non-finite uploads are
+        swapped for the broadcast reference and zero-weighted (counted
+        in the ``n_bad`` output — the non-finite guard runs with or
+        without a defense), and ``--defense`` dispatches through
+        core/robust.py (clip family per client before the weighted mean;
+        trimmed_mean/median/krum/geometric_median replace the mean over
+        the whole upload payload, batch_stats included).
 
         With ``--wire_codec`` set, every client's trained params pass
         through the codec's jitted lossy roundtrip (delta vs the round's
@@ -74,6 +91,17 @@ class FedAvgEngine(FederatedEngine):
         w = ns.astype(jnp.float32)
         client_params = cs.params
         client_bstats = cs.batch_stats
+        if byz is not None:
+            # the attack hits the WHOLE upload payload (params + batch
+            # stats — what the wire ships) before any encoding; honest
+            # clients ride the plan's identity rows bitwise-untouched
+            mult, std, nonfinite, keys = byz
+            atk = adversary.apply_attack_stacked(
+                {"params": client_params, "batch_stats": client_bstats},
+                {"params": params, "batch_stats": bstats},
+                mult, std, nonfinite, keys)
+            client_params = atk["params"]
+            client_bstats = atk["batch_stats"]
         new_efs = u0 = None
         if self.wire_spec is not None:
             from neuroimagedisttraining_tpu.codec import device as codec_dev
@@ -92,6 +120,18 @@ class FedAvgEngine(FederatedEngine):
                 dec, new_efs = jax.vmap(
                     lambda u, e: codec_dev.lossy_roundtrip(
                         spec, u, reference=ref, ef=e))(upload, efs)
+                # a non-finite upload row (byz nonfinite attack, diverged
+                # optimizer) would park NaN in the EF stack FOREVER —
+                # EF = u - decode(u) is NaN, and every later encode
+                # consumes it, so the guard would zero-weight the client
+                # for the rest of the run. Zero those rows so the value
+                # fault stays transient (the engine-side mirror of the
+                # server's post-quarantine ARG_EF_RESET invariant).
+                fin = robust.finite_per_client(upload)
+                new_efs = jax.tree.map(
+                    lambda e: jnp.where(
+                        fin.reshape((-1,) + (1,) * (e.ndim - 1)),
+                        e, jnp.zeros_like(e)), new_efs)
             else:
                 dec, _ = jax.vmap(
                     lambda u: codec_dev.lossy_roundtrip(
@@ -99,34 +139,30 @@ class FedAvgEngine(FederatedEngine):
             client_params = dec["params"]
             client_bstats = dec["batch_stats"]
             u0 = jax.tree.map(lambda x: x[0], dec)
-        # robust defenses (norm-diff clipping / weak DP) between local train
-        # and aggregation; batch_stats are never clipped (structural parity
-        # with is_weight_param, robust_aggregation.py:28-29)
-        f = self.cfg.fed
-        client_params = robust.defend_stacked(
-            client_params, params, defense=f.defense_type,
-            norm_bound=f.norm_bound, stddev=f.stddev, rngs=cs.rng)
-        new_params = self.aggregate(client_params, w)
-        new_bstats = self.aggregate(client_bstats, w)
-        mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+        # non-finite guard + defense dispatch (base._sanitize_and_defend)
+        new_params, new_bstats, mean_loss, n_bad = self._sanitize_and_defend(
+            {"params": client_params, "batch_stats": client_bstats},
+            {"params": params, "batch_stats": bstats}, w, losses,
+            rngs=cs.rng)
         if self.wire_spec is not None:
-            return new_params, new_bstats, mean_loss, new_efs, u0
-        return new_params, new_bstats, mean_loss
+            return new_params, new_bstats, mean_loss, n_bad, new_efs, u0
+        return new_params, new_bstats, mean_loss, n_bad
 
     @functools.cached_property
     def _round_jit(self):
         def round_fn(params, bstats, data, sampled_idx, rngs, lr,
-                     efs=None):
+                     efs=None, byz=None):
             Xs = jnp.take(data.X_train, sampled_idx, axis=0)
             ys = jnp.take(data.y_train, sampled_idx, axis=0)
             ns = jnp.take(data.n_train, sampled_idx, axis=0)
             return self._round_body(params, bstats, Xs, ys, ns, rngs, lr,
-                                    efs)
+                                    efs, byz)
 
         # donation: the incoming global {params, bstats} and the sampled
         # EF rows are consumed by the round — their buffers back the
         # round's outputs; the driver snapshots (account_wire_bytes
-        # reference) BEFORE dispatch and never rereads donated args
+        # reference) BEFORE dispatch and never rereads donated args.
+        # The byz plan (arg 7) is tiny and never donated.
         return jax.jit(round_fn,
                        donate_argnums=self._donate_argnums(0, 1, 6))
 
@@ -148,20 +184,26 @@ class FedAvgEngine(FederatedEngine):
         (PROFILE.md round 2: a 16-step scan sustains 2.4x the
         per-dispatch loop through the tunnel)."""
         def build():
-            def fused_round_fn(params, bstats, data, sampled_idx, rngs, lrs):
+            def fused_round_fn(params, bstats, data, sampled_idx, rngs,
+                               lrs, byz=None):
                 def one_round(carry, xs):
                     p, b = carry
-                    si, rg, lr = xs
+                    if byz is None:
+                        (si, rg, lr), bz = xs, None
+                    else:
+                        si, rg, lr, bz = xs
                     Xs = jnp.take(data.X_train, si, axis=0)
                     ys = jnp.take(data.y_train, si, axis=0)
                     ns = jnp.take(data.n_train, si, axis=0)
-                    p, b, loss = self._round_body(p, b, Xs, ys, ns, rg,
-                                                  lr)
-                    return (p, b), loss
+                    p, b, loss, bad = self._round_body(p, b, Xs, ys, ns,
+                                                       rg, lr, byz=bz)
+                    return (p, b), (loss, bad)
 
-                (params, bstats), losses = jax.lax.scan(
-                    one_round, (params, bstats), (sampled_idx, rngs, lrs))
-                return params, bstats, losses
+                xs = ((sampled_idx, rngs, lrs) if byz is None
+                      else (sampled_idx, rngs, lrs, byz))
+                (params, bstats), (losses, bads) = jax.lax.scan(
+                    one_round, (params, bstats), xs)
+                return params, bstats, losses, bads
 
             return jax.jit(fused_round_fn,
                            donate_argnums=self._donate_argnums(0, 1))
@@ -170,13 +212,16 @@ class FedAvgEngine(FederatedEngine):
 
     def _run_fused_window(self, params, bstats, round_idx: int, k: int):
         """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan.
-        Sampling/rng/lr are precomputed on the host round by round (the
-        ``np.random.seed(round_idx)`` contract is untouched). Returns
-        ``(params, bstats, last_round_loss, k_actual)`` — ``k_actual``
-        may shrink when the fault schedule varies the cohort size."""
-        _, idx, rngs, lrs, k = self._window_host_inputs(round_idx, k)
-        params, bstats, losses = self._fused_round_jit(k)(
-            params, bstats, self.data, idx, rngs, lrs)
+        Sampling/rng/lr — and the Byzantine attack plan when the fault
+        schedule carries value faults — are precomputed on the host
+        round by round (the ``np.random.seed(round_idx)`` contract is
+        untouched). Returns ``(params, bstats, last_round_loss,
+        k_actual)`` — ``k_actual`` may shrink when the fault schedule
+        varies the cohort size."""
+        _, idx, rngs, lrs, byz, k = self._window_host_inputs(round_idx, k)
+        params, bstats, losses, bads = self._fused_round_jit(k)(
+            params, bstats, self.data, idx, rngs, lrs, byz)
+        self._note_nonfinite(bads)
         return params, bstats, losses[-1], k
 
     def _finetune_body(self, params, bstats, X, y, n, rngs, lr):
@@ -254,6 +299,7 @@ class FedAvgEngine(FederatedEngine):
                 self.log.info("################ round %d: clients %s",
                               round_idx, sampled.tolist())
                 rngs = self.per_client_rngs(round_idx, sampled)
+                byz = self._byz_round_plan(round_idx, sampled)
                 if codec_on:
                     # downlink reference snapshot BEFORE dispatch: the
                     # round donates {params, bstats} and the sampled EF
@@ -264,9 +310,10 @@ class FedAvgEngine(FederatedEngine):
                     efs = (pt.tree_stack_index(self._wire_ef,
                                                np.asarray(sampled))
                            if self.wire_spec.needs_ef else None)
-                    params, bstats, loss, new_efs, u0 = self._round_jit(
+                    (params, bstats, loss, n_bad, new_efs,
+                     u0) = self._round_jit(
                         params, bstats, self.data, jnp.asarray(sampled),
-                        rngs, self.round_lr(round_idx), efs)
+                        rngs, self.round_lr(round_idx), efs, byz)
                     if new_efs is not None:
                         real = jnp.asarray(self._n_train_host[sampled] > 0)
                         self._wire_ef = self.scatter_sampled_rows(
@@ -274,18 +321,27 @@ class FedAvgEngine(FederatedEngine):
                             real)
                     self.account_wire_bytes(jax.tree.map(np.asarray, u0),
                                             ref_host, None, len(sampled))
+                elif byz is not None:
+                    # byz plans only reach engines whose round accepts
+                    # them (supports_byz_faults gates at startup); efs
+                    # rides its default None
+                    params, bstats, loss, n_bad = self._round_jit(
+                        params, bstats, self.data, jnp.asarray(sampled),
+                        rngs, self.round_lr(round_idx), None, byz)
                 else:
-                    # efs stays default-bound (None): subclasses override
-                    # _round_jit with efs-free signatures
+                    # efs/byz stay default-bound (None): subclasses
+                    # override _round_jit with efs-free signatures
                     # (turboaggregate), and an argument filled from its
                     # default is never donated, so no explicit None is
                     # needed here
-                    params, bstats, loss = self._round_jit(
+                    params, bstats, loss, n_bad = self._round_jit(
                         params, bstats, self.data, jnp.asarray(sampled),
                         rngs, self.round_lr(round_idx))
+                self._note_nonfinite(n_bad)
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 m = self.eval_global(params, bstats)
+                self._flush_nonfinite(round_idx)
                 self.stat_info["global_test_acc"].append(m["acc"])
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx, "train_loss": float(loss),
@@ -293,6 +349,7 @@ class FedAvgEngine(FederatedEngine):
             self.maybe_checkpoint(round_idx, {
                 "params": params, "batch_stats": bstats, "history": history})
             round_idx += 1
+        self._flush_nonfinite(cfg.fed.comm_round - 1)
         # final fine-tune pass -> personalized models + final eval at "-1"
         rngs = self.per_client_rngs(cfg.fed.comm_round,
                                     np.arange(self.num_clients))
@@ -334,18 +391,27 @@ class FedAvgEngine(FederatedEngine):
                 self.stream.prefetch_train(
                     *self.stream_sampling(round_idx + 1))
             rngs = self.per_client_rngs(round_idx, fed_ids)
-            params, bstats, loss = self._round_stream_jit(
-                params, bstats, Xs, ys, ns, rngs,
-                self.round_lr(round_idx))
+            byz = self._byz_round_plan(round_idx, fed_ids)
+            if byz is not None:
+                params, bstats, loss, n_bad = self._round_stream_jit(
+                    params, bstats, Xs, ys, ns, rngs,
+                    self.round_lr(round_idx), None, byz)
+            else:
+                params, bstats, loss, n_bad = self._round_stream_jit(
+                    params, bstats, Xs, ys, ns, rngs,
+                    self.round_lr(round_idx))
+            self._note_nonfinite(n_bad)
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 m = self.eval_global_stream(params, bstats)
+                self._flush_nonfinite(round_idx)
                 self.stat_info["global_test_acc"].append(m["acc"])
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx,
                                 "train_loss": float(loss), **m})
             self.maybe_checkpoint(round_idx, {
                 "params": params, "batch_stats": bstats, "history": history})
+        self._flush_nonfinite(cfg.fed.comm_round - 1)
         # final fine-tune: chunked over client blocks; personalized models
         # are evaluated per block then discarded (they'd exceed HBM)
         chunk = self._eval_chunk_size()

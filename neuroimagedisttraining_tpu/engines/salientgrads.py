@@ -29,8 +29,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import snip as snip_ops
 from neuroimagedisttraining_tpu.ops.masks import mask_density, ones_mask
@@ -46,6 +48,8 @@ class SalientGradsEngine(FederatedEngine):
     # round granularity, same as FedAvg's streaming path.
     supports_streaming = True
     supports_wire_codec = True  # masked roundtrip inside _round_body
+    supports_byz_faults = True  # uploads route through faults/adversary
+    supported_defenses = robust.DEFENSES
     #: the phase-1 global mask once generated (wire_masks handoff)
     _wire_masks = None
 
@@ -140,10 +144,17 @@ class SalientGradsEngine(FederatedEngine):
     # ---------- phase 2: masked rounds ----------
 
     def _round_body(self, params, bstats, per_params, per_bstats, Xs, ys,
-                    ns, masks, sampled_idx, rngs, lr):
+                    ns, masks, sampled_idx, rngs, lr, byz=None):
         """One masked round over pre-gathered sampled-client shards; shared
         by the device-resident and streaming paths (sampled_idx only drives
-        the personal-state scatter)."""
+        the personal-state scatter).
+
+        Byzantine hooks (ISSUE 5, same stages as FedAvg's round): ``byz``
+        transforms the scheduled clients' uploads BEFORE the wire codec
+        (personal models keep the client's honest local result — the
+        attack is on the wire payload, not the silo's own state); every
+        round then applies the non-finite guard, and ``--defense``
+        dispatches through core/robust.py on what the codec decoded."""
         trainer = self.trainer
         o = self.cfg.optim
         S = Xs.shape[0]
@@ -169,6 +180,14 @@ class SalientGradsEngine(FederatedEngine):
         w = ns.astype(jnp.float32)
         client_params = cs.params
         client_bstats = cs.batch_stats
+        if byz is not None:
+            mult, std, nonfinite, keys = byz
+            atk = adversary.apply_attack_stacked(
+                {"params": client_params, "batch_stats": client_bstats},
+                {"params": params, "batch_stats": bstats},
+                mult, std, nonfinite, keys)
+            client_params = atk["params"]
+            client_bstats = atk["batch_stats"]
         u0 = None
         if self.wire_spec is not None:
             # wire-codec roundtrip with MASK HANDOFF (codec/device.py)
@@ -195,13 +214,15 @@ class SalientGradsEngine(FederatedEngine):
             client_params = dec["params"]
             client_bstats = dec["batch_stats"]
             u0 = jax.tree.map(lambda x: x[0], dec)
-        # silo-aware aggregation (base.aggregate): on a two-level
-        # (silos, clients) mesh the masked FedAvg reduces silo-first over
-        # ICI with ONE aggregate per silo across DCN; flat weighted mean
-        # otherwise — identical result either way (tests/test_sharding.py),
-        # cross-silo layout parity with ABCD/data_loader.py:216-315
-        new_params = self.aggregate(client_params, w)
-        new_bstats = self.aggregate(client_bstats, w)
+        # non-finite guard + defense dispatch (base._sanitize_and_defend)
+        # on what the (possibly codec-roundtripped) wire delivered; the
+        # clip path reduces through the silo-aware base.aggregate (two-
+        # level mesh: silo-first over ICI, ONE aggregate per silo across
+        # DCN — tests/test_sharding.py, ABCD/data_loader.py:216-315)
+        new_params, new_bstats, mean_loss, n_bad = self._sanitize_and_defend(
+            {"params": client_params, "batch_stats": client_bstats},
+            {"params": params, "batch_stats": bstats}, w, losses,
+            rngs=cs.rng)
         # personal models <- this round's local results; pad entries from
         # stream_sampling are dropped, never written (base.scatter_sampled_rows)
         real = ns > 0
@@ -209,21 +230,22 @@ class SalientGradsEngine(FederatedEngine):
                                                sampled_idx, real)
         per_bstats = self.scatter_sampled_rows(per_bstats, cs.batch_stats,
                                                sampled_idx, real)
-        mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
         if self.wire_spec is not None:
             return (new_params, new_bstats, per_params, per_bstats,
-                    mean_loss, u0)
-        return new_params, new_bstats, per_params, per_bstats, mean_loss
+                    mean_loss, n_bad, u0)
+        return (new_params, new_bstats, per_params, per_bstats, mean_loss,
+                n_bad)
 
     @functools.cached_property
     def _round_jit(self):
         def round_fn(params, bstats, per_params, per_bstats, data, masks,
-                     sampled_idx, rngs, lr):
+                     sampled_idx, rngs, lr, byz=None):
             Xs = jnp.take(data.X_train, sampled_idx, axis=0)
             ys = jnp.take(data.y_train, sampled_idx, axis=0)
             ns = jnp.take(data.n_train, sampled_idx, axis=0)
             return self._round_body(params, bstats, per_params, per_bstats,
-                                    Xs, ys, ns, masks, sampled_idx, rngs, lr)
+                                    Xs, ys, ns, masks, sampled_idx, rngs,
+                                    lr, byz)
 
         # donation: the global model and the [C, ...] per-client personal
         # stacks are consumed — their buffers back the round's outputs
@@ -250,21 +272,26 @@ class SalientGradsEngine(FederatedEngine):
         and the resident federation ride as loop constants."""
         def build():
             def fused_round_fn(params, bstats, per_params, per_bstats, data,
-                         masks, sampled_idx, rngs, lrs):
+                         masks, sampled_idx, rngs, lrs, byz=None):
                 def one_round(carry, xs):
                     p, b, pp, pb = carry
-                    si, rg, lr = xs
+                    if byz is None:
+                        (si, rg, lr), bz = xs, None
+                    else:
+                        si, rg, lr, bz = xs
                     Xs = jnp.take(data.X_train, si, axis=0)
                     ys = jnp.take(data.y_train, si, axis=0)
                     ns = jnp.take(data.n_train, si, axis=0)
-                    p, b, pp, pb, loss = self._round_body(
-                        p, b, pp, pb, Xs, ys, ns, masks, si, rg, lr)
-                    return (p, b, pp, pb), loss
+                    p, b, pp, pb, loss, bad = self._round_body(
+                        p, b, pp, pb, Xs, ys, ns, masks, si, rg, lr, bz)
+                    return (p, b, pp, pb), (loss, bad)
 
-                carry, losses = jax.lax.scan(
+                xs = ((sampled_idx, rngs, lrs) if byz is None
+                      else (sampled_idx, rngs, lrs, byz))
+                carry, (losses, bads) = jax.lax.scan(
                     one_round, (params, bstats, per_params, per_bstats),
-                    (sampled_idx, rngs, lrs))
-                return (*carry, losses)
+                    xs)
+                return (*carry, losses, bads)
 
             return jax.jit(fused_round_fn,
                            donate_argnums=self._donate_argnums(0, 1, 2, 3))
@@ -274,16 +301,19 @@ class SalientGradsEngine(FederatedEngine):
     def _run_fused_window(self, params, bstats, per_params, per_bstats,
                           masks, round_idx: int, k: int):
         """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan;
-        host-side sampling/rng/lr precomputed per round (reference
+        host-side sampling/rng/lr (and the Byzantine plan when value
+        faults are scheduled) precomputed per round (reference
         ``np.random.seed(round_idx)`` contract untouched). Returns the
         new state, per-round sampled sets (for the host-side stat
         accounting), the boundary round's loss, and the actual window
         length."""
-        sampled, idx, rngs, lrs, k = self._window_host_inputs(round_idx, k)
-        (params, bstats, per_params, per_bstats,
-         losses) = self._fused_round_jit(k)(
+        sampled, idx, rngs, lrs, byz, k = self._window_host_inputs(
+            round_idx, k)
+        (params, bstats, per_params, per_bstats, losses,
+         bads) = self._fused_round_jit(k)(
             params, bstats, per_params, per_bstats, self.data, masks,
-            idx, rngs, lrs)
+            idx, rngs, lrs, byz)
+        self._note_nonfinite(bads)
         return (params, bstats, per_params, per_bstats, sampled,
                 losses[-1], k)
 
@@ -298,6 +328,7 @@ class SalientGradsEngine(FederatedEngine):
                 or round_idx == cfg.fed.comm_round - 1:
             m = self._eval_g(params, bstats)
             mp = self._eval_p(per_params, per_bstats)
+            self._flush_nonfinite(round_idx)
             self.stat_info["global_test_acc"].append(m["acc"])
             self.stat_info["person_test_acc"].append(mp["acc"])
             self.log.metrics(round_idx, train_loss=loss, **m,
@@ -386,27 +417,29 @@ class SalientGradsEngine(FederatedEngine):
             if self.stream is not None:
                 fed_ids, n_real = self.stream_sampling(round_idx, sampled)
                 rngs = self.per_client_rngs(round_idx, fed_ids)
+                byz = self._byz_round_plan(round_idx, fed_ids)
                 Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
                 if round_idx + 1 < cfg.fed.comm_round:
                     # overlap next round's host read with this round
                     self.stream.prefetch_train(
                         *self.stream_sampling(round_idx + 1))
-                (params, bstats, per_params, per_bstats,
-                 loss) = self._round_stream_jit(
+                (params, bstats, per_params, per_bstats, loss,
+                 n_bad) = self._round_stream_jit(
                     params, bstats, per_params, per_bstats, Xs, ys, ns,
                     masks, jnp.asarray(fed_ids), rngs,
-                    self.round_lr(round_idx))
+                    self.round_lr(round_idx), byz)
             else:
                 rngs = self.per_client_rngs(round_idx, sampled)
+                byz = self._byz_round_plan(round_idx, sampled)
                 if self.wire_spec is not None:
                     ref_host = jax.tree.map(
                         np.asarray, {"params": params,
                                      "batch_stats": bstats})
-                    (params, bstats, per_params, per_bstats, loss,
+                    (params, bstats, per_params, per_bstats, loss, n_bad,
                      u0) = self._round_jit(
                         params, bstats, per_params, per_bstats, self.data,
                         masks, jnp.asarray(sampled), rngs,
-                        self.round_lr(round_idx))
+                        self.round_lr(round_idx), byz)
                     masks_host = {
                         "params": jax.tree.map(np.asarray, masks),
                         "batch_stats": jax.tree.map(
@@ -415,11 +448,12 @@ class SalientGradsEngine(FederatedEngine):
                         jax.tree.map(np.asarray, u0), ref_host,
                         masks_host=masks_host, n_uploads=len(sampled))
                 else:
-                    (params, bstats, per_params, per_bstats,
-                     loss) = self._round_jit(
+                    (params, bstats, per_params, per_bstats, loss,
+                     n_bad) = self._round_jit(
                         params, bstats, per_params, per_bstats, self.data,
                         masks, jnp.asarray(sampled), rngs,
-                        self.round_lr(round_idx))
+                        self.round_lr(round_idx), byz)
+            self._note_nonfinite(n_bad)
             n_samples = float(np.sum(self._n_train_host[sampled]))
             self.stat_info["sum_training_flops"] += (
                 flops_per_sample * cfg.optim.epochs * n_samples)
@@ -428,6 +462,7 @@ class SalientGradsEngine(FederatedEngine):
             self._eval_ckpt_hooks(round_idx, params, bstats, per_params,
                                   per_bstats, masks, loss, history)
             round_idx += 1
+        self._flush_nonfinite(cfg.fed.comm_round - 1)
         m_global = self._eval_g(params, bstats)
         m_person = self._eval_p(per_params, per_bstats)
         self.log.metrics(-1, global_=m_global, personal=m_person)

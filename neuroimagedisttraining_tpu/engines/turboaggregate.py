@@ -50,6 +50,15 @@ class TurboAggregateEngine(FedAvgEngine):
     # SecureFedAvgServer, and the inherited codec call path would pass
     # this engine's 6-arg round program 7 args anyway).
     supports_wire_codec = False
+    # Byzantine simulation + order-statistic defenses are likewise OUT:
+    # secure aggregation is a linear sum over additive shares — the
+    # server never observes individual updates, so trimmed-mean/Krum
+    # style order statistics have nothing to select over (the same
+    # tension ARCHITECTURE.md's Byzantine-robustness section documents
+    # for cross_silo's SecureFedAvgServer). Clipping still composes:
+    # each silo clips its OWN update before sharing it.
+    supports_byz_faults = False
+    supported_defenses = robust.CLIP_DEFENSES
 
     def _train_only_body(self, params, bstats, Xs, ys, ns, rngs, lr):
         """Local training WITHOUT the in-program aggregation: returns the
@@ -78,13 +87,22 @@ class TurboAggregateEngine(FedAvgEngine):
 
         cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
         w = ns.astype(jnp.float32)
+        # non-finite upload guard (ISSUE 5 satellite): a NaN client
+        # would poison the GF(p) quantization AND the plain bstats mean;
+        # its row becomes the broadcast reference at weight 0
+        upload = {"params": cs.params, "batch_stats": cs.batch_stats}
+        ref = {"params": params, "batch_stats": bstats}
+        finite = robust.finite_per_client(upload)
+        upload = robust.replace_nonfinite_clients(upload, ref, finite)
+        n_bad = jnp.sum(~finite).astype(jnp.int32)
+        w = w * finite.astype(jnp.float32)
         wn = w / jnp.maximum(jnp.sum(w), 1e-12)
         # robust defenses apply BEFORE weighting/sharing, same stage as
         # FedAvgEngine._round_body (clipping composes with secure agg:
         # each silo clips its own update before secret-sharing it)
         f = self.cfg.fed
         client_params = robust.defend_stacked(
-            cs.params, params, defense=f.defense_type,
+            upload["params"], params, defense=f.defense_type,
             norm_bound=f.norm_bound, stddev=f.stddev, rngs=cs.rng)
         weighted = jax.tree.map(
             lambda x: x.astype(jnp.float32)
@@ -93,9 +111,11 @@ class TurboAggregateEngine(FedAvgEngine):
         # silo-aware aggregate so the non-MPC half of the round keeps the
         # two-level ICI/DCN layout (params cross the host MPC boundary
         # regardless — that boundary IS the cross-silo link)
-        new_bstats = self.aggregate(cs.batch_stats, w)
-        mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
-        return weighted, new_bstats, mean_loss
+        new_bstats = self.aggregate(upload["batch_stats"], w)
+        safe_losses = jnp.where(jnp.isfinite(losses), losses, 0.0)
+        mean_loss = jnp.sum(safe_losses * w) / jnp.maximum(jnp.sum(w),
+                                                           1e-9)
+        return weighted, new_bstats, mean_loss, n_bad
 
     @functools.cached_property
     def _train_only_jit(self):
@@ -190,11 +210,11 @@ class TurboAggregateEngine(FedAvgEngine):
         train_only = self._train_only_jit
 
         def round_fn(params, bstats, data, sampled_idx, rngs, lr):
-            weighted, new_bstats, loss = train_only(
+            weighted, new_bstats, loss, n_bad = train_only(
                 params, bstats, data, sampled_idx, rngs, lr)
             new_params = self.secure_aggregate(weighted, self._mpc_calls)
             self._mpc_calls += 1
-            return new_params, new_bstats, loss
+            return new_params, new_bstats, loss, n_bad
 
         return round_fn  # wrapper (not one jit): tracks _mpc_calls and
         # dispatches the MPC stage per mpc_backend
@@ -207,10 +227,10 @@ class TurboAggregateEngine(FedAvgEngine):
         train_only = self._train_only_stream_jit
 
         def round_fn(params, bstats, Xs, ys, ns, rngs, lr):
-            weighted, new_bstats, loss = train_only(params, bstats, Xs, ys,
-                                                    ns, rngs, lr)
+            weighted, new_bstats, loss, n_bad = train_only(
+                params, bstats, Xs, ys, ns, rngs, lr)
             new_params = self.secure_aggregate(weighted, self._mpc_calls)
             self._mpc_calls += 1
-            return new_params, new_bstats, loss
+            return new_params, new_bstats, loss, n_bad
 
         return round_fn

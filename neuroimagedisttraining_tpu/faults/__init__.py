@@ -18,17 +18,21 @@ suspicion, rejoin, stale/duplicate rejection) lives in
 """
 
 from neuroimagedisttraining_tpu.faults.schedule import (
+    BYZ_KINDS,
     FaultSchedule,
     FaultSpec,
     activity_mask,
+    parse_byz_kind,
     parse_fault_spec,
 )
 from neuroimagedisttraining_tpu.faults.chaos import FaultyCommManager
 
 __all__ = [
+    "BYZ_KINDS",
     "FaultSchedule",
     "FaultSpec",
     "FaultyCommManager",
     "activity_mask",
+    "parse_byz_kind",
     "parse_fault_spec",
 ]

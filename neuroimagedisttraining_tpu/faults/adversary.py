@@ -1,0 +1,154 @@
+"""Deterministic Byzantine value-fault transforms (the ``byz:`` grammar).
+
+``faults/schedule.py`` decides WHO is Byzantine WHEN and with what KIND
+(a pure function of the config seed — the same contract as
+``activity_mask``/``survivors``, so one seed drives the simulated
+engines and the multiprocess federation identically). This module
+realizes the kinds as jitted pytree transforms on a client's upload::
+
+    sign_flip   u' = ref − (u − ref)            (flip the update delta)
+    scale:K     u' = ref + K·(u − ref)          (amplified update)
+    gauss:STD   u' = u + N(0, STD²)             (additive Gaussian)
+    nonfinite   u' = NaN everywhere             (poison-the-mean probe)
+
+Attacks transform the *upload delta* against the round's broadcast
+reference — the model tree the client just received — because clients
+upload full parameter trees, not gradients: negating the raw parameters
+would be a trivially detectable attack, whereas a flipped or scaled
+delta stays inside plausible parameter ranges (and, for ``sign_flip``
+inside the clip bound, passes norm-diff clipping untouched — the gap
+ISSUE 5's robust aggregators close).
+
+Numerically every kind lowers to one fused per-client form
+
+    d' = mult · (u − ref) + std · N(0, 1);   u' = ref + d'
+    u' = NaN where nonfinite
+
+so a whole cohort's attack round is three scalars per client
+(``mult``, ``std``, ``nonfinite``) plus a PRNG key — host-precomputable
+per round, stackable over a fused ``lax.scan`` window, and applied
+inside the jitted round body (``apply_attack_stacked``). Gaussian noise
+keys derive from ``(seed, round, rank)`` via ``jax.random.fold_in``, so
+the cross-silo client (``attack_update``, eager on its own upload) and
+the simulated engine (vmapped over the client axis) inject bitwise-
+identical noise.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.faults.schedule import FaultSchedule
+
+PyTree = Any
+
+#: fold-in offset decorrelating attack-noise keys from the engines'
+#: per-client training rngs (base.py uses seed + 17)
+_KEY_SALT = 23029
+
+
+def kind_params(kind: str | None) -> tuple[float, float, bool]:
+    """``(mult, std, nonfinite)`` numerics for a canonical kind string
+    (``schedule.parse_byz_kind`` output) or None (honest client)."""
+    if kind is None:
+        return 1.0, 0.0, False
+    name, _, param = kind.partition(":")
+    if name == "sign_flip":
+        return -1.0, 0.0, False
+    if name == "scale":
+        return float(param), 0.0, False
+    if name == "gauss":
+        return 1.0, float(param), False
+    if name == "nonfinite":
+        return 1.0, 0.0, True
+    raise ValueError(f"unknown byz kind {kind!r}")
+
+
+def plan_arrays(schedule: FaultSchedule, round_idx: int,
+                ranks) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side attack plan for one round over cross-silo ``ranks``:
+    ``(mult[C], std[C], nonfinite[C])`` numpy arrays (honest clients get
+    the identity row 1/0/False). Pure function of (schedule seed, round,
+    rank) — replays identically in any process."""
+    mult, std, nan = [], [], []
+    for r in np.asarray(ranks):
+        m, s, n = kind_params(schedule.byzantine_kind(round_idx, int(r)))
+        mult.append(m)
+        std.append(s)
+        nan.append(n)
+    return (np.asarray(mult, np.float32), np.asarray(std, np.float32),
+            np.asarray(nan, bool))
+
+
+def attack_keys(seed: int, round_idx: int, ranks) -> jax.Array:
+    """[C] stacked PRNG keys for the round's Gaussian attack noise, one
+    per cross-silo rank — ``fold_in(fold_in(key(seed+salt), round),
+    rank)``, identical to what ``attack_update`` derives client-side."""
+    base = jax.random.fold_in(jax.random.key(int(seed) + _KEY_SALT),
+                              round_idx + 1)
+    return jax.vmap(lambda r: jax.random.fold_in(base, r))(
+        jnp.asarray(np.asarray(ranks), jnp.uint32))
+
+
+def apply_attack(update: PyTree, reference: PyTree, mult, std, nonfinite,
+                 key) -> PyTree:
+    """One client's attacked upload (trace-safe; scalars may be traced).
+    ``reference`` is the round's broadcast model the delta is taken
+    against; each leaf gets its own fold_in(key, leaf_index) noise
+    stream so leaf shapes never alias draws.
+
+    Honest rows (the identity plan 1/0/False) pass through BITWISE
+    untouched via a select, not by computing ``ref + (u − ref)`` — so a
+    round driven with an all-honest plan is bit-identical to one driven
+    with no plan at all (the fused-window pins rely on it)."""
+    u_leaves, treedef = jax.tree.flatten(update)
+    r_leaves = treedef.flatten_up_to(reference)
+    mult = jnp.asarray(mult, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    bad = jnp.asarray(nonfinite, bool)
+    active = (mult != jnp.float32(1.0)) | (std != jnp.float32(0.0)) | bad
+    out = []
+    for i, (u, r) in enumerate(zip(u_leaves, r_leaves)):
+        u32 = jnp.asarray(u, jnp.float32)
+        ref32 = jnp.asarray(r, jnp.float32)
+        noise = jax.random.normal(jax.random.fold_in(key, i), u32.shape,
+                                  jnp.float32)
+        y = ref32 + (u32 - ref32) * mult + std * noise
+        y = jnp.where(bad, jnp.float32(jnp.nan), y)
+        out.append(jnp.where(active, y, u32).astype(
+            jnp.asarray(u).dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+def apply_attack_stacked(stacked_update: PyTree, reference: PyTree,
+                         mult, std, nonfinite, keys) -> PyTree:
+    """Vmapped ``apply_attack`` over the leading client axis of a
+    stacked upload tree (the engines' round-body integration point);
+    ``reference`` is the unstacked broadcast model."""
+    return jax.vmap(
+        lambda u, m, s, b, k: apply_attack(u, reference, m, s, b, k),
+        in_axes=(0, 0, 0, 0, 0))(stacked_update, mult, std, nonfinite,
+                                 keys)
+
+
+def attack_update(schedule: FaultSchedule, seed: int, round_idx: int,
+                  rank: int, update: PyTree,
+                  reference: PyTree) -> PyTree:
+    """Cross-silo client hook: returns ``update`` transformed per this
+    rank's scheduled kind (or unchanged when honest this round). Runs
+    the SAME jax math as the simulated engines' vmapped path — Gaussian
+    draws included — so one seed produces one attack trace in both
+    federations. Output leaves are host numpy (the upload payload)."""
+    kind = schedule.byzantine_kind(round_idx, rank)
+    if kind is None:
+        return update
+    mult, std, bad = kind_params(kind)
+    base = jax.random.fold_in(jax.random.key(int(seed) + _KEY_SALT),
+                              round_idx + 1)
+    key = jax.random.fold_in(base, jnp.uint32(rank))
+    attacked = apply_attack(update, reference, mult, std, bad, key)
+    return jax.tree.map(np.asarray, attacked)

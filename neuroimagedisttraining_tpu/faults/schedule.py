@@ -30,6 +30,38 @@ _STREAM_STRAGGLE = 2
 _STREAM_DROP = 3
 _STREAM_DUP = 4
 _STREAM_DISCONNECT = 5
+_STREAM_BYZ = 6
+
+#: value-fault kinds a Byzantine client can inject (faults/adversary.py
+#: realizes them as jitted pytree transforms). ``scale`` and ``gauss``
+#: carry a parameter: ``scale:K`` / ``gauss:STD``.
+BYZ_KINDS = ("sign_flip", "scale", "gauss", "nonfinite")
+
+
+def parse_byz_kind(text: str) -> str:
+    """Validate a byz KIND token (``sign_flip | scale:K | gauss:STD |
+    nonfinite``) and return it canonicalized. Raises ValueError on
+    anything else — a typo'd attack kind must fail at config parse, not
+    mid-round."""
+    text = text.strip()
+    name, _, param = text.partition(":")
+    name = name.strip()
+    if name not in BYZ_KINDS:
+        raise ValueError(
+            f"unknown byz kind {text!r}; one of sign_flip | scale:K | "
+            "gauss:STD | nonfinite")
+    if name in ("scale", "gauss"):
+        if not param:
+            raise ValueError(
+                f"byz kind {name!r} needs a parameter ({name}:VALUE)")
+        val = float(param)  # raises ValueError on garbage
+        if name == "gauss" and val < 0:
+            raise ValueError(f"byz gauss std must be >= 0, got {val}")
+        return f"{name}:{val}"
+    if param:
+        raise ValueError(f"byz kind {name!r} takes no parameter "
+                         f"(got {text!r})")
+    return name
 
 
 def activity_mask(seed: int, round_idx: int, n: int,
@@ -55,13 +87,28 @@ class FaultSpec:
     drop_prob: float = 0.0         # per outbound protocol message
     dup_prob: float = 0.0          # per outbound protocol message
     disconnect_prob: float = 0.0   # mid-frame disconnect per outbound message
+    # value faults (Byzantine clients, faults/adversary.py): (rank,
+    # round, kind) — the client uploads adversarially transformed
+    # updates from ``round`` on (a compromised silo stays compromised,
+    # same permanence as ``crashes``); byz_prob draws a per-(round,
+    # rank) transient corruption of ``byz_kind`` instead
+    byz: tuple[tuple[int, int, str], ...] = ()
+    byz_prob: float = 0.0
+    byz_kind: str = "sign_flip"
 
     @property
     def any_faults(self) -> bool:
-        return bool(self.crashes) or any(
+        return bool(self.crashes) or bool(self.byz) or any(
             p > 0 for p in (self.crash_prob, self.straggle_prob,
                             self.drop_prob, self.dup_prob,
-                            self.disconnect_prob))
+                            self.disconnect_prob, self.byz_prob))
+
+    @property
+    def any_value_faults(self) -> bool:
+        """True iff the spec can corrupt upload VALUES (the engines must
+        route updates through faults/adversary.py; omission/timing
+        faults never need that)."""
+        return bool(self.byz) or self.byz_prob > 0
 
 
 def parse_fault_spec(text: str) -> FaultSpec:
@@ -74,11 +121,17 @@ def parse_fault_spec(text: str) -> FaultSpec:
         drop:P                  drop outbound protocol messages with prob P
         dup:P                   duplicate outbound messages with prob P
         disconnect:P            tear the connection mid-frame with prob P
+        byz:RANK@ROUND:KIND     RANK uploads KIND-corrupted values from
+                                ROUND on; KIND = sign_flip | scale:K |
+                                gauss:STD | nonfinite
+        byz_prob:P[:KIND]       per-(round, rank) transient value fault
+                                of KIND (default sign_flip)
 
-    e.g. ``"crash:3@1,drop:0.1,straggle:0.5:0.2"``. Empty string => no
+    e.g. ``"crash:3@1,drop:0.1,byz:1@0:sign_flip"``. Empty string => no
     faults."""
     crashes: list[tuple[int, int]] = []
-    kw: dict[str, float] = {}
+    byz: list[tuple[int, int, str]] = []
+    kw: dict = {}
     for part in text.replace(";", ",").split(","):
         part = part.strip()
         if not part:
@@ -89,6 +142,19 @@ def parse_fault_spec(text: str) -> FaultSpec:
             if key == "crash":
                 rank_s, _, round_s = rest.partition("@")
                 crashes.append((int(rank_s), int(round_s)))
+            elif key == "byz":
+                at, _, kind = rest.partition(":")
+                rank_s, _, round_s = at.partition("@")
+                if not kind:
+                    raise ValueError(
+                        "byz needs RANK@ROUND:KIND (e.g. byz:1@0:sign_flip)")
+                byz.append((int(rank_s), int(round_s),
+                            parse_byz_kind(kind)))
+            elif key == "byz_prob":
+                p_s, _, kind = rest.partition(":")
+                kw["byz_prob"] = float(p_s)
+                if kind:
+                    kw["byz_kind"] = parse_byz_kind(kind)
             elif key == "straggle":
                 p_s, _, d_s = rest.partition(":")
                 kw["straggle_prob"] = float(p_s)
@@ -103,9 +169,11 @@ def parse_fault_spec(text: str) -> FaultSpec:
             raise ValueError(
                 f"bad --fault_spec directive {part!r}: {e}") from None
     for name, p in kw.items():
-        if name != "straggle_delay" and not 0.0 <= p <= 1.0:
+        if name in ("straggle_delay", "byz_kind"):
+            continue
+        if not 0.0 <= p <= 1.0:
             raise ValueError(f"--fault_spec {name}={p} not in [0, 1]")
-    return FaultSpec(crashes=tuple(crashes), **kw)
+    return FaultSpec(crashes=tuple(crashes), byz=tuple(byz), **kw)
 
 
 class FaultSchedule:
@@ -150,6 +218,25 @@ class FaultSchedule:
         for r in range(horizon):
             if self.crashed(r, rank):
                 return r
+        return None
+
+    def byzantine_kind(self, round_idx: int, rank: int) -> str | None:
+        """The value-fault kind ``rank`` injects at ``round_idx``, or
+        None when it uploads honestly. Deterministic ``byz:`` directives
+        are permanent from their round on (latest directive whose round
+        has arrived wins); ``byz_prob`` adds a transient per-(round,
+        rank) Bernoulli draw of ``byz_kind`` on its own RNG stream."""
+        best: tuple[int, str] | None = None
+        for r, at, kind in self.spec.byz:
+            if r == rank and round_idx >= at and (
+                    best is None or at >= best[0]):
+                best = (at, kind)
+        if best is not None:
+            return best[1]
+        p = self.spec.byz_prob
+        if p > 0 and self._draw(_STREAM_BYZ, round_idx,
+                                rank).random() < p:
+            return self.spec.byz_kind
         return None
 
     def straggle_seconds(self, round_idx: int, rank: int) -> float:
@@ -214,6 +301,7 @@ class FaultSchedule:
                 out.append({
                     "round": r, "rank": int(k),
                     "crashed": self.crashed(r, k),
+                    "byzantine": self.byzantine_kind(r, k),
                     "straggle_s": self.straggle_seconds(r, k),
                     "drop": [self.drop(r, k, s)
                              for s in range(msgs_per_round)],

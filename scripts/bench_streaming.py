@@ -83,7 +83,7 @@ def main() -> None:
                                         engine.per_client_rngs(r, sampled),
                                         engine.round_lr(r))
 
-    params, bstats, loss = one_round(params, bstats, 0)  # compile+warm
+    params, bstats, loss, _ = one_round(params, bstats, 0)  # compile+warm
     float(loss)
 
     n_rounds = 3
@@ -97,7 +97,7 @@ def main() -> None:
             stream.transfer_stats[k] = 0
         t0 = time.perf_counter()
         for r in range(1, 1 + n_rounds):
-            params, bstats, loss = one_round(params, bstats, r)
+            params, bstats, loss, _ = one_round(params, bstats, r)
         float(loss)
         dt = time.perf_counter() - t0
         # drain the reader queue before snapshotting: the trailing

@@ -1,9 +1,14 @@
 #!/usr/bin/env bash
-# Multiprocess kill-k chaos smoke (ISSUE 2): a 4-silo FedAvg federation
-# where client 3 crashes at round 1 (deterministic FaultSchedule via
-# --fault_spec) must still complete every round on BOTH control-plane
-# transports — the deadline+quorum server aggregates the survivors with
-# sample-count re-weighting and flags the corpse via heartbeats.
+# Multiprocess chaos smoke: a 4-silo FedAvg federation must complete
+# every round on BOTH control-plane transports under
+#   - kill-k (ISSUE 2): client 3 crashes at round 1 (deterministic
+#     FaultSchedule via --fault_spec) — the deadline+quorum server
+#     aggregates the survivors with sample-count re-weighting and flags
+#     the corpse via heartbeats;
+#   - Byzantine (ISSUE 5): client 1 sign-flips its upload delta every
+#     round — the server defends with trimmed_mean (byz_f=1) and the
+#     outlier-scorer/quarantine control plane armed, and the final
+#     model must come out finite.
 #
 # Heavier than the tier-1 suite (each run trains the tiny 3D CNN in 5
 # real OS processes), so it lives here as a CI smoke, not a pytest.
@@ -16,7 +21,7 @@ ROUNDS=3
 CLIENTS=4
 
 run_one() {
-    local transport=$1
+    local transport=$1 mode=$2
     local port
     port=$($PY -c "from neuroimagedisttraining_tpu.distributed.ports \
 import free_port_block; print(free_port_block(16))")
@@ -26,11 +31,20 @@ import free_port_block; print(free_port_block(16))")
                   --synthetic_shape 12 14 12 --batch_size 4
                   --base_port "$port" --force_cpu
                   --transport "$transport"
-                  --fault_spec "crash:3@1"
                   --round_deadline 30 --quorum 2
                   --heartbeat_interval 0.5 --heartbeat_timeout 5)
-    echo "== chaos smoke ($transport transport, port $port): kill client 3 at round 1 =="
-    local out="/tmp/chaos_smoke_${transport}.log"
+    local what
+    if [ "$mode" = byz ]; then
+        common+=(--fault_spec "byz:1@0:sign_flip"
+                 --defense trimmed_mean --byz_f 1
+                 --quarantine_rounds 2 --outlier_threshold 2)
+        what="client 1 sign-flips every round (defense=trimmed_mean)"
+    else
+        common+=(--fault_spec "crash:3@1")
+        what="kill client 3 at round 1"
+    fi
+    echo "== chaos smoke ($transport transport, $mode cell, port $port): $what =="
+    local out="/tmp/chaos_smoke_${transport}_${mode}.log"
     $PY -m neuroimagedisttraining_tpu.distributed.run \
         --role server "${common[@]}" > "$out" 2>&1 &
     local server_pid=$!
@@ -42,7 +56,8 @@ import free_port_block; print(free_port_block(16))")
         pids+=($!)
     done
     if ! wait "$server_pid"; then
-        echo "FAIL($transport): server exited non-zero"; cat "$out"; return 1
+        echo "FAIL($transport/$mode): server exited non-zero"
+        cat "$out"; return 1
     fi
     for p in "${pids[@]}"; do wait "$p" 2>/dev/null || true; done
     local json
@@ -50,17 +65,26 @@ import free_port_block; print(free_port_block(16))")
     # lands on the same stdout line (both streams share the log file)
     json=$(grep -a -o '^{.*}' "$out" | tail -1)
     echo "$json"
-    $PY - "$json" <<EOF
-import json, sys
+    $PY - "$json" "$mode" <<EOF
+import json, math, sys
 res = json.loads(sys.argv[1])
+mode = sys.argv[2]
 assert res["rounds_completed"] == $ROUNDS, res
-assert 3 in res["suspects"], f"killed client not flagged suspect: {res}"
-print(f"OK({res['transport']}): {res['rounds_completed']} rounds, "
-      f"suspects={res['suspects']}")
+if mode == "byz":
+    assert res["defense"] == "trimmed_mean", res
+    assert math.isfinite(res["final_param_norm"]), res
+    print(f"OK({res['transport']}/byz): {res['rounds_completed']} rounds "
+          f"defended, |params|={res['final_param_norm']:.3f}")
+else:
+    assert 3 in res["suspects"], f"killed client not flagged suspect: {res}"
+    print(f"OK({res['transport']}/crash): {res['rounds_completed']} rounds, "
+          f"suspects={res['suspects']}")
 EOF
 }
 
 rc=0
-run_one socket || rc=1
-run_one broker || rc=1
+run_one socket crash || rc=1
+run_one broker crash || rc=1
+run_one socket byz   || rc=1
+run_one broker byz   || rc=1
 exit $rc

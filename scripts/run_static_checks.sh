@@ -22,6 +22,10 @@ fi
 # schedule to the same seeded-stream contract as the engines, and the
 # trace-safety rules apply to codec/device.py's jitted encode math
 # (lossy_roundtrip runs inside every codec-enabled engine round).
+# the Byzantine layer (ISSUE 5) rides the same net: the transitive-call
+# closure traces faults/adversary.py's apply_attack through its vmapped
+# lambda and core/robust.py's aggregators through their vmap/fori_loop
+# bodies (no host syncs, no global RNG — one seed, one attack trace)
 # the donation-discipline family (ISSUE 4) rides along: round programs
 # must declare donate_argnums, and no caller may reread a donated buffer
 echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline) =="

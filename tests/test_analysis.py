@@ -627,3 +627,65 @@ def test_donation_use_after_donate_rebind_then_read_is_clean():
                 return params
         """, rules=["donation-use-after-donate"])
     assert fs == []
+
+
+# ---------------- Byzantine layer coverage (ISSUE 5) ----------------
+
+def test_byzantine_layer_modules_lint_clean_standalone():
+    """faults/adversary.py and core/robust.py are inside the lexical net
+    and clean on their own (not just as part of the whole-tree gate):
+    the jitted attack transforms and order-statistic aggregators carry
+    no host syncs, no global RNG, no unseeded streams."""
+    for rel in ("faults/adversary.py", "core/robust.py"):
+        fs = lint_paths([os.path.join(PACKAGE_DIR, rel)])
+        assert fs == [], rel + "\n" + "\n".join(f.render() for f in fs)
+
+
+def test_trace_safety_catches_adversary_shaped_violation():
+    """The exact idiom faults/adversary.py uses — a per-client transform
+    CALLED from a vmapped lambda — is covered by the transitive-call
+    closure: host numpy RNG inside it is a trace finding (the attack
+    must draw from jax.random so one seed replays in both
+    federations). Before ISSUE 5 the resolver stopped at the call
+    boundary and this idiom escaped the net entirely."""
+    fs = lint("""
+        import jax
+        import numpy as np
+
+        def apply_attack(u, ref, mult):
+            noise = np.random.normal(size=u.shape)
+            return ref + (u - ref) * mult + noise
+
+        def apply_attack_stacked(us, ref, mults):
+            return jax.vmap(
+                lambda u, m: apply_attack(u, ref, m))(us, mults)
+        """)
+    # the same draw is both a global-stream read and a trace hazard
+    assert rules_of(fs) == ["determinism-global-random", "trace-np-random"]
+
+
+def test_trace_safety_catches_host_sync_in_weiszfeld_body():
+    """An eager .item() escape inside a lax.fori_loop body (the
+    geometric_median Weiszfeld shape) is a trace-safety finding."""
+    fs = lint("""
+        import jax
+
+        def geometric_median(stacked, iters):
+            def step(_, z):
+                return z * float(jax.numpy.sum(z).item())
+            return jax.lax.fori_loop(0, iters, step, stacked)
+        """)
+    assert "trace-host-sync" in rules_of(fs)
+
+
+def test_determinism_rule_covers_schedule_shaped_rng():
+    """The byz_prob transient stream must ride the seeded FaultSchedule
+    draw: an unseeded default_rng in a schedule-shaped module is a
+    determinism finding."""
+    fs = lint("""
+        import numpy as np
+
+        def byzantine_kind(round_idx, rank, p):
+            return np.random.default_rng().random() < p
+        """, rules=["determinism-unseeded-rng"])
+    assert rules_of(fs) == ["determinism-unseeded-rng"]

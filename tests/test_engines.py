@@ -80,8 +80,8 @@ def test_fedprox_end_to_end_and_prox_pull_direction(tmp_path,
         gs = e.init_global_state()
         sampled = jnp.asarray(e.client_sampling(0))
         rngs = e.per_client_rngs(0, np.asarray(sampled))
-        params, _, _ = e._round_jit(gs.params, gs.batch_stats, e.data,
-                                    sampled, rngs, jnp.float32(1e-3))
+        params, _, _, _ = e._round_jit(gs.params, gs.batch_stats, e.data,
+                                       sampled, rngs, jnp.float32(1e-3))
         return float(pt.tree_norm(pt.tree_sub(params, gs.params)))
 
     drift_avg = one_round_drift("fedavg")
@@ -111,8 +111,8 @@ def test_fedprox_composes_with_byzantine_clipping(tmp_path,
         data = data.replace(X_train=Xb, y_train=yb)
         sampled = jnp.asarray(e.client_sampling(0))
         rngs = e.per_client_rngs(0, np.asarray(sampled))
-        params, _, _ = e._round_jit(gs.params, gs.batch_stats, data,
-                                    sampled, rngs, jnp.float32(0.5))
+        params, _, _, _ = e._round_jit(gs.params, gs.batch_stats, data,
+                                       sampled, rngs, jnp.float32(0.5))
         return float(pt.tree_norm(pt.tree_sub(params, gs.params)))
 
     drift_plain = poisoned_round()

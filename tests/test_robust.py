@@ -77,11 +77,17 @@ def test_defense_unknown_raises():
     g = {"w": jnp.zeros((2,), jnp.float32)}
     stacked = jax.tree.map(lambda x: jnp.stack([x, x]), g)
     try:
-        robust.defend_stacked(stacked, g, defense="krum", norm_bound=1.0,
-                              stddev=0.0)
+        robust.defend_stacked(stacked, g, defense="madeup_defense",
+                              norm_bound=1.0, stddev=0.0)
         raise AssertionError("should have raised")
     except ValueError:
         pass
+    # order-statistic names are now VALID defense names — they pass
+    # through defend_stacked untouched (aggregation-time dispatch)
+    out = robust.defend_stacked(stacked, g, defense="krum", norm_bound=1.0,
+                                stddev=0.0)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(stacked)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 @pytest.mark.slow
@@ -115,7 +121,7 @@ def test_fedavg_round_clipping_bounds_byzantine_update(tmp_path,
         data = data.replace(X_train=Xb, y_train=yb)
         sampled = jnp.asarray(engine.client_sampling(0))
         rngs = engine.per_client_rngs(0, np.asarray(sampled))
-        params, _, _ = engine._round_jit(
+        params, _, _, _ = engine._round_jit(
             gs.params, gs.batch_stats, data, sampled, rngs,
             jnp.float32(0.5))  # big lr amplifies the poison
         return float(pt.tree_norm(pt.tree_sub(params, gs.params)))

@@ -44,7 +44,7 @@ def test_round_program_contains_cross_client_collective(tmp_path,
     assert ("all-reduce" in txt) or ("all-gather" in txt) or \
         ("reduce-scatter" in txt), "no cross-device collective in the round"
 
-    params, bstats, loss = engine._round_jit(
+    params, bstats, loss, _ = engine._round_jit(
         gs.params, gs.batch_stats, engine.data, sampled, rngs,
         jnp.float32(0.01))
     jax.block_until_ready(params)
@@ -148,10 +148,10 @@ def test_fedavg_round_identical_on_flat_and_two_level_mesh(tmp_path):
                            client_num_in_total=8)
         gs = eng.init_global_state()
         sampled = eng.client_sampling(0)
-        p, b, loss = eng._round_jit(gs.params, gs.batch_stats, eng.data,
-                                    jnp.asarray(sampled),
-                                    eng.per_client_rngs(0, sampled),
-                                    eng.round_lr(0))
+        p, b, loss, _ = eng._round_jit(gs.params, gs.batch_stats, eng.data,
+                                       jnp.asarray(sampled),
+                                       eng.per_client_rngs(0, sampled),
+                                       eng.round_lr(0))
         outs.append((p, float(loss)))
     (p_flat, l_flat), (p_two, l_two) = outs
     np.testing.assert_allclose(l_flat, l_two, rtol=1e-6)
@@ -188,7 +188,7 @@ def test_salientgrads_round_identical_on_flat_and_two_level_mesh(tmp_path):
                              eng.round_lr(0))
         if shape:  # the silo-first path must actually have been routed
             assert not getattr(eng, "_warned_flat_fallback", False)
-        outs.append((masks, out[0], float(out[-1])))
+        outs.append((masks, out[0], float(out[4])))  # out[4] = mean loss
     (m_flat, p_flat, l_flat), (m_two, p_two, l_two) = outs
     for a, b in zip(jax.tree.leaves(m_flat), jax.tree.leaves(m_two)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
