@@ -91,7 +91,221 @@ def _chip_peak_tflops(device) -> float | None:
     return None
 
 
+def cohort_sharding_cell(n_devices: int) -> dict:
+    """Cohort-sharding bench cell (ISSUE 6): per-round wall time vs C for
+    the sequential C-loop (the reference's client-at-a-time simulation as
+    ONE ``lax.map`` program), the cohort-SHARDED program
+    (parallel/cohort.py), and the shipped vmapped unsharded round —
+    plus the flagship 21-site fedavg + salientgrads cells, the K=4
+    fused-window compile-count pin (one compiled program, one dispatch
+    per window), and ``salientgrads_mask_ms`` under the sharded phase-1
+    driver (PROFILE.md round 7 / ROADMAP item 4 reconciliation).
+
+    Env: BENCH_COHORT_DEVICES=D arms this cell (main() then prints ONLY
+    it); BENCH_COHORT_VIRTUAL=1 provisions D virtual CPU devices first
+    (the committed bench_matrix/cohort_sharding.json artifact runs this
+    way on the 2-core harness — treat the SLOPES and the one-dispatch
+    pin as the stable claims there; the absolute speedup is a
+    TPU-session measurement). BENCH_COHORT_CLIENTS overrides the C
+    sweep."""
+    if os.environ.get("BENCH_COHORT_VIRTUAL", "0") == "1":
+        from neuroimagedisttraining_tpu.parallel.mesh import (
+            provision_virtual_devices,
+        )
+        provision_virtual_devices(n_devices)
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import FederatedData
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    D = n_devices
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+    n_local = int(os.environ.get("BENCH_LOCAL", 16))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    shape = tuple(int(s) for s in
+                  os.environ.get("BENCH_SHAPE", "12,14,12").split(","))
+    model_name = os.environ.get("BENCH_MODEL", "3dcnn_tiny")
+    c_env = os.environ.get("BENCH_COHORT_CLIENTS", "")
+    Cs = ([int(c) for c in c_env.split(",")] if c_env
+          else sorted({D, 2 * D, 21, 3 * D}))
+
+    mesh = make_mesh(num_devices=D)
+    log = ExperimentLogger("/tmp/nidt_bench", "synthetic", "cohort_cell",
+                           console=False)
+
+    def make_fed(C: int, pad_to: int | None, sharded: bool):
+        P = C if pad_to is None else pad_to
+        kx, ky = jax.random.split(jax.random.key(4))
+        X = jax.random.randint(kx, (P, n_local) + shape, 0, 255,
+                               dtype=jnp.int32).astype(jnp.uint8)
+        y = jax.random.randint(ky, (P, n_local), 0, 2, dtype=jnp.int32)
+        n = jnp.asarray([n_local] * C + [0] * (P - C), jnp.int32)
+        fed = FederatedData(X_train=X, y_train=y, n_train=n,
+                            X_test=X[:, :4], y_test=y[:, :4],
+                            n_test=jnp.where(n > 0, 4, 0))
+        if sharded:
+            from neuroimagedisttraining_tpu.parallel.mesh import (
+                shard_federation,
+            )
+            fed = shard_federation(fed, mesh)
+        return fed
+
+    def engine_for(C: int, mode: str, algorithm: str = "fedavg"):
+        """mode: 'sharded' | 'sequential' (C-loop reference) |
+        'vmapped' (the shipped unsharded default)."""
+        pad = ((C + D - 1) // D) * D
+        cfg = ExperimentConfig(
+            model=model_name, num_classes=1, algorithm=algorithm,
+            data=DataConfig(dataset="synthetic"),
+            optim=OptimConfig(lr=1e-3, batch_size=batch, epochs=1),
+            fed=FedConfig(client_num_in_total=C, comm_round=3,
+                          frequency_of_the_test=10 ** 9,
+                          client_mesh=D if mode != "vmapped" else 0),
+            log_dir="/tmp/nidt_bench", tag=f"cohort-{mode}-{C}")
+        trainer = LocalTrainer(create_model(model_name, num_classes=1),
+                               cfg.optim, num_classes=1)
+        use_mesh = None if mode == "vmapped" else mesh
+        fed = make_fed(C, None if mode == "vmapped" else pad,
+                       sharded=mode != "vmapped")
+        eng = create_engine(algorithm, cfg, fed, trainer, mesh=use_mesh,
+                            logger=log)
+        eng._donate = False
+        if mode == "sequential":
+            eng._cohort_sequential = True
+        return eng
+
+    def bestof(fn):
+        fn()  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    cells: dict[str, dict] = {}
+    for C in Cs:
+        row: dict[str, float] = {}
+        for mode in ("sequential", "sharded", "vmapped"):
+            eng = engine_for(C, mode)
+            gs = eng.init_global_state()
+            sampled = eng.client_sampling(0)
+            if mode == "vmapped":
+                rngs = eng.per_client_rngs(0, sampled)
+                fn = lambda e=eng, g=gs, s=sampled, r=rngs: e._round_jit(
+                    g.params, g.batch_stats, e.data, jnp.asarray(s), r,
+                    e.round_lr(0))
+            else:
+                ids, n_real = eng._cohort_pad(sampled)
+                rngs = eng.per_client_rngs(0, ids)
+                row["n_pad"] = len(ids)
+                fn = lambda e=eng, g=gs, i=ids, r=rngs, nr=n_real: \
+                    e._sharded_round_jit(nr)(
+                        g.params, g.batch_stats, e.data, jnp.asarray(i),
+                        r, e.round_lr(0))
+            key = {"sequential": "sequential_loop_s",
+                   "sharded": "sharded_s",
+                   "vmapped": "vmapped_unsharded_s"}[mode]
+            row[key] = round(bestof(fn), 4)
+        row["speedup_vs_sequential_loop"] = round(
+            row["sequential_loop_s"] / row["sharded_s"], 3)
+        cells[str(C)] = row
+
+    # slopes (s per client) from a least-squares fit over the C sweep —
+    # the stable claim on a noisy shared host
+    xs = np.asarray(Cs, np.float64)
+    slope = {}
+    for key in ("sequential_loop_s", "sharded_s", "vmapped_unsharded_s"):
+        ys = np.asarray([cells[str(C)][key] for C in Cs])
+        slope[key] = float(np.polyfit(xs, ys, 1)[0])
+    slope["sharded_over_sequential"] = round(
+        slope["sharded_s"] / max(slope["sequential_loop_s"], 1e-12), 4)
+    slope = {k: round(v, 6) for k, v in slope.items()}
+
+    # flagship 21-site salientgrads: sharded masked round + mask pipeline
+    sg_sh = engine_for(21, "sharded", "salientgrads")
+    sg_un = engine_for(21, "vmapped", "salientgrads")
+    gs = sg_sh.init_global_state()
+    mask_sync = lambda m: float(sum(jnp.sum(x)
+                                    for x in jax.tree.leaves(m)))
+    t_mask = {}
+    for name, e in (("cohort_sharded", sg_sh), ("unsharded", sg_un)):
+        e.generate_global_mask(gs.params, gs.batch_stats)  # compile+warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            m, _ = e.generate_global_mask(gs.params, gs.batch_stats)
+            mask_sync(m)
+            best = min(best, time.perf_counter() - t0)
+        t_mask[name] = round(best * 1e3, 1)
+    masks, _ = sg_sh.generate_global_mask(gs.params, gs.batch_stats)
+    per = sg_sh.broadcast_states(gs, sg_sh.num_clients)
+    sampled = sg_sh.client_sampling(0)
+    ids, n_real = sg_sh._cohort_pad(sampled)
+    rngs = sg_sh.per_client_rngs(0, ids)
+    sg_round_s = bestof(lambda: sg_sh._sharded_round_jit(n_real)(
+        gs.params, gs.batch_stats, per.params, per.batch_stats,
+        sg_sh.data, masks, jnp.asarray(ids), rngs, sg_sh.round_lr(0)))
+
+    # K=4 fused window: ONE compiled program, ONE dispatch per window
+    fz = engine_for(21, "sharded")
+    fz.cfg = dataclasses.replace(
+        fz.cfg, fed=dataclasses.replace(fz.cfg.fed, comm_round=4,
+                                        rounds_per_dispatch=4))
+    gsf = fz.init_global_state()
+    w_s = bestof(lambda: fz._run_fused_window(
+        jax.tree.map(jnp.copy, gsf.params),
+        jax.tree.map(jnp.copy, gsf.batch_stats), 0, 4)[2])
+    fused_cache = len(fz.__dict__.get("_fused_round_jit_cache", {}))
+
+    return {
+        "metric": "cohort_sharding",
+        "devices": D,
+        "device_kind": getattr(jax.devices()[0], "device_kind",
+                               "unknown"),
+        "model": model_name, "shape": "x".join(map(str, shape)),
+        "batch": batch, "n_local": n_local,
+        "cells_per_round_s": cells,
+        "slope_s_per_client": slope,
+        "flagship_21_salientgrads": {
+            "sharded_round_s": round(sg_round_s, 4),
+            "mask_ms": t_mask,
+        },
+        "fused_k4_window": {
+            "window_s": round(w_s, 4),
+            "per_round_s": round(w_s / 4, 4),
+            "compiled_programs": fused_cache,
+            "dispatches_per_window": 1,
+        },
+        "timing": f"best of {reps} repeats",
+        "caveat": ("virtual-CPU-mesh numbers when BENCH_COHORT_VIRTUAL=1 "
+                   "(2-core harness): the slope ratio and the one-"
+                   "dispatch pin are the stable claims; the absolute "
+                   "sharded speedup is a TPU-session measurement"),
+    }
+
+
 def main() -> None:
+    cohort_devices = int(os.environ.get("BENCH_COHORT_DEVICES", "0"))
+    if cohort_devices > 1:
+        # standalone cell: provisions (optionally virtual) devices before
+        # any backend touch, prints ONE JSON line, skips the flagship
+        # phases (scripts/run_cohort_bench.sh -> bench_matrix/)
+        print(json.dumps(cohort_sharding_cell(cohort_devices)))
+        return
+
     import jax
     import jax.numpy as jnp
     import numpy as np

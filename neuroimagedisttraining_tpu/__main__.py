@@ -227,6 +227,19 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "compile); unset falls back to "
                              "$NIDT_COMPILE_CACHE, then "
                              "/tmp/nidt_jax_cache; empty string disables")
+    parser.add_argument("--client_mesh", type=int, default=0,
+                        help="shard the sampled-client axis of every "
+                             "jitted round program over a client mesh of "
+                             "exactly N devices (parallel/cohort.py): "
+                             "per-device local training on client "
+                             "shards, aggregation on all-gathered "
+                             "stacks, bitwise-equal to the unsharded "
+                             "round; non-tiling cohorts (21 sites on 8 "
+                             "devices) pad with zero-weight rows. "
+                             "Engines/modes without a sharded round "
+                             "body fall back with a logged reason. "
+                             "Combine with --virtual_devices N to "
+                             "simulate without TPU hardware")
     parser.add_argument("--rounds_per_dispatch", type=int, default=1,
                         help="fuse up to K rounds into ONE lax.scan "
                              "dispatch when the federation is resident "
@@ -272,6 +285,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             norm_bound=args.norm_bound, stddev=args.stddev,
             byz_f=args.byz_f, geomed_iters=args.geomed_iters,
             rounds_per_dispatch=args.rounds_per_dispatch,
+            client_mesh=args.client_mesh,
             frequency_of_the_test=args.frequency_of_the_test,
             ci=bool(args.ci)),
         sparsity=SparsityConfig(
@@ -445,8 +459,13 @@ def main(argv: list[str] | None = None) -> int:
     # axes silo-major (data/stream.py::_put), so the engine's silo-first
     # aggregation routing is preserved while the cohort streams from host
     from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
-    if args.streaming and not cfg.mesh_shape:
+    if args.streaming and not cfg.mesh_shape and not cfg.fed.client_mesh:
         mesh = None  # plain single-device streaming feed
+    elif cfg.fed.client_mesh > 0 and not cfg.mesh_shape:
+        # --client_mesh N builds the 1-D N-device client mesh it shards
+        # over (an explicit --mesh_shape wins and must agree — the
+        # engine validates the sizes at startup)
+        mesh = make_mesh(num_devices=cfg.fed.client_mesh)
     else:
         mesh = make_mesh(shape=cfg.mesh_shape)
     engine = build_experiment(cfg, streaming=args.streaming, mesh=mesh)

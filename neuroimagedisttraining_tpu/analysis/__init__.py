@@ -33,6 +33,7 @@ from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
     donation,
     engine_contract,
     lock_discipline,
+    mesh_discipline,
     trace_safety,
 )
 

@@ -1,0 +1,110 @@
+"""Mesh-discipline rules: explicit shard_map specs, one pad-weight rule.
+
+Cohort sharding (ISSUE 6, parallel/cohort.py) put a second family of
+``shard_map`` programs in the tree and made zero-weight pad rows a
+correctness invariant (a pad row that keeps its gathered sample count
+VOTES in the aggregation — silently, since a pad often duplicates a real
+client's id). Two lexical rules keep both honest:
+
+- ``mesh-shardmap-specs`` — every ``shard_map`` call must pass BOTH
+  ``in_specs`` and ``out_specs`` as explicit keywords. An omitted spec
+  either crashes at trace time (hard to attribute through the engine
+  stack) or — worse, on API versions that default it — silently
+  replicates an axis the caller meant to shard, turning a sharded round
+  into C copies of the same work. The placement contract must be
+  visible at the call site.
+- ``mesh-pad-weights`` — pad-row weight masks must come from THE shared
+  helper (``parallel.cohort.pad_row_weights``); reconstructing the
+  ``arange(...) < n_real`` position mask ad hoc is flagged anywhere
+  outside ``parallel/cohort.py``. The helper is one line — the rule
+  exists because the half-correct rewrite (zeroing by gathered sample
+  count instead of by position) type-checks, runs, and double-counts a
+  duplicated client.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from neuroimagedisttraining_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    normalize,
+    register,
+)
+
+#: the one module allowed to build pad-row position masks by hand
+_PAD_HELPER_HOME = "cohort.py"
+
+
+def _is_arange_call(node: ast.AST, aliases: dict) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = normalize(dotted_name(node.func), aliases) or ""
+    return name.split(".")[-1] == "arange"
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+@register
+class MeshDisciplineRule(Rule):
+    rule_ids = ("mesh-shardmap-specs", "mesh-pad-weights")
+    description = (
+        "shard_map calls must declare explicit in_specs AND out_specs "
+        "(mesh-shardmap-specs); pad-row zero-weight masks must come from "
+        "parallel.cohort.pad_row_weights, not ad-hoc arange(...) < n_real "
+        "comparisons (mesh-pad-weights)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        yield from self._check_shardmap_specs(mod)
+        yield from self._check_pad_weights(mod)
+
+    # ---------- mesh-shardmap-specs ----------
+
+    def _check_shardmap_specs(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = normalize(dotted_name(node.func), mod.aliases) or ""
+            if name.split(".")[-1] != "shard_map":
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            missing = sorted({"in_specs", "out_specs"} - kwargs)
+            if missing:
+                yield Finding(
+                    mod.path, node.lineno, "mesh-shardmap-specs",
+                    f"shard_map call omits explicit {' and '.join(missing)}"
+                    " — the placement contract must be declared at the "
+                    "call site (a defaulted spec silently replicates an "
+                    "axis the caller meant to shard)")
+
+    # ---------- mesh-pad-weights ----------
+
+    def _check_pad_weights(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if mod.path_parts and mod.path_parts[-1] == _PAD_HELPER_HOME \
+                and "parallel" in mod.path_parts:
+            return  # the helper's own home
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides = [node.left] + list(node.comparators)
+            has_arange = any(_is_arange_call(s, mod.aliases)
+                             for s in sides)
+            names = {_terminal_name(s) for s in sides}
+            if has_arange and "n_real" in names:
+                yield Finding(
+                    mod.path, node.lineno, "mesh-pad-weights",
+                    "ad-hoc pad-row mask (arange(...) compared against "
+                    "n_real) — use parallel.cohort.pad_row_weights, the "
+                    "one audited zero-weight construction (pads may "
+                    "DUPLICATE a real client id; zeroing by position is "
+                    "the only correct rule)")

@@ -153,6 +153,19 @@ class FedConfig:
     # evolution, streaming, --wire_codec byte accounting) transparently
     # fall back to one round per dispatch with a logged reason.
     rounds_per_dispatch: int = 1
+    # Cohort sharding (ISSUE 6, parallel/cohort.py): when > 0, the
+    # sampled-client axis of every jitted round program shards over a
+    # client mesh of exactly this many devices (one shard_map per round:
+    # per-device local training on the client shards, trained stacks
+    # all-gathered, aggregation/defense/codec tail on replicated full
+    # stacks — bitwise-equal to the unsharded round). Sampled sets that
+    # do not tile the mesh (the flagship 21 sites on 8 devices) pad with
+    # zero-weight rows. Engines whose rounds cross the host or exchange
+    # per-client state outside the fedavg/salientgrads shape — and the
+    # streaming/two-level-mesh/single-device modes — fall back to the
+    # unsharded round with a logged reason; a mismatch with the
+    # constructed mesh size is a startup error.
+    client_mesh: int = 0
     # Evaluation cadence
     frequency_of_the_test: int = 1
     ci: bool = False               # CI mode: evaluate client 0 only

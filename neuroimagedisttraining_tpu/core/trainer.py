@@ -54,6 +54,18 @@ def epoch_permutations(rng: jax.Array, epochs: int, max_samples: int,
     return jnp.argsort(u, axis=-1)
 
 
+def epoch_perms_for(rng: jax.Array, epochs: int, max_samples: int,
+                    n_valid) -> jax.Array:
+    """The epoch permutations ``local_train`` would derive from ``rng``
+    (its ``cs.rng`` at entry) — the hoisted form the cohort-sharded round
+    computes OUTSIDE its ``shard_map`` and passes via ``perms=``: the
+    argsort-lowered permutation miscompiles inside a shard_map partition
+    on this toolchain (see ``local_train``'s docstring and
+    parallel/cohort.py). Must mirror local_train's split exactly."""
+    _, prng = jax.random.split(rng)
+    return epoch_permutations(prng, epochs, max_samples, n_valid)
+
+
 def shuffle_batch_indices(perms: jax.Array, t, steps_per_epoch: int,
                           batch_size: int, n_valid):
     """Row indices + validity weights for scan step ``t`` when walking the
@@ -148,7 +160,8 @@ class LocalTrainer:
                     batch_size: int, max_samples: int,
                     mask: PyTree | None = None,
                     prox_lamda: float | None = None,
-                    prox_ref: PyTree | None = None):
+                    prox_ref: PyTree | None = None,
+                    perms: jax.Array | None = None):
         """E epochs of local SGD on device-resident (padded) client data.
 
         Returns ``(new_state, mean_loss)``. ``n_valid`` is the client's true
@@ -170,6 +183,16 @@ class LocalTrainer:
         ``prox_lamda``/``prox_ref``: Ditto's personalized proximal pull,
         applied after each optimizer step: ``w -= lr * lamda * (w - ref)``
         (ditto/my_model_trainer.py:63-64).
+
+        ``perms``: precomputed epoch permutations (what
+        :func:`epoch_perms_for` derives from the SAME ``cs.rng``) — the
+        cohort-sharded round (parallel/cohort.py) computes them OUTSIDE
+        its ``shard_map`` and passes them in, because the argsort-based
+        permutation lowering MISCOMPILES inside a shard_map partition on
+        this toolchain (jax 0.4.x CPU SPMD: the consumed permutation
+        silently differs from the observable one — caught by the cohort
+        bitwise pins). The rng stream is identical either way: the split
+        that would have fed the permutation is still consumed.
         """
         steps_per_epoch = max(1, math.ceil(max_samples / batch_size))
         my_steps = jnp.ceil(n_valid / batch_size).astype(jnp.int32)
@@ -180,7 +203,9 @@ class LocalTrainer:
             # permutation of the client's rows in batch_size strides
             rng0, prng = jax.random.split(cs.rng)
             cs = cs.replace(rng=rng0)
-            perms = epoch_permutations(prng, epochs, max_samples, n_valid)
+            if perms is None:
+                perms = epoch_permutations(prng, epochs, max_samples,
+                                           n_valid)
 
         def step(carry, t):
             state = carry

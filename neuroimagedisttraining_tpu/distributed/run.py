@@ -81,6 +81,20 @@ def dispatch_fallback_note(k: int) -> str | None:
             "sockets)")
 
 
+def cohort_fallback_note(n: int) -> str | None:
+    """Why ``--client_mesh`` (ISSUE 6) has nothing to shard on the
+    distributed transport (printed once at startup; None when n <= 0).
+    The cohort-sharded round maps an IN-PROCESS ``[C, ...]`` client
+    stack onto a device mesh; here each rank is one silo training only
+    its own cohort — the client axis is the set of OS processes."""
+    if n <= 0:
+        return None
+    return (f"client_mesh={n} requested; the distributed transport has "
+            "no in-process client axis to shard (each rank trains its "
+            "own silo) — flag accepted for config parity with the main "
+            "CLI only")
+
+
 def _parse_hosts(spec: str) -> dict[int, str] | None:
     if not spec:
         return None
@@ -426,9 +440,18 @@ def main(argv=None) -> int:
                          "the cross-silo control plane synchronizes with "
                          "every silo each round, so rounds always "
                          "dispatch one at a time here")
+    ap.add_argument("--client_mesh", type=int, default=0,
+                    help="accepted for config parity with the main CLI; "
+                         "each cross-silo rank trains only its own silo, "
+                         "so there is no in-process client axis to shard "
+                         "(cohort sharding lives in the simulated "
+                         "engines, parallel/cohort.py)")
     args = ap.parse_args(argv)
     if args.rounds_per_dispatch > 1:
         print(f"[dispatch] {dispatch_fallback_note(args.rounds_per_dispatch)}",
+              flush=True)
+    if args.client_mesh > 0:
+        print(f"[cohort] {cohort_fallback_note(args.client_mesh)}",
               flush=True)
     if args.role == "aggregator":
         if args.n_aggregators <= 0:

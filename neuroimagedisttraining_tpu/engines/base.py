@@ -30,6 +30,7 @@ from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.faults.schedule import (
     FaultSchedule, parse_fault_spec,
 )
+from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.utils import checkpoint as ckpt
 from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger, get_logger
 from neuroimagedisttraining_tpu.utils import pytree as pt
@@ -58,6 +59,11 @@ class FederatedEngine:
     #: satellite). Base engines aggregate with a plain weighted mean and
     #: support no defense at all.
     supported_defenses: tuple = ("none",)
+    #: engines whose round body can run its local-training stage under
+    #: the cohort-sharded client mesh (``--client_mesh``, ISSUE 6,
+    #: parallel/cohort.py); others fall back to the unsharded round with
+    #: a logged reason (same pattern as fused-dispatch fallback)
+    supports_cohort_sharding = False
 
     def __init__(self, cfg: ExperimentConfig, fed_data: FederatedData | None,
                  trainer: LocalTrainer, mesh=None,
@@ -157,6 +163,37 @@ class FederatedEngine:
         #: device-side non-finite-upload counts queued per round; synced
         #: in one batched device_get at host boundaries (_flush_nonfinite)
         self._nonfinite_pending: list = []
+        # cohort sharding (--client_mesh, ISSUE 6): hard config errors
+        # fail here; engines/modes whose rounds cannot shard announce the
+        # unsharded fallback ONCE, up front (the fused-dispatch pattern)
+        self._cohort_on = False
+        cm = int(cfg.fed.client_mesh)
+        if cm > 0:
+            if mesh is None:
+                raise ValueError(
+                    f"--client_mesh {cm} requested but no device mesh was "
+                    "constructed — build the engine with a mesh (the CLIs "
+                    "do this automatically; tests: make_mesh())")
+            if cm != mesh.devices.size:
+                raise ValueError(
+                    f"--client_mesh {cm} does not match the constructed "
+                    f"{mesh.devices.size}-device mesh; pass a matching "
+                    "--client_mesh / --mesh_shape / --virtual_devices "
+                    "combination (the sampled-client axis shards over "
+                    "EVERY mesh device)")
+            reason = self._cohort_fallback_reason()
+            if reason is None:
+                self._cohort_on = True
+                self.log.info(
+                    "client_mesh=%d: cohort sharding armed — the sampled-"
+                    "client axis of every round program shards over the "
+                    "%d-device mesh (pad rows zero-weighted, aggregation "
+                    "on all-gathered stacks; parallel/cohort.py)",
+                    cm, mesh.devices.size)
+            else:
+                self.log.info(
+                    "client_mesh=%d requested; running the unsharded "
+                    "round program: %s", cm, reason)
         # fused multi-round dispatch (ISSUE 4): engines that cannot fuse
         # announce the collapse to K=1 ONCE, up front, so a config asking
         # for amortized dispatch never silently degrades
@@ -253,15 +290,8 @@ class FederatedEngine:
                 "cohort; this is a configuration error, not a crash)")
         if self.mesh is None:
             return sampled, len(sampled)
-        D = self.mesh.devices.size
-        pad = (-len(sampled)) % D
-        if pad == 0:
-            return sampled, len(sampled)
-        pool = np.arange(self.real_clients, self.num_clients)
-        fill = np.concatenate([pool, np.full(max(0, pad - len(pool)),
-                                             sampled[-1])])[:pad]
-        return np.concatenate([sampled, fill]).astype(sampled.dtype), \
-            len(sampled)
+        return cohort.pad_cohort(sampled, self.real_clients,
+                                 self.num_clients, self.mesh.devices.size)
 
     def scatter_sampled_rows(self, all_tree, new_tree, sampled_idx, real):
         """Write the sampled clients' new rows into the [C, ...] stacked
@@ -483,15 +513,24 @@ class FederatedEngine:
         lines the sequential loop would have emitted, and the stacked
         device inputs for the scan — including the [K, C]-stacked
         Byzantine attack plan when the fault schedule carries value
-        faults (None otherwise). Returns
-        ``(sampled, idx, rngs, lrs, byz, k)``."""
+        faults (None otherwise). With cohort sharding armed, ``idx`` and
+        ``rngs`` cover the mesh-padded per-round sets ([K, P]) while the
+        byz plan stays on the REAL sampled sets (the sharded round body
+        slices pad rows off before the attack/defense tail); ``n_real``
+        is the static real cohort size (None when unsharded). Returns
+        ``(sampled, idx, rngs, lrs, byz, k, n_real)``."""
         sampled, k = self._window_sampling(round_idx, k)
         for off, s in enumerate(sampled):
             self.log.info("################ round %d: clients %s (fused "
                           "window of %d)", round_idx + off, s.tolist(), k)
-        idx = jnp.asarray(np.stack(sampled))
+        if self._cohort_on:
+            ids = [self._cohort_pad(s)[0] for s in sampled]
+            n_real = len(sampled[0])
+        else:
+            ids, n_real = sampled, None
+        idx = jnp.asarray(np.stack(ids))
         rngs = jnp.stack([self.per_client_rngs(round_idx + off, s)
-                          for off, s in enumerate(sampled)])
+                          for off, s in enumerate(ids)])
         lrs = jnp.asarray([self.round_lr(round_idx + off)
                            for off in range(k)], jnp.float32)
         byz = None
@@ -500,7 +539,116 @@ class FederatedEngine:
                      for off, s in enumerate(sampled)]
             byz = tuple(jnp.stack([p[i] for p in plans])
                         for i in range(4))
-        return sampled, idx, rngs, lrs, byz, k
+        return sampled, idx, rngs, lrs, byz, k, n_real
+
+    # ---------- cohort sharding (--client_mesh, ISSUE 6) ----------
+
+    def cohort_fallback_reason(self) -> str | None:
+        """Why this engine runs the unsharded round even when
+        ``--client_mesh`` asks for the cohort-sharded client mesh — or
+        None when its round body supports the sharded local-training
+        stage (parallel/cohort.py). The base answer covers every engine
+        whose round crosses the host or exchanges per-client state in a
+        non-FedAvg shape; capable engines set
+        ``supports_cohort_sharding`` and delegate the mode checks to
+        ``_cohort_fallback_reason``."""
+        return ("engine has no cohort-sharded round body (its round "
+                "crosses the host or exchanges per-client state outside "
+                "the fedavg/salientgrads shape)")
+
+    def _cohort_fallback_reason(self) -> str | None:
+        """Engine capability + mode checks, combined. Mirrors
+        ``fused_fallback_reason``'s contract: None means the sharded
+        path arms."""
+        if not self.supports_cohort_sharding:
+            return self.cohort_fallback_reason()
+        if self.mesh is not None and len(self.mesh.axis_names) != 1:
+            return ("two-level (silos, clients) mesh routes aggregation "
+                    "silo-first (parallel/hierarchical.py); cohort "
+                    "sharding arms on 1-D client meshes")
+        if self.mesh is not None and self.mesh.devices.size == 1:
+            return ("only one device visible — the unsharded round IS "
+                    "the single-device program")
+        if self.stream is not None:
+            return ("streaming rounds host-stage each round's shards; "
+                    "the streamed feed already device_puts them client-"
+                    "sharded over the mesh")
+        if self.cfg.optim.batch_order != "shuffle":
+            return ("batch_order=replacement draws per-step randint "
+                    "batches inside the shard_map partition, where the "
+                    "partitioned RNG+gather lowering miscompiles on this "
+                    "toolchain (measured, parallel/cohort.py); the "
+                    "shuffle path hoists its permutations out of the "
+                    "partition — i.i.d. per-step draws cannot be hoisted")
+        return None
+
+    def _cohort_pad(self, sampled: np.ndarray) -> tuple[np.ndarray, int]:
+        """``(padded_ids, n_real)`` for a cohort-sharded resident round:
+        the sampled set padded to tile the client mesh (the shared
+        ``pad_cohort`` rule — zero-sample pool first, then repeat)."""
+        return cohort.pad_cohort(np.asarray(sampled), self.real_clients,
+                                 self.num_clients, self.mesh.devices.size)
+
+    def _cohort_perms(self, rngs, ns):
+        """Hoisted per-client epoch permutations for a sharded
+        local-train stage: what each client's ``local_train`` would
+        derive from its rng, computed OUTSIDE the shard_map and passed
+        in via ``perms=`` — the argsort-lowered permutation MISCOMPILES
+        inside a shard_map partition on this toolchain (jax 0.4.x CPU
+        SPMD; the consumed permutation silently differs from the
+        observable one — core/trainer.py documents the measurement).
+        None under ``batch_order=replacement`` (i.i.d. randint draws, no
+        permutation to hoist)."""
+        if self.cfg.optim.batch_order != "shuffle":
+            return None
+        from neuroimagedisttraining_tpu.core.trainer import epoch_perms_for
+
+        o = self.cfg.optim
+        ms = self._max_samples()
+        return jax.vmap(
+            lambda r, n: epoch_perms_for(r, o.epochs, ms, n))(rngs, ns)
+
+    def _cohort_local_stage(self, fn, cs, Xs, ys, ns):
+        """The sharded local-training stage as one call: hoist the epoch
+        permutations from ``cs.rng``, then run the per-client loop under
+        the client mesh. The hoist is non-optional here by construction
+        — cohort sharding only arms under ``batch_order=shuffle``
+        (``_cohort_fallback_reason``), so hoistable perms always exist;
+        reaching this point without them would put the argsort back
+        inside the partition, the exact miscompile the hoist prevents."""
+        perms = self._cohort_perms(cs.rng, ns)
+        assert perms is not None, \
+            "cohort sharding armed without hoistable epoch permutations"
+        return self._cohort_map(fn, cs, Xs, ys, ns, perms)
+
+    def _cohort_round_prog(self, sampled: np.ndarray):
+        """``(gather_ids, round_prog)`` for one resident round: the
+        mesh-padded id set + the sharded round program when cohort
+        sharding is armed; the sampled set + the unsharded
+        ``_round_jit`` otherwise (shared by the fedavg-family and
+        salientgrads drivers)."""
+        if self._cohort_on:
+            ids, n_real = self._cohort_pad(sampled)
+            return ids, self._sharded_round_jit(n_real)
+        return sampled, self._round_jit
+
+    #: when True, the sharded round programs lower their local-training
+    #: stage to the SEQUENTIAL C-loop on one device instead of the
+    #: mesh-sharded loops — the bitwise reference tests/test_cohort.py
+    #: and the bench's slope baseline pin the sharded path against (set
+    #: BEFORE the first program access; the jits read it at build time)
+    _cohort_sequential = False
+
+    def _cohort_map(self, fn, *stacked):
+        """The round body's local-training stage on the sharded path:
+        the unbatched per-client loop, shard_mapped over the client mesh
+        and all-gathered back to replicated full stacks — or the same
+        loop on one device when ``_cohort_sequential`` asks for the
+        sequential reference (~1-ulp-equal with bitwise first-round
+        losses — the full contract in parallel/cohort.py)."""
+        if self._cohort_sequential:
+            return cohort.sequential_map(fn, *stacked)
+        return cohort.cohort_map(self.mesh, fn, *stacked)
 
     # ---------- Byzantine value faults (faults/adversary.py, ISSUE 5) ----------
 

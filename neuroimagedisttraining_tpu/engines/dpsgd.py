@@ -87,6 +87,13 @@ class DPSGDEngine(FederatedEngine):
     # shards.
     supports_streaming = True
 
+    def cohort_fallback_reason(self) -> str | None:
+        # same story as DisPFL: the gossip consensus already lowers to
+        # client-sharded mesh collectives (parallel/gossip.py)
+        return ("dpsgd's decentralized round already runs client-sharded "
+                "gossip collectives on the mesh (parallel/gossip.py); "
+                "--client_mesh adds nothing")
+
     def _consensus(self, per_params, per_bstats, M, plan_arrays=None, *,
                    plan=None):
         """Gossip consensus over last round's models: ppermute ring shifts

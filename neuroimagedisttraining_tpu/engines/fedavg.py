@@ -25,6 +25,7 @@ from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.faults import adversary
+from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.utils import pytree as pt
 
 
@@ -34,6 +35,8 @@ class FedAvgEngine(FederatedEngine):
     supports_wire_codec = True  # _round_body runs the codec roundtrip
     supports_byz_faults = True  # _round_body routes uploads through the
     # adversary transform when the schedule carries byz: value faults
+    supports_cohort_sharding = True  # _round_body's local-train stage
+    # runs under the --client_mesh shard_map (ISSUE 6)
     supported_defenses = robust.DEFENSES
 
     def _prox_kwargs(self, global_params) -> dict:
@@ -42,9 +45,22 @@ class FedAvgEngine(FederatedEngine):
         return {}
 
     def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr, efs=None,
-                    byz=None):
+                    byz=None, n_real=None):
         """One FedAvg round over pre-gathered sampled-client shards; shared
-        by the device-resident and streaming paths.
+        by the device-resident, streaming, and cohort-sharded paths.
+
+        ``n_real`` (static) marks the cohort-sharded program (ISSUE 6):
+        the incoming shards cover the MESH-PADDED sampled set (pad rows
+        zero-weighted by position — cohort.pad_row_weights, since a pad
+        may duplicate a real client id), the local-training stage runs
+        as unbatched per-client loops under the client-mesh shard_map,
+        and the trained stacks are statically sliced back to the real
+        ``n_real`` rows — the attack/codec/sanitize/defense/aggregation
+        tail below then executes the identical operations the sequential
+        C-loop program executes (losses bitwise from identical state,
+        state to ~1 ulp — the full contract in parallel/cohort.py,
+        pinned in tests/test_cohort.py). ``efs``/``byz`` are always
+        sized for the REAL sampled set.
 
         ``byz`` (faults/adversary.py plan ``(mult, std, nonfinite,
         keys)``, [C] each) transforms the scheduled clients' uploads
@@ -71,6 +87,8 @@ class FedAvgEngine(FederatedEngine):
         S = Xs.shape[0]
         max_samples = self._max_samples()
         prox = self._prox_kwargs(params)
+        if n_real is not None:
+            ns = cohort.pad_row_weights(ns, n_real)
         cs = ClientState(
             params=jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
@@ -82,12 +100,21 @@ class FedAvgEngine(FederatedEngine):
             rng=rngs,
         )
 
-        def local(cs_c, Xc, yc, nc):
+        def local(cs_c, Xc, yc, nc, perms_c=None):
             return trainer.local_train(
                 cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                batch_size=o.batch_size, max_samples=max_samples, **prox)
+                batch_size=o.batch_size, max_samples=max_samples,
+                perms=perms_c, **prox)
 
-        cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
+        if n_real is None:
+            cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
+        else:
+            # hoisted-perms sharded loop (base._cohort_local_stage)
+            cs, losses = self._cohort_local_stage(local, cs, Xs, ys, ns)
+            if n_real < S:  # static slice: drop the mesh-pad rows
+                cs = jax.tree.map(lambda x: x[:n_real], cs)
+                losses = losses[:n_real]
+                ns = ns[:n_real]
         w = ns.astype(jnp.float32)
         client_params = cs.params
         client_bstats = cs.batch_stats
@@ -166,6 +193,26 @@ class FedAvgEngine(FederatedEngine):
         return jax.jit(round_fn,
                        donate_argnums=self._donate_argnums(0, 1, 6))
 
+    def _sharded_round_jit(self, n_real: int):
+        """The cohort-sharded round program (ISSUE 6): same signature and
+        donation contract as ``_round_jit``, but ``sampled_idx``/``rngs``
+        cover the MESH-PADDED sampled set and the body shards the local-
+        training stage over the client mesh (``n_real`` static — fault-
+        schedule cohort shrinkage re-specializes via the plan cache)."""
+        def build():
+            def sharded_round_fn(params, bstats, data, sampled_idx, rngs,
+                                 lr, efs=None, byz=None):
+                Xs = jnp.take(data.X_train, sampled_idx, axis=0)
+                ys = jnp.take(data.y_train, sampled_idx, axis=0)
+                ns = jnp.take(data.n_train, sampled_idx, axis=0)
+                return self._round_body(params, bstats, Xs, ys, ns, rngs,
+                                        lr, efs, byz, n_real=n_real)
+
+            return jax.jit(sharded_round_fn,
+                           donate_argnums=self._donate_argnums(0, 1, 6))
+
+        return self._plan_cached("_sharded_round_jit_cache", n_real, build)
+
     @functools.cached_property
     def _round_stream_jit(self):
         return jax.jit(self._round_body,
@@ -176,13 +223,16 @@ class FedAvgEngine(FederatedEngine):
     def fused_fallback_reason(self) -> str | None:
         return self._resident_fallback_reason()
 
-    def _fused_round_jit(self, k: int):
+    def _fused_round_jit(self, k: int, n_real: int | None = None):
         """K rounds as ONE dispatched program: a ``lax.scan`` over the
         exact per-round body, consuming host-precomputed stacks of
         sampling indices / per-client rngs / round lrs. Amortizes the
         per-dispatch latency the sequential loop pays K times
         (PROFILE.md round 2: a 16-step scan sustains 2.4x the
-        per-dispatch loop through the tunnel)."""
+        per-dispatch loop through the tunnel). ``n_real`` marks the
+        cohort-sharded variant (ISSUE 6): the scanned per-round body
+        shards its local-training stage over the client mesh, consuming
+        [K, P] mesh-padded index/rng stacks."""
         def build():
             def fused_round_fn(params, bstats, data, sampled_idx, rngs,
                                lrs, byz=None):
@@ -196,7 +246,8 @@ class FedAvgEngine(FederatedEngine):
                     ys = jnp.take(data.y_train, si, axis=0)
                     ns = jnp.take(data.n_train, si, axis=0)
                     p, b, loss, bad = self._round_body(p, b, Xs, ys, ns,
-                                                       rg, lr, byz=bz)
+                                                       rg, lr, byz=bz,
+                                                       n_real=n_real)
                     return (p, b), (loss, bad)
 
                 xs = ((sampled_idx, rngs, lrs) if byz is None
@@ -208,7 +259,8 @@ class FedAvgEngine(FederatedEngine):
             return jax.jit(fused_round_fn,
                            donate_argnums=self._donate_argnums(0, 1))
 
-        return self._plan_cached("_fused_round_jit_cache", k, build)
+        return self._plan_cached("_fused_round_jit_cache", (k, n_real),
+                                 build)
 
     def _run_fused_window(self, params, bstats, round_idx: int, k: int):
         """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan.
@@ -218,8 +270,9 @@ class FedAvgEngine(FederatedEngine):
         untouched). Returns ``(params, bstats, last_round_loss,
         k_actual)`` — ``k_actual`` may shrink when the fault schedule
         varies the cohort size."""
-        _, idx, rngs, lrs, byz, k = self._window_host_inputs(round_idx, k)
-        params, bstats, losses, bads = self._fused_round_jit(k)(
+        (_, idx, rngs, lrs, byz, k,
+         n_real) = self._window_host_inputs(round_idx, k)
+        params, bstats, losses, bads = self._fused_round_jit(k, n_real)(
             params, bstats, self.data, idx, rngs, lrs, byz)
         self._note_nonfinite(bads)
         return params, bstats, losses[-1], k
@@ -242,12 +295,22 @@ class FedAvgEngine(FederatedEngine):
             rng=rngs,
         )
 
-        def local(cs_c, Xc, yc, nc):
+        def local(cs_c, Xc, yc, nc, perms_c=None):
             return trainer.local_train(
                 cs_c, Xc, yc, nc, lr, epochs=o.epochs,
-                batch_size=o.batch_size, max_samples=max_samples)
+                batch_size=o.batch_size, max_samples=max_samples,
+                perms=perms_c)
 
-        cs, _ = jax.vmap(local)(cs, X, y, n)
+        # the final fine-tune trains EVERY client — the heaviest single
+        # program of the run — so it rides the cohort-sharded mesh too
+        # when armed (the full cohort already tiles the mesh: the data
+        # layer pads num_clients to a device multiple; permutations
+        # hoisted out of the shard_map like the round's —
+        # base._cohort_local_stage)
+        if self._cohort_on and C % self.mesh.devices.size == 0:
+            cs, _ = self._cohort_local_stage(local, cs, X, y, n)
+        else:
+            cs, _ = jax.vmap(local)(cs, X, y, n)
         return cs
 
     @functools.cached_property
@@ -298,7 +361,12 @@ class FedAvgEngine(FederatedEngine):
                 sampled = self.client_sampling(round_idx)
                 self.log.info("################ round %d: clients %s",
                               round_idx, sampled.tolist())
-                rngs = self.per_client_rngs(round_idx, sampled)
+                # cohort sharding (ISSUE 6): the sharded program gathers
+                # the mesh-padded set (and takes rngs for it); the EF
+                # rows, byz plan, and byte accounting stay on the REAL
+                # sampled set — the body slices pads off before that tail
+                ids, round_prog = self._cohort_round_prog(sampled)
+                rngs = self.per_client_rngs(round_idx, ids)
                 byz = self._byz_round_plan(round_idx, sampled)
                 if codec_on:
                     # downlink reference snapshot BEFORE dispatch: the
@@ -311,8 +379,8 @@ class FedAvgEngine(FederatedEngine):
                                                np.asarray(sampled))
                            if self.wire_spec.needs_ef else None)
                     (params, bstats, loss, n_bad, new_efs,
-                     u0) = self._round_jit(
-                        params, bstats, self.data, jnp.asarray(sampled),
+                     u0) = round_prog(
+                        params, bstats, self.data, jnp.asarray(ids),
                         rngs, self.round_lr(round_idx), efs, byz)
                     if new_efs is not None:
                         real = jnp.asarray(self._n_train_host[sampled] > 0)
@@ -325,8 +393,8 @@ class FedAvgEngine(FederatedEngine):
                     # byz plans only reach engines whose round accepts
                     # them (supports_byz_faults gates at startup); efs
                     # rides its default None
-                    params, bstats, loss, n_bad = self._round_jit(
-                        params, bstats, self.data, jnp.asarray(sampled),
+                    params, bstats, loss, n_bad = round_prog(
+                        params, bstats, self.data, jnp.asarray(ids),
                         rngs, self.round_lr(round_idx), None, byz)
                 else:
                     # efs/byz stay default-bound (None): subclasses
@@ -334,8 +402,8 @@ class FedAvgEngine(FederatedEngine):
                     # (turboaggregate), and an argument filled from its
                     # default is never donated, so no explicit None is
                     # needed here
-                    params, bstats, loss, n_bad = self._round_jit(
-                        params, bstats, self.data, jnp.asarray(sampled),
+                    params, bstats, loss, n_bad = round_prog(
+                        params, bstats, self.data, jnp.asarray(ids),
                         rngs, self.round_lr(round_idx))
                 self._note_nonfinite(n_bad)
             if round_idx % cfg.fed.frequency_of_the_test == 0 \

@@ -33,6 +33,7 @@ from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.faults import adversary
+from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import snip as snip_ops
 from neuroimagedisttraining_tpu.ops.masks import mask_density, ones_mask
@@ -49,6 +50,8 @@ class SalientGradsEngine(FederatedEngine):
     supports_streaming = True
     supports_wire_codec = True  # masked roundtrip inside _round_body
     supports_byz_faults = True  # uploads route through faults/adversary
+    supports_cohort_sharding = True  # phase-1 scores and the phase-2
+    # round's local-train stage shard over the --client_mesh (ISSUE 6)
     supported_defenses = robust.DEFENSES
     #: the phase-1 global mask once generated (wire_masks handoff)
     _wire_masks = None
@@ -81,16 +84,34 @@ class SalientGradsEngine(FederatedEngine):
             rng=rngs,
         )
 
-        def per_client(cs_c, Xc, yc, nc):
+        def per_client(cs_c, Xc, yc, nc, idx_c=None):
             sc = snip_ops.iter_snip_scores(
                 trainer, cs_c, Xc, yc, nc,
                 iterations=s.itersnip_iterations, batch_size=o.batch_size,
-                stratified=s.stratified_sampling)
+                stratified=s.stratified_sampling, idx_stack=idx_c)
             # zero-weight padding clients contribute nothing
             w = (nc > 0).astype(jnp.float32)
             return jax.tree.map(lambda t: t * w, sc), w
 
-        per, w = jax.vmap(per_client)(cs, Xs, ys, ns)
+        # phase-1 scoring shards per-client over the cohort mesh when
+        # armed (the resident cohort tiles the mesh by construction —
+        # the data layer pads num_clients); the weighted SUM runs on the
+        # all-gathered replicated stacks, so scores — and the global
+        # mask/threshold — match the sequential pipeline's to ~1 ulp
+        # (tests/test_cohort.py pins the emitted masks identical on its
+        # seed). Like the round's epoch permutations, IterSNIP's batch
+        # draws are HOISTED out of the partition (in-partition RNG draws
+        # consumed by a scan are the measured miscompile class —
+        # parallel/cohort.py); the STRATIFIED sampler's choice-based
+        # draw has no hoisted form yet, so it keeps the unsharded path
+        if self._cohort_on and K % self.mesh.devices.size == 0 \
+                and not s.stratified_sampling:
+            idxs = jax.vmap(
+                lambda r, n: snip_ops.iter_snip_batch_indices(
+                    r, s.itersnip_iterations, o.batch_size, n))(cs.rng, ns)
+            per, w = self._cohort_map(per_client, cs, Xs, ys, ns, idxs)
+        else:
+            per, w = jax.vmap(per_client)(cs, Xs, ys, ns)
         return (jax.tree.map(lambda t: jnp.sum(t, axis=0), per),
                 jnp.sum(w))
 
@@ -144,10 +165,21 @@ class SalientGradsEngine(FederatedEngine):
     # ---------- phase 2: masked rounds ----------
 
     def _round_body(self, params, bstats, per_params, per_bstats, Xs, ys,
-                    ns, masks, sampled_idx, rngs, lr, byz=None):
+                    ns, masks, sampled_idx, rngs, lr, byz=None,
+                    n_real=None):
         """One masked round over pre-gathered sampled-client shards; shared
-        by the device-resident and streaming paths (sampled_idx only drives
-        the personal-state scatter).
+        by the device-resident, streaming, and cohort-sharded paths
+        (sampled_idx only drives the personal-state scatter).
+
+        ``n_real`` (static) marks the cohort-sharded program (ISSUE 6,
+        same contract as FedAvg's): the shards cover the MESH-PADDED
+        sampled set, local training runs as unbatched per-client loops
+        under the client-mesh shard_map (the ``masks`` ride as a
+        closed-over replicated constant), and the trained stacks — plus
+        ``ns``/``sampled_idx`` — are statically sliced back to the real
+        rows before the attack/codec/defense/aggregate/scatter tail
+        (losses bitwise from identical state, state to ~1 ulp vs the
+        sequential C-loop — the full contract in parallel/cohort.py).
 
         Byzantine hooks (ISSUE 5, same stages as FedAvg's round): ``byz``
         transforms the scheduled clients' uploads BEFORE the wire codec
@@ -159,6 +191,8 @@ class SalientGradsEngine(FederatedEngine):
         o = self.cfg.optim
         S = Xs.shape[0]
         max_samples = self._max_samples()
+        if n_real is not None:
+            ns = cohort.pad_row_weights(ns, n_real)
         cs = ClientState(
             params=jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
@@ -170,13 +204,23 @@ class SalientGradsEngine(FederatedEngine):
             rng=rngs,
         )
 
-        def local(cs_c, Xc, yc, nc):
+        def local(cs_c, Xc, yc, nc, perms_c=None):
             return trainer.local_train(
                 cs_c, Xc, yc, nc, lr, epochs=o.epochs,
                 batch_size=o.batch_size, max_samples=max_samples,
-                mask=masks)
+                mask=masks, perms=perms_c)
 
-        cs, losses = jax.vmap(local, in_axes=(0, 0, 0, 0))(cs, Xs, ys, ns)
+        if n_real is None:
+            cs, losses = jax.vmap(local, in_axes=(0, 0, 0, 0))(cs, Xs, ys,
+                                                               ns)
+        else:
+            # hoisted-perms sharded loop (base._cohort_local_stage)
+            cs, losses = self._cohort_local_stage(local, cs, Xs, ys, ns)
+            if n_real < S:  # static slice: drop the mesh-pad rows
+                cs = jax.tree.map(lambda x: x[:n_real], cs)
+                losses = losses[:n_real]
+                ns = ns[:n_real]
+                sampled_idx = sampled_idx[:n_real]
         w = ns.astype(jnp.float32)
         client_params = cs.params
         client_bstats = cs.batch_stats
@@ -256,6 +300,28 @@ class SalientGradsEngine(FederatedEngine):
         return jax.jit(round_fn,
                        donate_argnums=self._donate_argnums(0, 1, 2, 3))
 
+    def _sharded_round_jit(self, n_real: int):
+        """The cohort-sharded masked round (ISSUE 6): ``_round_jit``'s
+        signature and donation contract, with ``sampled_idx``/``rngs``
+        covering the MESH-PADDED sampled set and the local-train stage
+        shard_mapped over the client mesh (``n_real`` static)."""
+        def build():
+            def sharded_round_fn(params, bstats, per_params, per_bstats,
+                                 data, masks, sampled_idx, rngs, lr,
+                                 byz=None):
+                Xs = jnp.take(data.X_train, sampled_idx, axis=0)
+                ys = jnp.take(data.y_train, sampled_idx, axis=0)
+                ns = jnp.take(data.n_train, sampled_idx, axis=0)
+                return self._round_body(params, bstats, per_params,
+                                        per_bstats, Xs, ys, ns, masks,
+                                        sampled_idx, rngs, lr, byz,
+                                        n_real=n_real)
+
+            return jax.jit(sharded_round_fn,
+                           donate_argnums=self._donate_argnums(0, 1, 2, 3))
+
+        return self._plan_cached("_sharded_round_jit_cache", n_real, build)
+
     @functools.cached_property
     def _round_stream_jit(self):
         return jax.jit(self._round_body,
@@ -266,10 +332,12 @@ class SalientGradsEngine(FederatedEngine):
     def fused_fallback_reason(self) -> str | None:
         return self._resident_fallback_reason()
 
-    def _fused_round_jit(self, k: int):
+    def _fused_round_jit(self, k: int, n_real: int | None = None):
         """K masked rounds as one ``lax.scan`` over the exact round body
         (same dispatch-amortization shape as FedAvg's); the phase-1 mask
-        and the resident federation ride as loop constants."""
+        and the resident federation ride as loop constants. ``n_real``
+        marks the cohort-sharded variant (mesh-padded [K, P] index/rng
+        stacks, sharded local-train stage inside the scan)."""
         def build():
             def fused_round_fn(params, bstats, per_params, per_bstats, data,
                          masks, sampled_idx, rngs, lrs, byz=None):
@@ -283,7 +351,8 @@ class SalientGradsEngine(FederatedEngine):
                     ys = jnp.take(data.y_train, si, axis=0)
                     ns = jnp.take(data.n_train, si, axis=0)
                     p, b, pp, pb, loss, bad = self._round_body(
-                        p, b, pp, pb, Xs, ys, ns, masks, si, rg, lr, bz)
+                        p, b, pp, pb, Xs, ys, ns, masks, si, rg, lr, bz,
+                        n_real=n_real)
                     return (p, b, pp, pb), (loss, bad)
 
                 xs = ((sampled_idx, rngs, lrs) if byz is None
@@ -296,7 +365,8 @@ class SalientGradsEngine(FederatedEngine):
             return jax.jit(fused_round_fn,
                            donate_argnums=self._donate_argnums(0, 1, 2, 3))
 
-        return self._plan_cached("_fused_round_jit_cache", k, build)
+        return self._plan_cached("_fused_round_jit_cache", (k, n_real),
+                                 build)
 
     def _run_fused_window(self, params, bstats, per_params, per_bstats,
                           masks, round_idx: int, k: int):
@@ -307,10 +377,10 @@ class SalientGradsEngine(FederatedEngine):
         new state, per-round sampled sets (for the host-side stat
         accounting), the boundary round's loss, and the actual window
         length."""
-        sampled, idx, rngs, lrs, byz, k = self._window_host_inputs(
-            round_idx, k)
+        (sampled, idx, rngs, lrs, byz, k,
+         n_real) = self._window_host_inputs(round_idx, k)
         (params, bstats, per_params, per_bstats, losses,
-         bads) = self._fused_round_jit(k)(
+         bads) = self._fused_round_jit(k, n_real)(
             params, bstats, per_params, per_bstats, self.data, masks,
             idx, rngs, lrs, byz)
         self._note_nonfinite(bads)
@@ -429,16 +499,20 @@ class SalientGradsEngine(FederatedEngine):
                     masks, jnp.asarray(fed_ids), rngs,
                     self.round_lr(round_idx), byz)
             else:
-                rngs = self.per_client_rngs(round_idx, sampled)
+                # cohort sharding (ISSUE 6): padded gather ids for the
+                # sharded program; byz plan and byte accounting stay on
+                # the REAL sampled set (the body slices pads off)
+                ids, round_prog = self._cohort_round_prog(sampled)
+                rngs = self.per_client_rngs(round_idx, ids)
                 byz = self._byz_round_plan(round_idx, sampled)
                 if self.wire_spec is not None:
                     ref_host = jax.tree.map(
                         np.asarray, {"params": params,
                                      "batch_stats": bstats})
                     (params, bstats, per_params, per_bstats, loss, n_bad,
-                     u0) = self._round_jit(
+                     u0) = round_prog(
                         params, bstats, per_params, per_bstats, self.data,
-                        masks, jnp.asarray(sampled), rngs,
+                        masks, jnp.asarray(ids), rngs,
                         self.round_lr(round_idx), byz)
                     masks_host = {
                         "params": jax.tree.map(np.asarray, masks),
@@ -449,9 +523,9 @@ class SalientGradsEngine(FederatedEngine):
                         masks_host=masks_host, n_uploads=len(sampled))
                 else:
                     (params, bstats, per_params, per_bstats, loss,
-                     n_bad) = self._round_jit(
+                     n_bad) = round_prog(
                         params, bstats, per_params, per_bstats, self.data,
-                        masks, jnp.asarray(sampled), rngs,
+                        masks, jnp.asarray(ids), rngs,
                         self.round_lr(round_idx), byz)
             self._note_nonfinite(n_bad)
             n_samples = float(np.sum(self._n_train_host[sampled]))

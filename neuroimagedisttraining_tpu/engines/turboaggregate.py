@@ -58,7 +58,18 @@ class TurboAggregateEngine(FedAvgEngine):
     # for cross_silo's SecureFedAvgServer). Clipping still composes:
     # each silo clips its OWN update before sharing it.
     supports_byz_faults = False
+    # Cohort sharding (ISSUE 6) is likewise out: the round crosses the
+    # host for the MPC share pipeline every round (the client<->server
+    # boundary is the point), and this engine overrides the round
+    # programs the sharded driver would dispatch — --client_mesh falls
+    # back to the unsharded round with the logged reason below.
+    supports_cohort_sharding = False
     supported_defenses = robust.CLIP_DEFENSES
+
+    def cohort_fallback_reason(self) -> str | None:
+        return ("turboaggregate's round crosses the host at the MPC "
+                "share boundary every round (quantize/share/aggregate "
+                "models the client<->server link); no sharded round body")
 
     def _train_only_body(self, params, bstats, Xs, ys, ns, rngs, lr):
         """Local training WITHOUT the in-program aggregation: returns the

@@ -66,20 +66,47 @@ def _stratified_indices(rng: jax.Array, y: jax.Array, n_valid,
                              p=p)
 
 
+def iter_snip_batch_indices(rng: jax.Array, iterations: int,
+                            batch_size: int, n_valid) -> jax.Array:
+    """[iterations, batch_size] of the batch indices ``iter_snip_scores``
+    would draw from ``rng`` (its ``cs.rng``) — the hoisted form the
+    cohort-sharded phase-1 computes OUTSIDE its ``shard_map`` and passes
+    via ``idx_stack=``: in-partition RNG draws consumed by a scan are
+    the measured jax-0.4.x SPMD miscompile class the round's perms hoist
+    exists for (parallel/cohort.py). Must mirror ``one_iter``'s splits
+    exactly."""
+    rngs = jax.random.split(rng, iterations)
+
+    def one(r):
+        brng, _ = jax.random.split(r)
+        return jax.random.randint(brng, (batch_size,), 0,
+                                  jnp.maximum(n_valid, 1))
+
+    return jax.vmap(one)(rngs)
+
+
 def iter_snip_scores(trainer: LocalTrainer, cs: ClientState, X: jax.Array,
                      y: jax.Array, n_valid, iterations: int,
-                     batch_size: int, stratified: bool = False) -> PyTree:
+                     batch_size: int, stratified: bool = False,
+                     idx_stack: jax.Array | None = None) -> PyTree:
     """IterSNIP: mean saliency over ``iterations`` minibatches
     (client.py:30-53 + snip.py:143-164). Batches are drawn uniformly from
     the client's valid range, or label-balanced when ``stratified``
-    (reference ``stratified_sampling`` flag)."""
-    def one_iter(carry, rng):
-        brng, srng = jax.random.split(rng)
-        if stratified:
-            idx = _stratified_indices(brng, y, n_valid, batch_size)
+    (reference ``stratified_sampling`` flag). ``idx_stack``: precomputed
+    batch indices (:func:`iter_snip_batch_indices`, cohort-sharded
+    phase-1) — the dropout rng stream is identical either way (the split
+    that would feed the draw is still consumed)."""
+    def one_iter(carry, xs):
+        if idx_stack is None:
+            brng, srng = jax.random.split(xs)
+            if stratified:
+                idx = _stratified_indices(brng, y, n_valid, batch_size)
+            else:
+                idx = jax.random.randint(brng, (batch_size,), 0,
+                                         jnp.maximum(n_valid, 1))
         else:
-            idx = jax.random.randint(brng, (batch_size,), 0,
-                                     jnp.maximum(n_valid, 1))
+            rng, idx = xs
+            _, srng = jax.random.split(rng)
         # fresh dropout rng per iteration so IterSNIP iterations don't share
         # one dropout mask
         s = snip_scores(trainer, cs.replace(rng=srng),
@@ -88,7 +115,8 @@ def iter_snip_scores(trainer: LocalTrainer, cs: ClientState, X: jax.Array,
 
     zero = jax.tree.map(jnp.zeros_like, cs.params)
     rngs = jax.random.split(cs.rng, iterations)
-    total, _ = jax.lax.scan(one_iter, zero, rngs)
+    xs = rngs if idx_stack is None else (rngs, idx_stack)
+    total, _ = jax.lax.scan(one_iter, zero, xs)
     return jax.tree.map(lambda t: t / iterations, total)
 
 

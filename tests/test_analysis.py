@@ -689,3 +689,65 @@ def test_determinism_rule_covers_schedule_shaped_rng():
             return np.random.default_rng().random() < p
         """, rules=["determinism-unseeded-rng"])
     assert rules_of(fs) == ["determinism-unseeded-rng"]
+
+
+# ---------------- mesh discipline (ISSUE 6) ----------------
+
+def test_shardmap_missing_specs_flagged():
+    fs = lint("""
+        from jax.experimental.shard_map import shard_map
+
+        def f(block, mesh, x):
+            return shard_map(block, mesh=mesh)(x)
+        """, rules=["mesh-shardmap-specs"])
+    assert rules_of(fs) == ["mesh-shardmap-specs"]
+    assert "in_specs and out_specs" in fs[0].message
+
+
+def test_shardmap_partial_specs_flagged_and_full_specs_pass():
+    fs = lint("""
+        from jax import shard_map
+
+        def f(block, mesh, x, spec):
+            return shard_map(block, mesh=mesh, in_specs=(spec,))(x)
+        """, rules=["mesh-shardmap-specs"])
+    assert rules_of(fs) == ["mesh-shardmap-specs"]
+    assert "out_specs" in fs[0].message
+    assert lint("""
+        from jax.experimental.shard_map import shard_map
+
+        def f(block, mesh, x, spec):
+            return shard_map(block, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec)(x)
+        """, rules=["mesh-shardmap-specs"]) == []
+
+
+def test_pad_weights_adhoc_mask_flagged():
+    fs = lint("""
+        import jax.numpy as jnp
+
+        def weights(ns, n_real):
+            return jnp.where(jnp.arange(ns.shape[0]) < n_real, ns, 0)
+        """, path="neuroimagedisttraining_tpu/engines/base.py",
+        rules=["mesh-pad-weights"])
+    assert rules_of(fs) == ["mesh-pad-weights"]
+    assert "pad_row_weights" in fs[0].message
+
+
+def test_pad_weights_helper_home_and_other_compares_pass():
+    # the helper's own home is exempt
+    assert lint("""
+        import jax.numpy as jnp
+
+        def pad_row_weights(ns, n_real):
+            return jnp.where(jnp.arange(ns.shape[0]) < n_real, ns, 0)
+        """, path="neuroimagedisttraining_tpu/parallel/cohort.py",
+        rules=["mesh-pad-weights"]) == []
+    # sample-validity masks (arange vs a per-client count) are not the
+    # pad-row idiom and stay legal
+    assert lint("""
+        import jax.numpy as jnp
+
+        def valid(X, nc):
+            return jnp.arange(X.shape[0]) < nc
+        """, rules=["mesh-pad-weights"]) == []
