@@ -110,6 +110,28 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="cross-silo server: mark clients suspect "
                              "once their heartbeat is older than this "
                              "(0 = off)")
+    parser.add_argument("--async_server", action="store_true",
+                        help="cross-silo server runs the FedBuff-style "
+                             "buffered asynchronous control plane "
+                             "(asyncfl/, distributed.run): uploads "
+                             "aggregate every --buffer_k arrivals with "
+                             "staleness weighting instead of a round "
+                             "barrier; recorded in the config for "
+                             "parity with the multiprocess runner")
+    parser.add_argument("--buffer_k", type=int, default=0,
+                        help="async server: aggregate every K accepted "
+                             "uploads (0 = cohort size, which with zero "
+                             "staleness reproduces the synchronous "
+                             "server bitwise)")
+    parser.add_argument("--staleness_alpha", type=float, default=0.5,
+                        help="async server: polynomial staleness weight "
+                             "(1 + tau)^-alpha on upload sample counts "
+                             "(0 disables down-weighting)")
+    parser.add_argument("--max_staleness", type=int, default=20,
+                        help="async server: drop uploads based on a "
+                             "version more than this many aggregations "
+                             "old (also bounds the codec delta-"
+                             "reference ring)")
     parser.add_argument("--tag", type=str, default="test")
     parser.add_argument("--num_classes", type=int, default=1)
     # sparsity family
@@ -278,6 +300,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             round_deadline=args.round_deadline, quorum=args.quorum,
             heartbeat_interval=args.heartbeat_interval,
             heartbeat_timeout=args.heartbeat_timeout,
+            async_server=args.async_server, buffer_k=args.buffer_k,
+            staleness_alpha=args.staleness_alpha,
+            max_staleness=args.max_staleness,
             lamda=args.lamda, local_epochs=args.local_epochs,
             fomo_m=args.fomo_m, mpc_n_shares=args.mpc_n_shares,
             mpc_frac_bits=args.mpc_frac_bits, mpc_backend=args.mpc_backend,

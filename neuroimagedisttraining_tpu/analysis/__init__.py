@@ -29,6 +29,7 @@ from neuroimagedisttraining_tpu.analysis.core import (  # noqa: F401
 
 # importing the rule modules registers every rule family
 from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
+    async_discipline,
     determinism,
     donation,
     engine_contract,

@@ -18,9 +18,10 @@ Lexical means per-function: a helper that writes without taking the lock
 is flagged at its ``def`` site even if every current caller holds the
 lock — that invariant lives in the callers and must be pragma'd with the
 justification where the send happens. The rule fires for files under a
-``distributed/`` or ``faults/`` directory (the fault-injection wrapper
-writes raw frames too — torn-frame sends carry the same interleaving
-hazard as the transports').
+``distributed/``, ``faults/`` or ``asyncfl/`` directory (the
+fault-injection wrapper and the selector core both write raw frames —
+torn-frame sends carry the same interleaving hazard as the
+transports').
 """
 
 from __future__ import annotations
@@ -70,7 +71,7 @@ class LockDisciplineRule(Rule):
                    "sit inside a `with <lock>:` block")
 
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
-        if not {"distributed", "faults"} & set(mod.path_parts):
+        if not {"distributed", "faults", "asyncfl"} & set(mod.path_parts):
             return
         yield from self._walk(mod, mod.tree.body, lock_depth=0)
 

@@ -1,0 +1,35 @@
+"""Asynchronous buffered control plane (ISSUE 7).
+
+The cross-silo server (distributed/cross_silo.py) is round-synchronous:
+deadline + quorum + barrier, throughput capped by the slowest survivor,
+one listener/dispatch thread pair per connection. This package is its
+cross-device-scale counterpart:
+
+- :mod:`asyncfl.loop` — ``SelectorCommManager``, a selector-based
+  rewrite of the server-side socket core behind the same
+  ``BaseCommManager`` frame contract: ONE event-loop thread holds
+  thousands of concurrent connections (persistent duplex or the legacy
+  one-frame-per-connection clients, interchangeably), with bounded
+  per-connection write queues for backpressure.
+- :mod:`asyncfl.server` — ``BufferedFedAvgServer``, a FedBuff-style
+  (Nguyen et al., AISTATS 2022) server: uploads accepted continuously
+  into a bounded buffer, aggregated every K arrivals with polynomial
+  staleness weighting ``(1 + tau)^-alpha``, broadcasts version-tagged so
+  the wire codec's delta references stay correct against each client's
+  actual base version, admitted staleness hard-bounded.
+- :mod:`asyncfl.loadgen` — an asyncio load harness driving thousands of
+  lightweight simulated clients (canned update pytrees, seeded
+  ``FaultSchedule`` churn) against one server, emitting the
+  ``bench_matrix/async_bench.json`` sync-vs-async cell.
+"""
+
+from neuroimagedisttraining_tpu.asyncfl.loop import (  # noqa: F401
+    SelectorCommManager,
+)
+from neuroimagedisttraining_tpu.asyncfl.server import (  # noqa: F401
+    BufferedFedAvgServer,
+    staleness_weight,
+)
+
+__all__ = ["SelectorCommManager", "BufferedFedAvgServer",
+           "staleness_weight"]

@@ -1,0 +1,433 @@
+"""Load harness: thousands of lightweight simulated clients, one server.
+
+The point of the asynchronous control plane is a population no
+thread-per-connection server can hold; this module proves it ON THIS BOX
+with an asyncio client fleet in one thread — each simulated client is a
+coroutine holding one persistent connection, speaking the real protocol
+(register -> version-tagged sync -> upload echoing the tag), uploading a
+canned update pytree instead of training. Churn comes from the seeded
+``FaultSchedule``: ``crash:RANK@ROUND`` disconnects the client when it
+observes that version, ``rejoin:RANK@ROUND`` reconnects and re-registers
+once the server's version reaches the rejoin point, ``straggle:P:MAX_S``
+sleeps before uploads. One ``--fault_spec`` string therefore drives the
+same deterministic churn trace against both servers.
+
+Two modes on the SAME cohort:
+
+- ``async`` — ``BufferedFedAvgServer`` on the selector core: aggregate
+  every ``buffer_k`` arrivals, staleness-weighted.
+- ``sync`` — the round-synchronous ``FedAvgServer`` on the SAME selector
+  core (so the A/B isolates the control-plane discipline, not the socket
+  implementation), deadline + quorum armed so churn cannot deadlock the
+  barrier.
+
+Metrics per mode: sustained uploads/s (accepted), aggregations/s, p99
+version-advance latency, peak concurrent connections, byte/frame
+counters, and the accounting audits (every received upload accounted
+exactly once; accepted == aggregated + still-buffered; sent-vs-received
+reconciles to at most one in-flight upload per client).
+``main()`` writes the sync-vs-async cell to
+``bench_matrix/async_bench.json`` (scripts/run_async_bench.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import struct
+import threading
+import time
+
+import numpy as np
+
+from neuroimagedisttraining_tpu.asyncfl.loop import SelectorCommManager
+from neuroimagedisttraining_tpu.asyncfl.server import BufferedFedAvgServer
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.distributed.cross_silo import FedAvgServer
+from neuroimagedisttraining_tpu.distributed.ports import free_port_block
+from neuroimagedisttraining_tpu.faults.schedule import (
+    FaultSchedule,
+    parse_fault_spec,
+)
+
+
+def canned_update_tree(rank: int, leaf_elems: int = 256) -> dict:
+    """A small deterministic per-client update pytree (the model payload
+    stand-in). Structure must match the server's init template."""
+    rng = np.random.default_rng(9973 * rank + 17)
+    return {"params": {
+        "dense": {"kernel": rng.standard_normal(leaf_elems,
+                                                dtype=np.float32),
+                  "bias": rng.standard_normal(8, dtype=np.float32)}}}
+
+
+@dataclasses.dataclass
+class ClientStats:
+    """Aggregated across the fleet by the harness."""
+
+    sent: int = 0
+    bytes_sent: int = 0
+    syncs_seen: int = 0
+    crashes: int = 0
+    rejoins: int = 0
+    finished: int = 0
+    errors: int = 0
+
+
+def _frame(msg: M.Message) -> bytes:
+    return M.frame_bytes(msg)
+
+
+async def _read_msg(reader: asyncio.StreamReader) -> M.Message:
+    header = await reader.readexactly(8)
+    (length,) = struct.unpack("!Q", header)
+    return M.Message.from_bytes(await reader.readexactly(length))
+
+
+async def _connect_and_register(rank: int, port: int, server_done
+                                ) -> tuple[asyncio.StreamReader,
+                                           asyncio.StreamWriter] | None:
+    """Connect with patience — a 1k-client connect storm can transiently
+    overflow the accept backlog. Returns None once the server has
+    finished (a tiny fast cohort can complete every aggregation before
+    the staggered tail ever connects; retrying a closed listener
+    forever would hang the fleet)."""
+    delay = 0.05
+    while True:
+        if server_done():
+            return None
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            break
+        except OSError:
+            await asyncio.sleep(delay)
+            delay = min(1.0, delay * 2)
+    reg = M.Message(M.MSG_TYPE_C2S_REGISTER, rank, 0)
+    # promise a persistent connection: the selector core routes every
+    # reply to this rank back on this very socket
+    reg.add(M.ARG_CONN_PERSISTENT, True)
+    writer.write(_frame(reg))
+    await writer.drain()
+    return reader, writer
+
+
+async def _run_client(rank: int, port: int, update: dict,
+                      num_samples: float, stats: ClientStats,
+                      schedule: FaultSchedule | None,
+                      version_probe, server_done, train_delay: float,
+                      start_stagger: float, report_corpse=None) -> None:
+    """One simulated client: persistent connection, real protocol, canned
+    uploads, schedule-driven churn. ``version_probe``/``server_done``
+    peek at the in-process server so a crashed client knows when its
+    rejoin round has arrived without holding a connection."""
+    if start_stagger > 0:
+        await asyncio.sleep(start_stagger)
+    conn = await _connect_and_register(rank, port, server_done)
+    if conn is None:
+        stats.finished += 1
+        return
+    reader, writer = conn
+    seq = 0
+    while True:
+        try:
+            msg = await _read_msg(reader)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            if server_done():
+                stats.finished += 1
+            else:
+                stats.errors += 1
+            return
+        if msg.msg_type == M.MSG_TYPE_S2C_FINISH:
+            stats.finished += 1
+            writer.close()
+            return
+        version = int(msg.get(M.ARG_ROUND_IDX, 0))
+        stats.syncs_seen += 1
+        if schedule is not None and schedule.crashed(version, rank):
+            # simulated SIGKILL: drop the connection, then wait out the
+            # crash window (rejoin directive) by watching the server's
+            # version advance — or leave for good
+            stats.crashes += 1
+            writer.close()
+            if report_corpse is not None:
+                # report_corpse takes the server's _rlock — run it on a
+                # worker thread so a dispatch-held lock (jit compile,
+                # drain) never freezes the event loop
+                await asyncio.get_running_loop().run_in_executor(
+                    None, report_corpse, rank)
+            while not server_done():
+                v = version_probe()
+                if not schedule.crashed(v, rank):
+                    conn = await _connect_and_register(rank, port,
+                                                       server_done)
+                    if conn is None:
+                        break  # finished while reconnecting
+                    stats.rejoins += 1
+                    reader, writer = conn
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                stats.finished += 1
+                return
+            if conn is None:
+                stats.finished += 1
+                return
+            continue
+        delay = train_delay
+        if schedule is not None:
+            delay += schedule.straggle_seconds(version, rank)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, rank, 0)
+        out.add(M.ARG_MODEL_PARAMS, update)
+        out.add(M.ARG_NUM_SAMPLES, num_samples)
+        out.add(M.ARG_ROUND_IDX, version)
+        out.add(M.ARG_UPLOAD_SEQ, seq)
+        seq += 1
+        buf = _frame(out)
+        try:
+            writer.write(buf)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            if server_done():
+                stats.finished += 1
+            else:
+                stats.errors += 1
+            return
+        stats.sent += 1
+        stats.bytes_sent += len(buf)
+
+
+class _TimedSyncServer(FedAvgServer):
+    """The round-synchronous baseline with advance timestamps, so both
+    modes report the same p99 version-advance metric."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.advance_t: list[float] = []
+
+    def _complete_round(self, n_clients, survivors=None):
+        self.advance_t.append(time.monotonic())
+        super()._complete_round(n_clients, survivors=survivors)
+
+
+def run_load(mode: str = "async", num_clients: int = 200,
+             aggregations: int = 20, buffer_k: int = 0,
+             staleness_alpha: float = 0.5, max_staleness: int = 50,
+             fault_spec: str = "", seed: int = 0,
+             train_delay: float = 0.0, leaf_elems: int = 256,
+             sync_round_deadline: float = 5.0,
+             base_port: int | None = None) -> dict:
+    """Drive ``num_clients`` simulated clients against one server and
+    return the metrics dict. ``mode="async"`` runs the buffered server
+    for ``aggregations`` aggregations of ``buffer_k`` uploads each;
+    ``mode="sync"`` runs the round-synchronous server for the number of
+    rounds that consumes a comparable upload volume."""
+    if mode not in ("async", "sync"):
+        raise ValueError(f"mode must be async|sync, got {mode!r}")
+    port = base_port if base_port is not None else free_port_block(2)
+    k = int(buffer_k) if buffer_k else num_clients
+    init = canned_update_tree(0, leaf_elems)
+    schedule = None
+    if fault_spec:
+        schedule = FaultSchedule(parse_fault_spec(fault_spec), seed)
+    # send_timeout mirrors the server's own hardening: a simulated
+    # client that stops draining must stall the dispatch thread for at
+    # most 2 s, not the 30 s default — the p99 numbers exist to measure
+    # the control plane, not one stuck peer
+    comm = SelectorCommManager(0, num_clients + 1, base_port=port,
+                               send_timeout=2.0)
+    if mode == "async":
+        server = BufferedFedAvgServer(
+            init, aggregations, num_clients, buffer_k=k,
+            staleness_alpha=staleness_alpha, max_staleness=max_staleness,
+            comm=comm)
+        rounds = aggregations
+    else:
+        rounds = max(2, (aggregations * k) // num_clients)
+        server = _TimedSyncServer(
+            init, rounds, num_clients, comm=comm,
+            round_deadline=sync_round_deadline,
+            quorum=max(1, int(num_clients * 0.6)))
+    server_thread = threading.Thread(target=server.run, daemon=True)
+
+    stats = [ClientStats() for _ in range(num_clients + 1)]
+
+    def version_probe():
+        # LOCK-FREE by design: this is polled from the asyncio loop
+        # every 20 ms by crashed clients, and taking the server's
+        # _rlock here would freeze the whole fleet whenever the
+        # dispatch thread holds it (jit compile, dial-out retries,
+        # drain). A torn int read cannot happen in CPython, and the
+        # poll only needs eventual progress, not a consistent snapshot.
+        return server.round_idx
+
+    server_done = server._done.is_set
+
+    def report_corpse(rank):
+        # stand-in for the heartbeat monitor's verdict: the harness
+        # KNOWS the schedule just killed this client, so it marks the
+        # corpse suspect directly instead of flooding the GIL-bound box
+        # with per-client beat frames. Without this, a cohort-sized
+        # buffer (buffer_k=0) plus one permanent crash can never fill —
+        # _k_eff only shrinks on suspicion. Real deployments arm
+        # --heartbeat_interval/--heartbeat_timeout for the same signal.
+        if mode == "async":
+            with server._rlock:
+                server._suspect.add(rank)
+                server._maybe_complete()
+        # the sync server's deadline/quorum path handles corpses itself
+
+    async def _fleet():
+        # ~500 connects/s ramp: enough to dodge backlog overflow, short
+        # against the measured window
+        tasks = [asyncio.create_task(_run_client(
+            r, port, canned_update_tree(r, leaf_elems), float(8 + r % 5),
+            stats[r], schedule, version_probe, server_done, train_delay,
+            start_stagger=r * 0.002, report_corpse=report_corpse))
+            for r in range(1, num_clients + 1)]
+        await asyncio.gather(*tasks)
+
+    t0 = time.monotonic()
+    server_thread.start()
+    asyncio.run(_fleet())
+    server_thread.join(timeout=60.0)
+    wall = time.monotonic() - t0
+
+    fleet = ClientStats()
+    for s in stats:
+        for f in dataclasses.fields(ClientStats):
+            setattr(fleet, f.name,
+                    getattr(fleet, f.name) + getattr(s, f.name))
+    if mode == "async":
+        adv_t = [h["t"] for h in server.history]
+        accepted = server.upload_stats["accepted"]
+        audit = server.upload_audit()
+        received = server.upload_stats["received"]
+    else:
+        adv_t = server.advance_t
+        accepted = sum(h["clients"] for h in server.history)
+        # the sync server keeps no received/drop counters: a deadline-
+        # advanced round legitimately drops late uploads as stale, so
+        # `accepted` is a LOWER bound on received, not a proxy for it —
+        # only the one-sided bound below is provable in sync mode
+        received = None
+        audit = {"received_accounted": True, "accepted_accounted": True}
+    deltas_ms = (1e3 * np.diff(np.asarray(adv_t))
+                 if len(adv_t) >= 2 else np.asarray([]))
+    result = {
+        "mode": mode,
+        "num_clients": num_clients,
+        "buffer_k": k if mode == "async" else None,
+        "staleness_alpha": staleness_alpha if mode == "async" else None,
+        "max_staleness": max_staleness if mode == "async" else None,
+        "rounds_or_aggregations": len(server.history),
+        "target": aggregations if mode == "async" else rounds,
+        "fault_spec": fault_spec,
+        "wall_s": round(wall, 3),
+        "uploads_sent": fleet.sent,
+        "uploads_accepted": accepted,
+        "uploads_per_s": round(accepted / wall, 2) if wall else 0.0,
+        "sent_per_s": round(fleet.sent / wall, 2) if wall else 0.0,
+        "aggregations_per_s": (round(len(server.history) / wall, 3)
+                               if wall else 0.0),
+        "version_advance_p50_ms": (round(float(
+            np.percentile(deltas_ms, 50)), 2) if deltas_ms.size else None),
+        "version_advance_p99_ms": (round(float(
+            np.percentile(deltas_ms, 99)), 2) if deltas_ms.size else None),
+        "peak_connections": comm.peak_connections,
+        "client_stats": dataclasses.asdict(fleet),
+        "byte_stats": comm.byte_stats(),
+        "upload_audit": audit,
+        # async: every client has at most one upload in flight when the
+        # server stops reading, so sent can exceed received by at most
+        # the fleet size — anything else is a lost or double-counted
+        # frame. Sync: the server keeps no received counter (deadline
+        # rounds drop stale uploads by design), so only accepted <= sent
+        # is provable.
+        "frames_reconciled": bool(
+            audit["received_accounted"] and audit["accepted_accounted"]
+            and (accepted <= fleet.sent if received is None
+                 else (received <= fleet.sent
+                       and fleet.sent - received <= num_clients))),
+        "staleness_hist": (_staleness_hist(server.history)
+                           if mode == "async" else None),
+    }
+    return result
+
+
+def _staleness_hist(history: list[dict]) -> dict[str, int]:
+    hist: dict[str, int] = {}
+    for h in history:
+        for tau in h.get("taus", ()):
+            hist[str(tau)] = hist.get(str(tau), 0) + 1
+    return hist
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="neuroimagedisttraining_tpu.asyncfl.loadgen",
+        description=__doc__.split("\n\n")[0])
+    ap.add_argument("--clients", type=int, default=1000)
+    ap.add_argument("--mode", choices=("async", "sync", "both"),
+                    default="both")
+    ap.add_argument("--aggregations", type=int, default=30,
+                    help="async: buffered aggregations to run; the sync "
+                         "baseline runs the round count consuming a "
+                         "comparable upload volume")
+    ap.add_argument("--buffer_k", type=int, default=50,
+                    help="aggregate every K accepted uploads (0 = "
+                         "cohort size)")
+    ap.add_argument("--staleness_alpha", type=float, default=0.5)
+    ap.add_argument("--max_staleness", type=int, default=50)
+    ap.add_argument("--fault_spec", type=str, default="",
+                    help="seeded churn, e.g. 'crash:7@3,rejoin:7@10,"
+                         "straggle:0.1:0.05'")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--train_delay", type=float, default=0.0,
+                    help="seconds each client 'trains' per round")
+    ap.add_argument("--leaf_elems", type=int, default=256)
+    ap.add_argument("--out", type=str, default="",
+                    help="write the JSON cell here (bench_matrix/"
+                         "async_bench.json)")
+    args = ap.parse_args(argv)
+
+    cells = {}
+    modes = ("async", "sync") if args.mode == "both" else (args.mode,)
+    for mode in modes:
+        cells[mode] = run_load(
+            mode=mode, num_clients=args.clients,
+            aggregations=args.aggregations, buffer_k=args.buffer_k,
+            staleness_alpha=args.staleness_alpha,
+            max_staleness=args.max_staleness,
+            fault_spec=args.fault_spec, seed=args.seed,
+            train_delay=args.train_delay, leaf_elems=args.leaf_elems)
+        print(json.dumps(cells[mode]), flush=True)
+    out = {"bench": "async_control_plane", **cells}
+    if "async" in cells and "sync" in cells:
+        a, s = cells["async"], cells["sync"]
+        out["summary"] = {
+            "uploads_per_s_ratio": (round(a["uploads_per_s"]
+                                          / s["uploads_per_s"], 2)
+                                    if s["uploads_per_s"] else None),
+            "p99_advance_ratio": (round(s["version_advance_p99_ms"]
+                                        / a["version_advance_p99_ms"], 2)
+                                  if a["version_advance_p99_ms"]
+                                  and s["version_advance_p99_ms"]
+                                  else None),
+        }
+        print(json.dumps({"summary": out["summary"]}), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+    ok = all(c["frames_reconciled"] for c in cells.values())
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,388 @@
+"""``SelectorCommManager``: one event-loop thread, thousands of sockets.
+
+The thread-per-connection socket core (distributed/comm.py) spends one OS
+thread per peer plus one short-lived connection per frame — fine for 21
+silos, impossible for a cross-device population. This manager keeps the
+exact ``BaseCommManager`` contract (length-prefixed ``Message`` frames,
+``byte_stats()`` counters, blocking dispatch via ``QueueDispatchMixin``)
+but multiplexes every socket through ONE ``selectors`` event loop:
+
+- **accept** — the listener is non-blocking; accepted connections are
+  registered for reads and live until the peer closes them. A legacy
+  ``SocketCommManager`` peer that opens a connection, writes one frame
+  and closes is served by the same path (read until EOF), so the
+  threaded client side plugs in unchanged.
+- **read** — per-connection reassembly buffer; every complete frame is
+  decoded and enqueued for the dispatch thread. A mid-frame EOF or a
+  malformed body drops that frame (logged) and never touches the loop.
+  The first frame a peer sends maps its rank to the connection (latest
+  connection wins), so replies ride the same socket back — the piece the
+  dial-out transport cannot do for peers that listen on nothing.
+- **write / backpressure** — ``send_message`` appends whole frames to a
+  BOUNDED per-connection write queue and wakes the loop via a self-pipe;
+  the loop flushes as the socket drains. A full queue blocks the sender
+  (condition wait) until the slow reader catches up or the send timeout
+  expires — bytes are never dropped and never interleaved, because the
+  loop thread is the only writer on every persistent socket.
+- **dial-out fallback** — a receiver with no live inbound connection is
+  reached the legacy way (short-lived connection to ``base_port + rank``
+  with capped exponential backoff), so this manager is a drop-in server
+  core for the existing round-synchronous protocol too.
+
+``FaultyCommManager`` wraps this manager like any other transport (it
+only decorates ``send_message`` and the observer path).
+"""
+
+from __future__ import annotations
+
+import logging
+import selectors
+import socket
+import struct
+import threading
+import time
+from collections import deque
+
+from neuroimagedisttraining_tpu.distributed.comm import (
+    BASE_PORT,
+    BaseCommManager,
+    QueueDispatchMixin,
+)
+from neuroimagedisttraining_tpu.distributed.message import (
+    ARG_CONN_PERSISTENT,
+    Message,
+    frame_bytes,
+)
+
+log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
+
+#: refuse absurd length prefixes (a peer speaking another protocol would
+#: otherwise make the loop wait forever for terabytes that never come)
+_MAX_FRAME = 1 << 32
+
+
+class _Conn:
+    """Per-connection state owned by the loop thread; the write queue and
+    ``open`` flag are shared with senders under the manager's lock."""
+
+    __slots__ = ("sock", "rbuf", "wq", "wq_frames", "rank", "open",
+                 "want_write")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rbuf = bytearray()
+        #: deque of (memoryview, original frame length); the head may be
+        #: a partially-written tail of its frame — kept as a memoryview
+        #: so re-queuing the remainder after a partial send is zero-copy
+        #: (re-slicing bytes would memcpy O(frame^2/sndbuf) per large
+        #: frame to a slow reader, on the one thread every socket shares)
+        self.wq: deque[tuple[memoryview, int]] = deque()
+        self.wq_frames = 0
+        self.rank: int | None = None
+        self.open = True
+        self.want_write = False
+
+
+class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
+    """Selector-multiplexed manager for one rank (normally the server,
+    rank 0). API-compatible with ``SocketCommManager`` including the
+    retry keywords on ``send_message``, so every caller in
+    ``cross_silo.py`` works unchanged."""
+
+    def __init__(self, rank: int, world_size: int,
+                 host_map: dict[int, str] | None = None,
+                 base_port: int = BASE_PORT,
+                 max_pending_frames: int = 64,
+                 send_timeout: float = 30.0):
+        self.rank = rank
+        self.world_size = world_size
+        self.base_port = base_port
+        self.host_map = host_map or {}
+        self.max_pending_frames = int(max_pending_frames)
+        self.send_timeout = float(send_timeout)
+        self._init_dispatch()
+        #: guards _conns/_by_rank/every write queue; doubles as the
+        #: backpressure condition senders wait on
+        self._send_lock = threading.Condition()
+        self._conns: dict[socket.socket, _Conn] = {}
+        self._by_rank: dict[int, _Conn] = {}
+        self.peak_connections = 0
+        self._running = True
+        self._sel = selectors.DefaultSelector()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", base_port + rank))
+        self._server.listen(1024)
+        self._server.setblocking(False)
+        self._sel.register(self._server, selectors.EVENT_READ, "accept")
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._loop_thread = threading.Thread(target=self._loop,
+                                             daemon=True)
+        self._loop_thread.start()
+
+    # ---- event loop (the only thread that touches the selector or
+    # writes on persistent sockets) ----
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                events = self._sel.select(timeout=0.5)
+            except OSError:
+                return  # selector closed during shutdown
+            for key, mask in events:
+                if key.data == "accept":
+                    self._accept_ready()
+                elif key.data == "wake":
+                    self._drain_wake()
+                else:
+                    conn: _Conn = key.data
+                    if mask & selectors.EVENT_WRITE:
+                        self._flush(conn)
+                    if mask & selectors.EVENT_READ and conn.open:
+                        self._read_ready(conn)
+
+    def _accept_ready(self) -> None:
+        while True:
+            try:
+                sock, _ = self._server.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            conn = _Conn(sock)
+            with self._send_lock:
+                self._conns[sock] = conn
+                self.peak_connections = max(self.peak_connections,
+                                            len(self._conns))
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+        # senders queued frames since the last pass: express write
+        # interest for every connection with pending bytes
+        with self._send_lock:
+            pending = [c for c in self._conns.values()
+                       if c.wq and not c.want_write and c.open]
+            for c in pending:
+                c.want_write = True
+        for c in pending:
+            try:
+                self._sel.modify(c.sock, selectors.EVENT_READ
+                                 | selectors.EVENT_WRITE, c)
+            except (KeyError, ValueError, OSError):
+                pass  # closed between the lock and here
+
+    def _read_ready(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(1 << 16)
+        except BlockingIOError:
+            return
+        except OSError as e:
+            self._close(conn, f"read error: {e}")
+            return
+        if not data:
+            why = (f"EOF mid-frame ({len(conn.rbuf)} buffered bytes "
+                   "dropped)" if conn.rbuf else "peer closed")
+            self._close(conn, why)
+            return
+        conn.rbuf += data
+        while True:
+            if len(conn.rbuf) < 8:
+                return
+            (length,) = struct.unpack("!Q", bytes(conn.rbuf[:8]))
+            if length > _MAX_FRAME:
+                self._close(conn, f"insane frame length {length}")
+                return
+            if len(conn.rbuf) < 8 + length:
+                return
+            raw = bytes(conn.rbuf[8:8 + length])
+            del conn.rbuf[:8 + length]
+            try:
+                msg = Message.from_bytes(raw)
+            except Exception as e:  # noqa: BLE001 — any malformed body
+                # (magic mismatch, msgpack OutOfData, schema drift) is a
+                # dropped frame, never a dead event loop
+                log.warning("rank %d: dropped malformed frame: %s",
+                            self.rank, e)
+                continue
+            self._count_recv(length + 8)
+            with self._send_lock:
+                conn.rank = msg.sender_id
+                if msg.get(ARG_CONN_PERSISTENT):
+                    # the peer promises to keep this connection open:
+                    # replies to its rank ride it back (latest wins —
+                    # a rejoined client's fresh connection supersedes
+                    # its corpse). Legacy one-frame-per-connection
+                    # peers never set the flag and are reached by
+                    # dial-out instead.
+                    self._by_rank[msg.sender_id] = conn
+            self._enqueue(msg)
+
+    def _flush(self, conn: _Conn) -> None:
+        with self._send_lock:
+            while conn.wq:
+                buf, frame_len = conn.wq[0]
+                try:
+                    n = conn.sock.send(buf)
+                except BlockingIOError:
+                    break
+                except OSError as e:
+                    self._close_locked(conn, f"write error: {e}")
+                    self._sel_unregister(conn)
+                    return
+                if n < len(buf):
+                    conn.wq[0] = (buf[n:], frame_len)
+                    break
+                conn.wq.popleft()
+                conn.wq_frames -= 1
+                self._count_sent(frame_len)
+            drained = not conn.wq
+            if drained:
+                conn.want_write = False
+            self._send_lock.notify_all()  # backpressure release
+        if drained:
+            try:
+                self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _close_locked(self, conn: _Conn, why: str) -> None:
+        """Under ``_send_lock``: drop a connection's shared state and
+        wake any sender blocked on its queue."""
+        if not conn.open:
+            return
+        conn.open = False
+        self._conns.pop(conn.sock, None)  # nidt: allow[lock-shared-map] -- every caller holds _send_lock (method contract in the docstring); the lock cannot be re-taken here without deadlocking
+        if conn.rank is not None and \
+                self._by_rank.get(conn.rank) is conn:
+            self._by_rank.pop(conn.rank, None)
+        if conn.wq_frames and self._running:
+            log.warning("rank %d: closing conn to rank %s with %d "
+                        "unflushed frames (%s)", self.rank, conn.rank,
+                        conn.wq_frames, why)
+        else:
+            log.debug("rank %d: conn to rank %s closed (%s)", self.rank,
+                      conn.rank, why)
+        self._send_lock.notify_all()
+
+    def _sel_unregister(self, conn: _Conn) -> None:
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _close(self, conn: _Conn, why: str) -> None:
+        with self._send_lock:
+            self._close_locked(conn, why)
+        self._sel_unregister(conn)
+
+    # ---- send side (any thread) ----
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\0")  # nidt: allow[lock-send] -- 1-byte self-pipe nudge; the pipe has exactly one writer semantic-free byte stream
+        except (BlockingIOError, OSError):
+            pass  # pipe full: the loop is already scheduled to wake
+
+    def send_message(self, msg: Message, retries: int = 7,
+                     retry_delay: float = 0.1,
+                     max_delay: float = 2.0) -> None:
+        """Route one frame. A live inbound connection from the receiver
+        carries it back (bounded queue, blocking backpressure); otherwise
+        fall back to the legacy dial-out (same retry semantics as
+        ``SocketCommManager.send_message``, so round-synchronous callers
+        and their error handling work unchanged)."""
+        frame = frame_bytes(msg)
+        deadline = None
+        with self._send_lock:
+            conn = self._by_rank.get(msg.receiver_id)
+            while (conn is not None and conn.open and self._running
+                   and conn.wq_frames >= self.max_pending_frames):
+                if deadline is None:
+                    deadline = time.monotonic() + self.send_timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ConnectionError(
+                        f"rank {self.rank}: send to rank "
+                        f"{msg.receiver_id} timed out after "
+                        f"{self.send_timeout}s of backpressure "
+                        f"({conn.wq_frames} frames pending)")
+                self._send_lock.wait(min(remaining, 0.5))
+                conn = self._by_rank.get(msg.receiver_id)
+            if conn is not None and conn.open and self._running:
+                conn.wq.append((memoryview(frame), len(frame)))
+                conn.wq_frames += 1
+                self._wake()
+                return
+        self._dial_out(msg, frame, retries, retry_delay, max_delay)
+
+    def _dial_out(self, msg: Message, frame: bytes, retries: int,
+                  retry_delay: float, max_delay: float) -> None:
+        host = self.host_map.get(msg.receiver_id, "127.0.0.1")
+        addr = (host, self.base_port + msg.receiver_id)
+        last_err: Exception | None = None
+        for attempt in range(retries):
+            try:
+                with socket.create_connection(addr, timeout=10.0) as conn:
+                    conn.sendall(frame)  # nidt: allow[lock-send] -- fresh per-frame connection local to this call; no concurrent writer exists
+                self._count_sent(len(frame))
+                return
+            except OSError as e:
+                last_err = e
+                if attempt + 1 < retries:
+                    time.sleep(min(max_delay,
+                                   retry_delay * (2.0 ** attempt)))
+        raise ConnectionError(
+            f"rank {self.rank} could not reach rank {msg.receiver_id} "
+            f"at {addr} (no live inbound connection either): {last_err}")
+
+    # ---- lifecycle ----
+
+    def connection_count(self) -> int:
+        with self._send_lock:
+            return len(self._conns)
+
+    def drain_sends(self, timeout: float = 5.0) -> bool:
+        """Block until every persistent write queue has flushed (or
+        ``timeout``). Callers about to stop the manager use this so a
+        just-broadcast frame (e.g. FINISH to a thousand clients) is not
+        torn out of the queues by the shutdown."""
+        deadline = time.monotonic() + timeout
+        with self._send_lock:
+            while any(c.wq for c in self._conns.values()):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._send_lock.wait(min(remaining, 0.2))
+        return True
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._wake()
+        self._loop_thread.join(timeout=5.0)
+        with self._send_lock:
+            conns = list(self._conns.values())
+            for c in conns:
+                self._close_locked(c, "manager stopped")
+        for c in conns:
+            self._sel_unregister(c)
+        for s in (self._server, self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        self._stop_dispatch()

@@ -1,0 +1,524 @@
+"""``BufferedFedAvgServer``: FedBuff-style asynchronous aggregation.
+
+Nguyen et al., "Federated Learning with Buffered Asynchronous
+Aggregation" (AISTATS 2022): the server never waits for a cohort — it
+accepts uploads continuously into a bounded buffer and aggregates every
+K arrivals, down-weighting stale contributions. This is the high-traffic
+limit of the FedProx premise (PAPERS.md: progress from whatever subset
+reports) and ROADMAP item 3.
+
+How it composes with everything already landed:
+
+- **Version tags** reuse the PR 2 round-tag plumbing verbatim: the model
+  VERSION (number of aggregations so far) rides ``ARG_ROUND_IDX`` on
+  every sync, and clients echo it on upload — ``FedAvgClientProc`` and
+  the ``FaultyCommManager`` chaos wrapper work unchanged. Staleness of
+  an upload is ``tau = current_version - echoed_version``.
+- **Codec reference threading** (PR 3) stays correct against the
+  client's ACTUAL base version: the server keeps a ring of the last
+  ``max_staleness + 1`` param trees and decodes each upload's delta
+  frame against the very tree it broadcast under that version tag — a
+  stale delta decoded against the current model would silently corrupt
+  the update, which is why ``max_staleness`` also bounds the ring.
+- **Aggregation** dispatches through the SAME jitted programs the
+  synchronous server uses: ``survivor_weighted_mean``
+  (``tree_weighted_mean``) when undefended, ``survivor_defended_mean``
+  (``robust.aggregate_with_defense``) when a ``--defense`` is armed —
+  over "effective uploads" ``u + (params_now - params_base)``
+  (delta-transported to the current base). A zero-staleness upload skips
+  the transport entirely, so a buffer of all-current uploads with
+  ``buffer_k == cohort`` reproduces one synchronous round BITWISE
+  (pinned in tests/test_asyncfl.py).
+- **Weights**: ``staleness_weight(n, tau, alpha) = n * (1+tau)^-alpha``
+  — FedBuff's polynomial staleness discount on the FedAvg sample-count
+  weight. ``tau == 0`` gives exactly ``n`` (the equivalence pin's
+  precondition); ``tau > max_staleness`` never reaches the weight: the
+  upload is dropped at accept time with a logged reason.
+- **Quarantine / strikes / heartbeats / EF reset** are inherited from
+  ``FedAvgServer``: outlier scoring runs per aggregation over the
+  buffer's delta-transported trees against the current params (the same
+  ``update_outlier_flags`` leave-one-out geometry), and a released
+  silo's first sync carries ``ARG_EF_RESET`` exactly as in the
+  synchronous plane.
+
+What does NOT compose (rejected at STARTUP, like the secure/codec
+rejection): secure aggregation — its two-phase weight exchange is a
+round barrier by construction (every client's normalized weight depends
+on every other reporter), which is the one thing an asynchronous buffer
+cannot provide; ``distributed/run.py`` refuses ``--secure
+--async_server``. Round deadlines/quorum are meaningless without a round
+barrier and are refused too.
+
+Protocol (no barrier anywhere):
+
+- register -> the server immediately replies with the current
+  version-tagged model (first contact gets ``INIT_CONFIG``, a
+  re-register gets ``SYNC_MODEL`` — the late-rejoin path, verbatim).
+- upload -> accept/drop, maybe aggregate, then reply with the CURRENT
+  model so the sender trains again at once. Every client is therefore
+  always either training or has one upload in flight; fast clients
+  simply lap slow ones, whose uploads arrive stale and down-weighted.
+- after ``comm_round`` aggregations the server broadcasts FINISH to
+  every rank that ever registered.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from neuroimagedisttraining_tpu.asyncfl.loop import SelectorCommManager
+from neuroimagedisttraining_tpu.codec import wire as codec
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.distributed.comm import BASE_PORT
+from neuroimagedisttraining_tpu.distributed.cross_silo import (
+    FedAvgServer,
+    survivor_defended_mean,
+    survivor_weighted_mean,
+    tree_all_finite,
+)
+
+log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
+
+
+def staleness_weight(n: float, tau: int, alpha: float) -> float:
+    """FedBuff polynomial staleness weight on the FedAvg sample count:
+    ``n * (1 + tau)^-alpha``, float64 host math so ``tau == 0`` returns
+    ``n`` EXACTLY (the equivalence pin depends on it) and the host
+    replay in tests reproduces the server's weights bitwise."""
+    return float(n) * (1.0 + float(int(tau))) ** (-float(alpha))
+
+
+class BufferedFedAvgServer(FedAvgServer):
+    """Rank 0 of the asynchronous control plane. See the module
+    docstring for the protocol; knobs:
+
+    - ``buffer_k`` — aggregate every K accepted uploads (0 = cohort
+      size, which with zero staleness reproduces the synchronous
+      server). Since every sender holds at most ONE buffer slot, the
+      effective trigger threshold shrinks below K when clients are
+      known to be gone (heartbeat-suspect, quarantined) — see
+      ``_k_eff``; a full cohort-sized buffer would otherwise deadlock
+      on the first permanent crash.
+    - ``staleness_alpha`` — polynomial staleness exponent (0 disables
+      down-weighting; FedBuff's default regime is ~0.5).
+    - ``max_staleness`` — hard admission bound: an upload based on a
+      version more than this many aggregations old is DROPPED with a
+      logged reason (and its sender immediately re-synced), and the
+      param ring that backs codec delta decoding holds exactly this
+      many historical versions.
+    """
+
+    def __init__(self, init_params, comm_round: int, num_clients: int,
+                 buffer_k: int = 0, staleness_alpha: float = 0.5,
+                 max_staleness: int = 20, world_size: int | None = None,
+                 host_map: dict[int, str] | None = None,
+                 base_port: int | None = None, comm=None, **kw):
+        from neuroimagedisttraining_tpu.core import robust
+
+        # --- async knobs fail loudly HERE (startup), never mid-run ---
+        self.buffer_k = int(buffer_k) if buffer_k else int(num_clients)
+        if self.buffer_k < 1:
+            raise ValueError(f"buffer_k must be >= 1, got {buffer_k}")
+        if staleness_alpha < 0:
+            raise ValueError(
+                f"staleness_alpha must be >= 0, got {staleness_alpha}")
+        if max_staleness < 0:
+            raise ValueError(
+                f"max_staleness must be >= 0, got {max_staleness}")
+        self.staleness_alpha = float(staleness_alpha)
+        self.max_staleness = int(max_staleness)
+        if kw.get("round_deadline", 0) or kw.get("quorum", 0):
+            raise ValueError(
+                "BufferedFedAvgServer has no round barrier: "
+                "round_deadline/quorum do not apply (uploads aggregate "
+                "every buffer_k arrivals instead)")
+        if comm is None:
+            # replies run on the single dispatch thread under _rlock: a
+            # peer that uploads but stops READING would otherwise stall
+            # the whole control plane for send_timeout per reply once
+            # its bounded write queue fills. 2 s bounds the stall; the
+            # timeout surfaces as ConnectionError, _send_tolerant marks
+            # the peer suspect, and the federation moves on.
+            comm = SelectorCommManager(
+                0, world_size or num_clients + 1, host_map=host_map,
+                base_port=BASE_PORT if base_port is None else base_port,
+                send_timeout=2.0)
+        super().__init__(init_params, comm_round, num_clients,
+                         world_size=world_size, comm=comm, **kw)
+        # the aggregation cohort is the BUFFER, not the client count —
+        # but one slot per sender also caps it at the cohort size, so
+        # an order-statistic defense must be feasible over
+        # min(buffer_k, num_clients) uploads or it would fall back on
+        # every single aggregation (checking bare buffer_k would let
+        # buffer_k > cohort silently disarm the defense for the run)
+        if self.defense in robust.ROBUST_AGGREGATORS:
+            robust._check_f(min(self.buffer_k, int(num_clients)),
+                            self.byz_f, self.defense)
+        #: there is no registration barrier: the federation is "started"
+        #: from the first moment, which is also what lets the inherited
+        #: heartbeat monitor invoke ``_maybe_complete`` when a new
+        #: suspect lowers ``_k_eff`` below the buffer occupancy
+        self._started = True
+        #: version ring: version -> broadcast params (numpy), the delta
+        #: reference for codec frames tagged with that version
+        self._ring: dict[int, dict] = {0: self.params}
+        #: accepted-but-not-yet-aggregated uploads, arrival order
+        self._buffer: list[dict] = []
+        #: sender -> highest ARG_UPLOAD_SEQ accepted (watermark dedup:
+        #: a re-delivered frame repeats its seq and is dropped, while an
+        #: honest repeat contribution from an unchanged base version
+        #: ships a fresh seq and is accepted; reset when the sender
+        #: re-registers, since a restarted process restarts its counter)
+        self._seq_seen: dict[int, int] = {}
+        #: sender -> base versions already accepted, the dedup fallback
+        #: for legacy senders that ship no seq: at most one contribution
+        #: per sync version (exactly what the sync protocol produces)
+        self._contributed: dict[int, set[int]] = {}
+        #: every _on_model increments ``received`` and then EXACTLY ONE
+        #: other counter — the frame-accounting audit the load harness
+        #: reconciles (upload_audit)
+        self.upload_stats = {
+            "received": 0, "accepted": 0, "dropped_stale": 0,
+            "dropped_duplicate": 0, "dropped_future": 0,
+            "dropped_quarantined": 0, "dropped_undecodable": 0,
+            "dropped_nonfinite": 0, "dropped_after_done": 0,
+            # frame decoded as a Message but its fields are broken
+            # (missing num_samples, non-numeric tags): a buggy client
+            # among thousands must never kill the dispatch thread
+            "dropped_malformed": 0,
+            # accepted into the buffer, then discarded because THIS
+            # aggregation's outlier scoring quarantined the sender
+            "quarantine_discarded": 0,
+            # accepted, then replaced by a NEWER accepted upload from
+            # the same sender before the buffer filled (one slot per
+            # sender per aggregation — see _accept_async)
+            "superseded_in_buffer": 0,
+        }
+
+    # the async server must NEVER crash its dispatch thread because one
+    # of thousands of clients vanished mid-reply: always send tolerantly
+    @property
+    def fault_tolerant(self) -> bool:
+        return True
+
+    @property
+    def version(self) -> int:
+        """Model version == number of aggregations so far. It IS
+        ``round_idx`` — the alias the round-tag plumbing generalizes
+        through."""
+        return self.round_idx
+
+    def current_version(self) -> int:
+        with self._rlock:
+            return self.round_idx
+
+    # ---- handlers (dispatch thread) ----
+
+    def _on_register(self, msg: M.Message) -> None:
+        """No registration barrier: first contact is answered with the
+        current version-tagged model immediately — a cross-device cohort
+        trickles in over hours and the federation must already be
+        making progress."""
+        with self._rlock:
+            c = msg.sender_id
+            if self._done.is_set():
+                self._send_tolerant(M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
+                return
+            first = c not in self._registered
+            self._registered.add(c)
+            self._suspect.discard(c)
+            # restarted process: fresh seq counter, fresh legacy
+            # per-version dedup marks (its pre-restart contribution was
+            # a different process's training)
+            self._seq_seen.pop(c, None)
+            self._contributed.pop(c, None)
+            self._last_beat[c] = time.monotonic()
+            if not first:
+                log.info("server: client %d re-registered; shipping "
+                         "version %d state", c, self.round_idx)
+            self._send_sync_to(M.MSG_TYPE_S2C_INIT_CONFIG if first
+                               else M.MSG_TYPE_S2C_SYNC_MODEL, c)
+
+    def _on_model(self, msg: M.Message) -> None:
+        with self._rlock:
+            self.upload_stats["received"] += 1
+            if self._done.is_set():
+                self.upload_stats["dropped_after_done"] += 1
+                return
+            c = msg.sender_id
+            self._last_beat[c] = time.monotonic()
+            self._suspect.discard(c)
+            try:
+                ok = self._accept_async(msg)
+            except Exception as e:  # noqa: BLE001 — a frame with broken
+                # FIELDS (missing num_samples, non-numeric version/seq
+                # from a version-skewed client) is a dropped upload, not
+                # a dead dispatch thread — the same contract the decode
+                # guard keeps for broken BODIES
+                self.upload_stats["dropped_malformed"] += 1
+                log.warning("server: dropping malformed upload from %d "
+                            "(%s: %s)", c, type(e).__name__, e)
+                ok = False
+            if ok:
+                self.upload_stats["accepted"] += 1
+                if len(self._buffer) >= self._k_eff():
+                    self._aggregate_buffer()
+            if not self._done.is_set():
+                # accepted or dropped, the sender gets the CURRENT model
+                # so it immediately trains at the freshest version —
+                # liveness never depends on the verdict
+                self._send_sync_to(M.MSG_TYPE_S2C_SYNC_MODEL, c)
+
+    def _accept_async(self, msg: M.Message) -> bool:
+        """Under ``_rlock``: admission control. Returns True iff the
+        upload entered the buffer; every rejection increments exactly
+        one ``upload_stats`` counter and logs its reason."""
+        c = msg.sender_id
+        tag = msg.get(M.ARG_ROUND_IDX)
+        v = self.round_idx if tag is None else int(tag)
+        tau = self.round_idx - v
+        if tau < 0:
+            self.upload_stats["dropped_future"] += 1
+            log.warning("server: dropping upload from %d tagged with "
+                        "FUTURE version %d (current %d)", c, v,
+                        self.round_idx)
+            return False
+        if tau > self.max_staleness:
+            self.upload_stats["dropped_stale"] += 1
+            log.warning("server: dropping ancient upload from %d "
+                        "(base version %d, current %d, staleness %d > "
+                        "max_staleness %d)", c, v, self.round_idx, tau,
+                        self.max_staleness)
+            return False
+        seq = msg.get(M.ARG_UPLOAD_SEQ)
+        if seq is not None:
+            if int(seq) <= self._seq_seen.get(c, -1):
+                self.upload_stats["dropped_duplicate"] += 1
+                log.warning("server: dropping re-delivered upload from "
+                            "%d (seq %s <= watermark %d)", c, seq,
+                            self._seq_seen[c])
+                return False
+            # advance the watermark NOW, not on acceptance: the verdict
+            # rendered below (accept OR reject) is final for this seq,
+            # and a transport re-delivery must repeat the VERDICT
+            # (duplicate-drop), never the processing — a duplicated
+            # transient-NaN frame re-processed here would strike its
+            # sender twice and could quarantine an honest silo
+            self._seq_seen[c] = int(seq)
+        elif v in self._contributed.get(c, ()):
+            self.upload_stats["dropped_duplicate"] += 1
+            log.warning("server: dropping duplicate upload from %d for "
+                        "base version %d (sender ships no upload_seq)",
+                        c, v)
+            return False
+        if c in self._quarantined_now():
+            self.upload_stats["dropped_quarantined"] += 1
+            log.warning("server: dropping upload from QUARANTINED silo "
+                        "%d (version %d; window ends at version %d)",
+                        c, self.round_idx, self._quarantine_until[c])
+            return False
+        ref = self._ring[v]  # present by construction: tau <= ring span
+        try:
+            decoded = codec.decode_update(msg.get(M.ARG_MODEL_PARAMS),
+                                          like=self.params,
+                                          reference=ref,
+                                          masks=self.wire_masks)
+        except Exception as e:  # noqa: BLE001 — an undecodable frame is
+            # a dropped upload, never a dead dispatch thread (same
+            # contract as the synchronous server's _on_model)
+            self.upload_stats["dropped_undecodable"] += 1
+            log.warning("server: dropping undecodable upload from %d "
+                        "(base version %d): %s", c, v, e)
+            return False
+        if not tree_all_finite(decoded):
+            self.upload_stats["dropped_nonfinite"] += 1
+            self.byz_stats["nonfinite_rejected"] += 1
+            log.warning("server: REJECTING non-finite upload from silo "
+                        "%d (base version %d)", c, v)
+            if self.quarantine_rounds > 0:
+                self._strike(c, "non-finite upload")
+            if seq is None:
+                # legacy senders dedup by version: mark it so a
+                # re-delivered copy of this rejected frame cannot
+                # strike twice either
+                self._contributed.setdefault(c, set()).add(v)
+            return False
+        n = float(msg.get(M.ARG_NUM_SAMPLES))
+        if tau == 0:
+            u_eff = decoded  # bitwise passthrough (the equivalence pin)
+        else:
+            # delta-transport the stale model to the current base:
+            # u + (params_now - params_base). The client's LEARNING
+            # (u - base) is preserved exactly; what changes is the
+            # anchor it applies to.
+            import jax
+
+            u_eff = jax.tree.map(
+                lambda u, p, r: (np.asarray(u, np.float32)
+                                 + (np.asarray(p, np.float32)
+                                    - np.asarray(r, np.float32))
+                                 ).astype(np.asarray(u).dtype),
+                decoded, self.params, ref)
+        if seq is None:  # the watermark already advanced at the gate
+            self._contributed.setdefault(c, set()).add(v)
+        # ONE buffer slot per sender: a client that laps the buffer
+        # (trains faster than it fills) REPLACES its older entry rather
+        # than occupying extra slots. This is what keeps the armed
+        # defense's threat model sound — robust._check_f(buffer_k,
+        # byz_f) bounds Byzantine ENTRIES, and without the cap a fast
+        # sign-flipping client could fill f+1 slots by pace alone — and
+        # it keeps the aggregation weighting unbiased toward fast
+        # clients (FedBuff's one-contribution-per-client shape).
+        for i, e in enumerate(self._buffer):
+            if e["client"] == c:
+                del self._buffer[i]
+                self.upload_stats["superseded_in_buffer"] += 1
+                log.info("server: upload from %d supersedes its own "
+                         "buffered entry (tau %d -> %d)", c,
+                         e["tau"], tau)
+                break
+        self._buffer.append({
+            "client": c, "tree": u_eff, "n": n, "tau": tau,
+            "weight": staleness_weight(n, tau, self.staleness_alpha)})
+        return True
+
+    # ---- aggregation ----
+
+    def _aggregate_buffer(self) -> None:
+        """Under ``_rlock``: one FedBuff aggregation over the buffered
+        uploads — outlier scoring first (a silo quarantined by THIS
+        buffer is excluded from this very aggregation, mirroring the
+        synchronous server), then the same jitted defended/weighted-mean
+        dispatch, then the version advances and the ring/buffer/history
+        roll forward."""
+        from neuroimagedisttraining_tpu.core import robust
+
+        # aggregate in CLIENT-ID order, not arrival order: float
+        # reduction order must not depend on OS scheduling, so two runs
+        # over the same upload set produce the same model bitwise — the
+        # exact reason the synchronous server sorts its senders
+        entries = sorted(self._buffer, key=lambda e: e["client"])
+        senders = [e["client"] for e in entries]
+        trees = [e["tree"] for e in entries]
+        self._score_survivors(senders, trees)
+        q = self._quarantined_now()
+        if q & set(senders):
+            kept = [e for e in entries if e["client"] not in q]
+            self.upload_stats["quarantine_discarded"] += (len(entries)
+                                                          - len(kept))
+            entries = kept
+        if not entries:
+            # every buffered upload came from silos quarantined by this
+            # very scoring pass: nothing trustworthy to aggregate —
+            # keep the model, refill the buffer
+            log.warning("server: buffer emptied by quarantine at "
+                        "version %d - skipping aggregation", self.round_idx)
+            self._buffer = []
+            return
+        trees = [e["tree"] for e in entries]
+        ws = [e["weight"] for e in entries]
+        senders = [e["client"] for e in entries]
+        defense = robust.effective_defense(
+            self.defense, len(entries), self.byz_f, warn=log.warning)
+        if defense == "none":
+            self.params = survivor_weighted_mean(trees, ws)
+        else:
+            rngs = None
+            if defense == "weak_dp":
+                import jax
+                import jax.numpy as jnp
+
+                base = jax.random.fold_in(
+                    jax.random.key(self.defense_seed), self.round_idx)
+                rngs = jax.vmap(
+                    lambda s: jax.random.fold_in(base, s))(
+                    jnp.asarray(senders, jnp.uint32))
+            self.params = survivor_defended_mean(
+                trees, ws, self.params, defense=defense,
+                byz_f=self.byz_f, geomed_iters=self.geomed_iters,
+                norm_bound=self.norm_bound, stddev=self.stddev,
+                rngs=rngs)
+        self._buffer = []
+        self.round_idx += 1
+        self._ring[self.round_idx] = self.params
+        floor = self.round_idx - self.max_staleness
+        for old in [k for k in self._ring if k < floor]:
+            del self._ring[old]
+        for c, seen in self._contributed.items():
+            # versions below the ring can only be stale-dropped now;
+            # keeping their dedup marks would grow without bound
+            self._contributed[c] = {v for v in seen if v >= floor}
+        self.history.append({
+            "version": self.round_idx, "clients": len(senders),
+            "contributors": senders,
+            "taus": [int(e["tau"]) for e in entries],
+            "weights": [float(e["weight"]) for e in entries],
+            "t": time.monotonic()})
+        if self.round_idx >= self.comm_round:
+            self._broadcast_finish()
+            self._done.set()
+            # let the selector flush the queued FINISH frames before the
+            # shutdown tears the write queues down under them
+            drain = getattr(self.com_manager, "drain_sends", None)
+            if drain is not None:
+                drain(5.0)
+            self.finish()
+
+    def _k_eff(self) -> int:
+        """Under ``_rlock``: the occupancy threshold that actually
+        triggers aggregation. With one buffer slot per sender, a buffer
+        can never hold more DISTINCT contributors than the cohort has
+        live members — so clients known to be incapable of contributing
+        (heartbeat-suspect corpses, quarantined silos) shrink the
+        threshold below ``buffer_k`` instead of deadlocking the
+        federation waiting for uploads that can never come. Without a
+        liveness signal (heartbeats off) a silent corpse is
+        indistinguishable from a slow client, exactly like the
+        synchronous server without a deadline — arm heartbeats for
+        crash tolerance."""
+        gone = len(self._suspect | self._quarantined_now())
+        return max(1, min(self.buffer_k, self.num_clients - gone))
+
+    def _maybe_complete(self) -> None:
+        """No round barrier to complete — but the inherited heartbeat
+        monitor calls this when suspicion changes, and a NEW suspect may
+        have just lowered ``_k_eff`` below the current buffer occupancy
+        (the buffered uploads would otherwise wait for a corpse)."""
+        if self._done.is_set() or not self._buffer:
+            return
+        if len(self._buffer) >= self._k_eff():
+            self._aggregate_buffer()
+
+    def _broadcast_finish(self) -> None:
+        # only ranks that ever registered expect a FINISH; iterating the
+        # full 1..num_clients range would dial thousands of never-seen
+        # addresses
+        for c in sorted(self._registered):
+            self._send_tolerant(M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
+
+    # ---- audits (the load harness reconciles these) ----
+
+    def upload_audit(self) -> dict:
+        """Frame accounting: every received upload is accounted exactly
+        once, and every accepted upload is either in a recorded
+        aggregation or still buffered — zero lost, zero double-counted."""
+        with self._rlock:
+            s = dict(self.upload_stats)
+            dropped = sum(v for k, v in s.items()
+                          if k.startswith("dropped_"))
+            aggregated = sum(h["clients"] for h in self.history
+                             if "version" in h)
+            return {
+                **s,
+                "aggregated": aggregated,
+                "buffered": len(self._buffer),
+                "received_accounted":
+                    s["received"] == s["accepted"] + dropped,
+                "accepted_accounted":
+                    s["accepted"] == (aggregated + len(self._buffer)
+                                      + s["quarantine_discarded"]
+                                      + s["superseded_in_buffer"]),
+            }

@@ -139,6 +139,18 @@ class FedConfig:
     wire_topk_ratio: float = 0.25  # top-k keep fraction for dense engines
     round_deadline: float = 0.0    # s; >0 arms the cross-silo per-round deadline
     quorum: int = 0                # min uploads to aggregate at deadline; 0 = all
+    # Async buffered control plane (ISSUE 7, asyncfl/): the cross-silo
+    # server becomes a FedBuff-style buffered aggregator — uploads
+    # accepted continuously, aggregated every buffer_k arrivals with
+    # polynomial staleness weighting (1 + tau)^-staleness_alpha, and
+    # uploads staler than max_staleness versions dropped at admission.
+    # The simulated in-process engines stay round-synchronous (the
+    # buffer is a control-plane construct); these fields mirror
+    # distributed/run.py's flags like round_deadline/quorum do.
+    async_server: bool = False
+    buffer_k: int = 0              # aggregate every K uploads; 0 = cohort size
+    staleness_alpha: float = 0.5   # FedBuff polynomial staleness exponent
+    max_staleness: int = 20        # admission bound (and codec-ref ring depth)
     heartbeat_interval: float = 0.0  # s; >0 makes silo clients beat liveness
     heartbeat_timeout: float = 0.0   # s; >0 marks silent clients suspect
     # Fused multi-round dispatch (ISSUE 4): when > 1 and the federation

@@ -209,15 +209,19 @@ class SocketCommManager(QueueDispatchMixin, BaseCommManager):
         ``retries``."""
         import time
 
-        raw = msg.to_bytes()
+        from neuroimagedisttraining_tpu.distributed.message import (
+            frame_bytes,
+        )
+
+        frame = frame_bytes(msg)
         addr = (self.host_map[msg.receiver_id],
                 self.base_port + msg.receiver_id)
         last_err: Exception | None = None
         for attempt in range(retries):  # receiver may not be listening yet
             try:
                 with socket.create_connection(addr, timeout=10.0) as conn:
-                    conn.sendall(struct.pack("!Q", len(raw)) + raw)  # nidt: allow[lock-send] -- conn is a fresh per-frame connection local to this call; no concurrent writer exists
-                self._count_sent(len(raw) + 8)
+                    conn.sendall(frame)  # nidt: allow[lock-send] -- conn is a fresh per-frame connection local to this call; no concurrent writer exists
+                self._count_sent(len(frame))
                 return
             except OSError as e:
                 last_err = e

@@ -1067,6 +1067,13 @@ class FedAvgClientProc(ClientManager):
     engines vmap over their client axis, keyed by (seed, round, rank),
     so one seed produces one attack trace in both federations."""
 
+    #: monotone upload counter (ARG_UPLOAD_SEQ, class-level default so
+    #: partially-constructed test doubles inherit it): lets the async
+    #: buffered server (asyncfl/) distinguish a transport-duplicated
+    #: frame from an honest repeat contribution; the sync server
+    #: ignores it (round-tag dedup)
+    _upload_seq = 0
+
     def __init__(self, rank: int, num_clients: int,
                  train_fn: Callable, world_size: int | None = None,
                  heartbeat_interval: float = 0.0, wire_codec: str = "none",
@@ -1166,6 +1173,8 @@ class FedAvgClientProc(ClientManager):
         out.add(M.ARG_MODEL_PARAMS, payload)
         out.add(M.ARG_NUM_SAMPLES, float(n))
         out.add(M.ARG_ROUND_IDX, round_idx)
+        out.add(M.ARG_UPLOAD_SEQ, self._upload_seq)
+        self._upload_seq += 1
         self.send_message(out)
 
     def _on_finish(self, msg: M.Message) -> None:

@@ -64,8 +64,34 @@ ARG_SLOT_INDEX = "slot_index"
 #: silo accumulated against frames the server dropped no longer
 #: corresponds to anything the server aggregated
 ARG_EF_RESET = "ef_reset"
+#: per-sender monotone upload counter (ISSUE 7): the asynchronous server
+#: dedups re-delivered frames by watermark — ``seq <= last seen`` from a
+#: sender is a transport duplicate — while a client honestly re-training
+#: from an unchanged base version ships a fresh seq and is accepted. The
+#: synchronous server keys dedup on the round tag instead and ignores
+#: this; senders without it fall back to one-contribution-per-version.
+ARG_UPLOAD_SEQ = "upload_seq"
+#: sender promise (ISSUE 7): "this connection stays open — route my
+#: replies back on it". The selector core maps rank -> connection only
+#: for frames carrying this flag; a legacy ``SocketCommManager`` peer
+#: (one short-lived connection per frame, replies dialed to its listener
+#: port) never sets it, so its dying connections are never chosen as a
+#: reply route.
+ARG_CONN_PERSISTENT = "persistent_conn"
 
 _MAGIC = b"NIDT1"
+
+
+def frame_bytes(msg: "Message") -> bytes:
+    """THE on-the-wire framing: an 8-byte ``!Q`` length prefix followed
+    by the serialized message. Every transport (socket, selector core,
+    load harness, chaos wrapper) must emit exactly this — one
+    definition, so a future header change cannot silently desync one
+    hand-rolled copy."""
+    import struct
+
+    raw = msg.to_bytes()
+    return struct.pack("!Q", len(raw)) + raw
 
 
 class Message:

@@ -373,6 +373,29 @@ def main(argv=None) -> int:
                     help="value-anomaly strikes before a silo is "
                          "quarantined (clean rounds forgive one strike "
                          "each)")
+    ap.add_argument("--async_server", action="store_true",
+                    help="server runs the FedBuff-style buffered "
+                         "asynchronous control plane (asyncfl/): the "
+                         "selector comm core holds every connection in "
+                         "one event loop, uploads are accepted "
+                         "continuously and aggregated every --buffer_k "
+                         "arrivals with (1+tau)^-alpha staleness "
+                         "weighting, broadcasts are version-tagged, and "
+                         "there is NO round barrier (comm_round counts "
+                         "aggregations). Clients run unchanged")
+    ap.add_argument("--buffer_k", type=int, default=0,
+                    help="async server: aggregate every K accepted "
+                         "uploads (0 = num_clients)")
+    ap.add_argument("--staleness_alpha", type=float, default=0.5,
+                    help="async server: polynomial staleness exponent; "
+                         "an upload tau versions stale weighs "
+                         "n * (1+tau)^-alpha")
+    ap.add_argument("--max_staleness", type=int, default=20,
+                    help="async server: uploads staler than this many "
+                         "versions are dropped at admission (with a "
+                         "logged reason); also bounds the ring of "
+                         "historical params kept as codec delta "
+                         "references")
     ap.add_argument("--round_deadline", type=float, default=0.0,
                     help="server: per-round deadline seconds; when it "
                          "fires with >= --quorum uploads the round "
@@ -503,6 +526,17 @@ def main(argv=None) -> int:
                       if args.fault_spec else None)
     except ValueError as e:
         ap.error(str(e))
+    if fault_spec is not None and fault_spec.rejoins:
+        # fail at startup, not silently mid-run: the chaos wrapper
+        # models a crash by latching and stopping the client PROCESS's
+        # dispatch — nothing remains to revive at the rejoin round
+        ap.error("--fault_spec rejoin: is not supported by the "
+                 "multiprocess runner (a crashed client process cannot "
+                 "revive itself; FaultyCommManager latches the crash). "
+                 "Model rejoin by launching a replacement client "
+                 "process (the server's late re-register path), or use "
+                 "the asyncfl load harness (asyncfl/loadgen.py) whose "
+                 "simulated clients honor rejoin deterministically")
     if args.secure:
         if args.defense != "none" or args.quarantine_rounds > 0:
             ap.error("--secure is incompatible with --defense/"
@@ -513,6 +547,30 @@ def main(argv=None) -> int:
             ap.error("--secure cannot simulate byz: value faults (the "
                      "share algebra hides the very values the attack "
                      "would corrupt; see cross_silo)")
+    if args.async_server:
+        # async incompatibilities fail at STARTUP on every rank, like
+        # the secure/codec rejections — never mid-run
+        if args.secure:
+            ap.error("--async_server is incompatible with --secure: the "
+                     "two-phase secure weight exchange (every client's "
+                     "normalized weight depends on every other phase-A "
+                     "reporter) IS a round barrier — exactly what the "
+                     "buffered asynchronous protocol removes (see "
+                     "asyncfl/server.py)")
+        if args.transport == "broker":
+            ap.error("--async_server pairs with the selector socket "
+                     "core (asyncfl/loop.py); the broker daemon is a "
+                     "thread-per-connection transport with its own "
+                     "scaling story — use --transport socket")
+        if args.round_deadline > 0 or args.quorum > 0:
+            ap.error("--async_server has no round barrier: "
+                     "--round_deadline/--quorum do not apply (uploads "
+                     "aggregate every --buffer_k arrivals; staleness is "
+                     "bounded by --max_staleness instead)")
+        if args.buffer_k < 0 or args.max_staleness < 0 \
+                or args.staleness_alpha < 0:
+            ap.error("--buffer_k/--max_staleness/--staleness_alpha "
+                     "must be >= 0")
     if args.round_deadline > 0 and args.quorum == 0:
         args.quorum = args.num_clients // 2 + 1  # simple majority
     if args.heartbeat_timeout > 0 and not (
@@ -572,15 +630,34 @@ def main(argv=None) -> int:
                     "stddev": args.stddev, "defense_seed": args.seed,
                     "quarantine_rounds": args.quarantine_rounds,
                     "outlier_threshold": args.outlier_threshold})
-        comm, broker = _make_comm(args, 0, host_map)
-        server = cls(init, args.comm_round, args.num_clients,
-                     base_port=args.base_port, host_map=host_map,
-                     comm=comm, round_deadline=args.round_deadline,
-                     quorum=args.quorum,
-                     heartbeat_timeout=args.heartbeat_timeout, **kw)
-        print(f"[server] {args.transport} control plane on port "
-              f"{args.broker_port or args.base_port}; waiting for "
-              f"{args.num_clients} silos", flush=True)
+        if args.async_server:
+            from neuroimagedisttraining_tpu.asyncfl import (
+                BufferedFedAvgServer,
+            )
+
+            server = BufferedFedAvgServer(
+                init, args.comm_round, args.num_clients,
+                buffer_k=args.buffer_k,
+                staleness_alpha=args.staleness_alpha,
+                max_staleness=args.max_staleness,
+                base_port=args.base_port, host_map=host_map,
+                heartbeat_timeout=args.heartbeat_timeout, **kw)
+            print(f"[server] asyncfl selector control plane on port "
+                  f"{args.base_port}; buffer_k="
+                  f"{server.buffer_k}, staleness_alpha="
+                  f"{args.staleness_alpha}, max_staleness="
+                  f"{args.max_staleness}", flush=True)
+            broker = None
+        else:
+            comm, broker = _make_comm(args, 0, host_map)
+            server = cls(init, args.comm_round, args.num_clients,
+                         base_port=args.base_port, host_map=host_map,
+                         comm=comm, round_deadline=args.round_deadline,
+                         quorum=args.quorum,
+                         heartbeat_timeout=args.heartbeat_timeout, **kw)
+            print(f"[server] {args.transport} control plane on port "
+                  f"{args.broker_port or args.base_port}; waiting for "
+                  f"{args.num_clients} silos", flush=True)
         server.run()
         if broker is not None:
             broker.stop()
@@ -588,6 +665,16 @@ def main(argv=None) -> int:
             float(np.sum(np.asarray(v, np.float64) ** 2))
             for v in jax.tree.leaves(server.params))))
         stats = server.com_manager.byte_stats()
+        extra = {}
+        if args.async_server:
+            extra = {"async_server": True,
+                     "buffer_k": server.buffer_k,
+                     "staleness_alpha": args.staleness_alpha,
+                     "max_staleness": args.max_staleness,
+                     "upload_audit": server.upload_audit(),
+                     "staleness_taus": sorted({
+                         t for h in server.history
+                         for t in h.get("taus", ())})}
         print(json.dumps({"rounds_completed": len(server.history),
                           "clients": args.num_clients,
                           "secure": bool(args.secure),
@@ -600,7 +687,7 @@ def main(argv=None) -> int:
                               server.quarantined_clients()),
                           "byz_stats": server.byz_stats,
                           "final_param_norm": round(norm, 6),
-                          **stats}), flush=True)
+                          **extra, **stats}), flush=True)
         return 0
 
     train_fn, wire_masks = _make_train_fn(args)

@@ -12,7 +12,12 @@ Fault semantics:
   dispatch stops (the manager's blocking loop returns, so the owning
   process/thread winds down exactly like a real death) and every send is
   swallowed. Peers observe the same thing a SIGKILL produces: no more
-  frames, no FIN handshake at the protocol level.
+  frames, no FIN handshake at the protocol level. The latch is
+  PERMANENT by design — a ``rejoin:`` directive cannot revive a wound-
+  down process, so ``distributed/run.py`` rejects rejoin specs at
+  startup; deterministic rejoin lives where a "process" is cheap to
+  resurrect (the asyncfl load harness's simulated clients, or a
+  replacement OS process using the server's late re-register path).
 - **straggle** — outbound sends sleep the scheduled delay first.
 - **drop** — the send silently never happens.
 - **duplicate** — the frame is sent twice (the server's round-tagged
@@ -34,7 +39,6 @@ from __future__ import annotations
 
 import logging
 import socket
-import struct
 import time
 import zlib
 
@@ -142,12 +146,11 @@ class FaultyCommManager(BaseCommManager, Observer):
         base_port = getattr(self.inner, "base_port", None)
         if host_map is None or base_port is None:
             return  # pub/sub stream: tearing it would desync ALL topics
-        raw = msg.to_bytes()
+        frame = M.frame_bytes(msg)  # prefix promises more than we send
         addr = (host_map[msg.receiver_id], base_port + msg.receiver_id)
         try:
             with socket.create_connection(addr, timeout=5.0) as conn:
-                conn.sendall(struct.pack("!Q", len(raw))  # nidt: allow[lock-send] -- fault injection writes a deliberately torn frame on a fresh per-call connection; no concurrent writer exists
-                             + raw[: max(1, len(raw) // 2)])
+                conn.sendall(frame[: 8 + max(1, (len(frame) - 8) // 2)])  # nidt: allow[lock-send] -- fault injection writes a deliberately torn frame on a fresh per-call connection; no concurrent writer exists
         except OSError:
             pass  # receiver gone — the message is lost either way
 

@@ -78,9 +78,16 @@ def activity_mask(seed: int, round_idx: int, n: int,
 class FaultSpec:
     """What can go wrong. All probabilities are per-event Bernoulli
     parameters; ``crashes`` adds deterministic (rank, round) kill points
-    on top of the probabilistic draw."""
+    on top of the probabilistic draw, and ``rejoins`` ends a
+    deterministic crash window (crash **and** come back — the churn the
+    asyncfl load harness drives, ISSUE 7)."""
 
     crashes: tuple[tuple[int, int], ...] = ()  # (rank, round): dead from round on
+    # (rank, round): alive again from round on — must follow a ``crashes``
+    # directive for the same rank at an earlier round (parse-validated);
+    # probabilistic crash_prob deaths stay permanent (no seeded stream
+    # could decide WHICH probabilistic corpse a rejoin revives)
+    rejoins: tuple[tuple[int, int], ...] = ()
     crash_prob: float = 0.0        # per-(round, rank); crashes are permanent
     straggle_prob: float = 0.0     # per-(round, rank)
     straggle_delay: float = 0.0    # max seconds; actual ~ U(0, max)
@@ -95,6 +102,23 @@ class FaultSpec:
     byz: tuple[tuple[int, int, str], ...] = ()
     byz_prob: float = 0.0
     byz_kind: str = "sign_flip"
+
+    def __post_init__(self) -> None:
+        # a rejoin without an earlier deterministic crash for the same
+        # rank is a spec typo (the rank was never scheduled dead) — fail
+        # at parse/construction, never mid-run
+        for rank, at in self.rejoins:
+            if not any(r == rank and cr < at for r, cr in self.crashes):
+                raise ValueError(
+                    f"rejoin:{rank}@{at} has no crash:{rank}@ROUND "
+                    f"directive with ROUND < {at} to rejoin from")
+            if any(r == rank and cr == at for r, cr in self.crashes):
+                # a tie would make the event walk order-dependent —
+                # the 'rounds never tie' invariant crashed() relies on
+                raise ValueError(
+                    f"crash:{rank}@{at} and rejoin:{rank}@{at} share a "
+                    "round; crash/rejoin directives for one rank must "
+                    "alternate at distinct rounds")
 
     @property
     def any_faults(self) -> bool:
@@ -116,6 +140,10 @@ def parse_fault_spec(text: str) -> FaultSpec:
     directives::
 
         crash:RANK@ROUND        deterministic kill of RANK at ROUND
+        rejoin:RANK@ROUND       RANK comes back at ROUND (ends a crash
+                                window; needs an earlier crash:RANK@R —
+                                deterministic churn for the async load
+                                harness; crash_prob deaths stay permanent)
         crash_prob:P            per-(round, rank) Bernoulli crash
         straggle:P:MAX_DELAY    with prob P delay sends by U(0, MAX_DELAY) s
         drop:P                  drop outbound protocol messages with prob P
@@ -127,9 +155,10 @@ def parse_fault_spec(text: str) -> FaultSpec:
         byz_prob:P[:KIND]       per-(round, rank) transient value fault
                                 of KIND (default sign_flip)
 
-    e.g. ``"crash:3@1,drop:0.1,byz:1@0:sign_flip"``. Empty string => no
-    faults."""
+    e.g. ``"crash:3@1,rejoin:3@4,drop:0.1,byz:1@0:sign_flip"``. Empty
+    string => no faults."""
     crashes: list[tuple[int, int]] = []
+    rejoins: list[tuple[int, int]] = []
     byz: list[tuple[int, int, str]] = []
     kw: dict = {}
     for part in text.replace(";", ",").split(","):
@@ -139,9 +168,10 @@ def parse_fault_spec(text: str) -> FaultSpec:
         key, _, rest = part.partition(":")
         key = key.strip()
         try:
-            if key == "crash":
+            if key in ("crash", "rejoin"):
                 rank_s, _, round_s = rest.partition("@")
-                crashes.append((int(rank_s), int(round_s)))
+                (crashes if key == "crash" else rejoins).append(
+                    (int(rank_s), int(round_s)))
             elif key == "byz":
                 at, _, kind = rest.partition(":")
                 rank_s, _, round_s = at.partition("@")
@@ -173,7 +203,11 @@ def parse_fault_spec(text: str) -> FaultSpec:
             continue
         if not 0.0 <= p <= 1.0:
             raise ValueError(f"--fault_spec {name}={p} not in [0, 1]")
-    return FaultSpec(crashes=tuple(crashes), byz=tuple(byz), **kw)
+    try:
+        return FaultSpec(crashes=tuple(crashes), rejoins=tuple(rejoins),
+                         byz=tuple(byz), **kw)
+    except ValueError as e:  # rejoin-without-crash cross-validation
+        raise ValueError(f"bad --fault_spec: {e}") from None
 
 
 class FaultSchedule:
@@ -184,11 +218,16 @@ class FaultSchedule:
     def __init__(self, spec: FaultSpec, seed: int):
         self.spec = spec
         self.seed = int(seed)
-        self._crash_at: dict[int, int] = {}
+        #: rank -> [(round, is_crash)] sorted by round; FaultSpec
+        #: validation guarantees every rejoin strictly follows a crash,
+        #: so rounds never tie and the walk in ``crashed`` is unambiguous
+        self._life_events: dict[int, list[tuple[int, bool]]] = {}
         for rank, round_idx in spec.crashes:
-            prev = self._crash_at.get(rank)
-            self._crash_at[rank] = (round_idx if prev is None
-                                    else min(prev, round_idx))
+            self._life_events.setdefault(rank, []).append((round_idx, True))
+        for rank, round_idx in spec.rejoins:
+            self._life_events.setdefault(rank, []).append((round_idx, False))
+        for events in self._life_events.values():
+            events.sort(key=lambda e: (e[0], e[1]))
 
     # ---- per-(round, rank) event draws ----
 
@@ -200,11 +239,18 @@ class FaultSchedule:
         return np.random.default_rng(coords)
 
     def crashed(self, round_idx: int, rank: int) -> bool:
-        """True iff ``rank`` is dead at ``round_idx`` (crashes are
-        permanent until an explicit rejoin, which the schedule does not
-        model — the control plane's re-register path does)."""
-        at = self._crash_at.get(rank)
-        if at is not None and round_idx >= at:
+        """True iff ``rank`` is dead at ``round_idx``. Deterministic
+        ``crash:``/``rejoin:`` directives form alternating windows (the
+        latest directive at or before ``round_idx`` decides); a
+        probabilistic ``crash_prob`` death is permanent — the wrapper's
+        process is gone, and only an explicit rejoin directive (or the
+        control plane's re-register path) models a comeback."""
+        dead = False
+        for at, is_crash in self._life_events.get(rank, ()):
+            if at > round_idx:
+                break
+            dead = is_crash
+        if dead:
             return True
         p = self.spec.crash_prob
         if p > 0:

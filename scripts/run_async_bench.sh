@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Async control-plane load benchmark (ISSUE 7): drive 1,000 concurrent
+# simulated clients (asyncio fleet, canned update pytrees, seeded
+# crash/rejoin churn) against ONE server process and A/B the buffered
+# asynchronous control plane (asyncfl/BufferedFedAvgServer) against the
+# round-synchronous baseline (FedAvgServer) on the SAME selector comm
+# core — the comparison isolates the control-plane discipline, not the
+# socket implementation.
+#
+# Emits bench_matrix/async_bench.json with, per mode: sustained
+# uploads/s (accepted), aggregations/s, p50/p99 version-advance latency,
+# peak concurrent connections, byte/frame counters, and the accounting
+# audits (zero lost / double-counted uploads). The script FAILS unless
+# both modes reconcile their frame accounting and the async cell
+# actually held >= the requested client count concurrently.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PY=${PYTHON:-python}
+CLIENTS=${CLIENTS:-1000}
+AGGREGATIONS=${AGGREGATIONS:-40}
+BUFFER_K=${BUFFER_K:-100}
+# deterministic churn keyed at rounds BOTH modes actually reach (the
+# sync baseline runs aggregations*buffer_k/clients rounds): two
+# crash/rejoin cycles plus one permanent corpse — the sync barrier pays
+# its deadline for them, the async buffer just keeps aggregating
+FAULTS="crash:7@1,rejoin:7@3,crash:13@2,crash:21@1,rejoin:21@2"
+OUT=bench_matrix/async_bench.json
+
+$PY -m neuroimagedisttraining_tpu.asyncfl.loadgen \
+    --clients "$CLIENTS" --mode both \
+    --aggregations "$AGGREGATIONS" --buffer_k "$BUFFER_K" \
+    --max_staleness 50 --staleness_alpha 0.5 \
+    --fault_spec "$FAULTS" --seed 7 \
+    --out "$OUT" || exit 1
+
+$PY - "$OUT" "$CLIENTS" <<'EOF'
+import json, sys
+res = json.load(open(sys.argv[1]))
+want = int(sys.argv[2])
+for mode in ("async", "sync"):
+    cell = res[mode]
+    assert cell["frames_reconciled"], (mode, cell)
+    assert cell["upload_audit"]["received_accounted"], (mode, cell)
+    assert cell["upload_audit"]["accepted_accounted"], (mode, cell)
+    assert cell["peak_connections"] >= want, (mode, cell)
+a, s = res["async"], res["sync"]
+print(f"OK: {want} concurrent clients held on one server process")
+print(f"  async: {a['uploads_per_s']} uploads/s, "
+      f"{a['aggregations_per_s']} agg/s, "
+      f"p99 advance {a['version_advance_p99_ms']} ms")
+print(f"  sync : {s['uploads_per_s']} uploads/s, "
+      f"{s['aggregations_per_s']} rounds/s, "
+      f"p99 advance {s['version_advance_p99_ms']} ms")
+print(f"  summary: {res['summary']}")
+EOF

@@ -8,7 +8,12 @@
 #   - Byzantine (ISSUE 5): client 1 sign-flips its upload delta every
 #     round — the server defends with trimmed_mean (byz_f=1) and the
 #     outlier-scorer/quarantine control plane armed, and the final
-#     model must come out finite.
+#     model must come out finite;
+#   - async (ISSUE 7): the FedBuff-style buffered server (asyncfl/) on
+#     the selector comm core, kill-k churn + trimmed_mean armed, no
+#     round barrier — every aggregation must land, the model stay
+#     finite, and BOTH accounting audits (received == accepted +
+#     dropped; accepted == aggregated + buffered) come back green.
 #
 # Heavier than the tier-1 suite (each run trains the tiny 3D CNN in 5
 # real OS processes), so it lives here as a CI smoke, not a pytest.
@@ -82,9 +87,66 @@ else:
 EOF
 }
 
+run_async() {
+    local port
+    port=$($PY -c "from neuroimagedisttraining_tpu.distributed.ports \
+import free_port_block; print(free_port_block(16))")
+    # NOTE: no --round_deadline/--quorum — the buffered server has no
+    # round barrier and rejects them at startup by design
+    local common=(--num_clients "$CLIENTS" --comm_round "$ROUNDS"
+                  --model 3dcnn_tiny --dataset synthetic
+                  --synthetic_num_subjects 24
+                  --synthetic_shape 12 14 12 --batch_size 4
+                  --base_port "$port" --force_cpu
+                  --async_server --buffer_k 3 --max_staleness 8
+                  --fault_spec "crash:3@1"
+                  --defense trimmed_mean --byz_f 1
+                  --heartbeat_interval 0.5 --heartbeat_timeout 5)
+    echo "== chaos smoke (asyncfl buffered server, port $port): kill" \
+         "client 3 at version 1, buffer_k=3, trimmed_mean armed =="
+    local out="/tmp/chaos_smoke_async.log"
+    $PY -m neuroimagedisttraining_tpu.distributed.run \
+        --role server "${common[@]}" > "$out" 2>&1 &
+    local server_pid=$!
+    local pids=()
+    for r in $(seq 1 "$CLIENTS"); do
+        $PY -m neuroimagedisttraining_tpu.distributed.run \
+            --role client --rank "$r" "${common[@]}" \
+            > "/tmp/chaos_smoke_async_c${r}.log" 2>&1 &
+        pids+=($!)
+    done
+    if ! wait "$server_pid"; then
+        echo "FAIL(async): server exited non-zero"
+        cat "$out"; return 1
+    fi
+    for p in "${pids[@]}"; do wait "$p" 2>/dev/null || true; done
+    local json
+    json=$(grep -a -o '^{.*}' "$out" | tail -1)
+    echo "$json"
+    $PY - "$json" <<EOF
+import json, math, sys
+res = json.loads(sys.argv[1])
+assert res["async_server"] is True, res
+assert res["rounds_completed"] == $ROUNDS, res
+assert res["defense"] == "trimmed_mean", res
+assert math.isfinite(res["final_param_norm"]), res
+audit = res["upload_audit"]
+# byte/frame accounting audit 1: every received upload accounted once
+assert audit["received_accounted"], audit
+# audit 2: every accepted upload aggregated or still buffered
+assert audit["accepted_accounted"], audit
+assert res["frames_recv"] > 0 and res["bytes_recv"] > 0, res
+print(f"OK(async): {res['rounds_completed']} aggregations, "
+      f"{audit['accepted']} uploads accepted "
+      f"(taus={res['staleness_taus']}), audits green, "
+      f"|params|={res['final_param_norm']:.3f}")
+EOF
+}
+
 rc=0
 run_one socket crash || rc=1
 run_one broker crash || rc=1
 run_one socket byz   || rc=1
 run_one broker byz   || rc=1
+run_async            || rc=1
 exit $rc

@@ -28,7 +28,11 @@ fi
 # bodies (no host syncs, no global RNG — one seed, one attack trace)
 # the donation-discipline family (ISSUE 4) rides along: round programs
 # must declare donate_argnums, and no caller may reread a donated buffer
-echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline) =="
+# the async-discipline family (ISSUE 7) covers asyncfl/: no blocking
+# calls (time.sleep, socket recv/accept, bare queue.get) lexically
+# inside async def bodies — one blocking call silently serializes the
+# whole simulated-client fleet; lock-discipline extends to asyncfl/ too
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
 

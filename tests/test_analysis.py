@@ -751,3 +751,85 @@ def test_pad_weights_helper_home_and_other_compares_pass():
         def valid(X, nc):
             return jnp.arange(X.shape[0]) < nc
         """, rules=["mesh-pad-weights"]) == []
+
+
+# ---------------- async discipline (ISSUE 7) ----------------
+
+_ASYNCFL_PATH = "neuroimagedisttraining_tpu/asyncfl/loadgen.py"
+
+
+def test_async_blocking_calls_flagged_in_asyncfl():
+    fs = lint("""
+        import time
+        import select
+
+        async def drive(sock):
+            time.sleep(0.1)
+            select.select([sock], [], [])
+            sock.recv(4)
+            sock.accept()
+        """, path=_ASYNCFL_PATH, rules=["async-blocking-call"])
+    assert rules_of(fs) == ["async-blocking-call"] * 4
+    assert "freezes every coroutine" in fs[0].message
+
+
+def test_async_awaited_and_nested_sync_bodies_pass():
+    # awaited calls are the sanctioned non-blocking spellings; nested
+    # SYNC defs/lambdas are executor-shipped bodies and may block
+    assert lint("""
+        import asyncio
+        import time
+
+        async def drive(loop, sock):
+            await asyncio.sleep(0.1)
+            data = await loop.sock_recv(sock, 4)
+
+            def off_loop():
+                time.sleep(1)
+                return sock.recv(4)
+            return await loop.run_in_executor(None, off_loop)
+        """, path=_ASYNCFL_PATH, rules=["async-blocking-call"]) == []
+
+
+def test_async_rules_scoped_to_asyncfl_and_sync_defs_exempt():
+    src = """
+        import time
+
+        def sync_helper():
+            time.sleep(1)
+
+        async def drive(sock):
+            time.sleep(1)
+        """
+    # outside asyncfl/ the family never fires
+    assert lint(src, path="neuroimagedisttraining_tpu/distributed/x.py",
+                rules=["async-blocking-call"]) == []
+    # inside, only the async body is flagged — module-level sync code
+    # (the selector loop itself) blocks legitimately
+    fs = lint(src, path=_ASYNCFL_PATH, rules=["async-blocking-call"])
+    assert len(fs) == 1 and fs[0].line == 8
+
+
+def test_async_nested_coroutine_violation_reported_once():
+    fs = lint("""
+        import time
+
+        async def outer():
+            async def inner():
+                time.sleep(1)
+            return inner
+        """, path=_ASYNCFL_PATH, rules=["async-blocking-call"])
+    assert rules_of(fs) == ["async-blocking-call"]
+    assert "inner" in fs[0].message
+
+
+def test_async_queue_get_flagged_dict_get_passes():
+    fs = lint("""
+        async def drain(q, d):
+            item = q.get()
+            known = d.get("key")
+            timed = q.get(timeout=0.1)
+            nonblock = q.get(block=False)
+        """, path=_ASYNCFL_PATH, rules=["async-queue-get"])
+    assert rules_of(fs) == ["async-queue-get"]
+    assert fs[0].line == 3

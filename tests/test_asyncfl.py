@@ -1,0 +1,609 @@
+"""asyncfl/: the buffered asynchronous control plane (ISSUE 7).
+
+Covers the FedBuff-style server's numerical contract (buffered aggregate
+with all-current uploads and ``buffer_k == cohort`` is BITWISE one
+synchronous ``tree_weighted_mean`` round; staleness weights pinned
+against a host replay), the version ring's codec-reference threading
+(a stale delta frame decodes against the base the sender trained from —
+and provably NOT against the current model), admission control
+(max_staleness / future tags / seq-watermark dedup), the selector comm
+core (mid-frame disconnect, slow-reader backpressure, legacy dial-in
+interop), startup rejections, and a ``slow``-marked 200-client loadgen
+smoke with seeded crash/rejoin churn.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.asyncfl.loop import SelectorCommManager
+from neuroimagedisttraining_tpu.asyncfl.server import (
+    BufferedFedAvgServer,
+    staleness_weight,
+)
+from neuroimagedisttraining_tpu.codec import wire as codec
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.distributed.comm import Observer
+from neuroimagedisttraining_tpu.distributed.cross_silo import (
+    FedAvgClientProc,
+    survivor_weighted_mean,
+)
+from neuroimagedisttraining_tpu.distributed.ports import free_port_block
+
+
+class _CaptureComm:
+    """Minimal BaseCommManager stand-in for handler-level unit tests."""
+
+    def __init__(self):
+        self.sent = []
+
+    def send_message(self, msg, **kw):
+        self.sent.append(msg)
+
+    def add_observer(self, obs):
+        pass
+
+    def remove_observer(self, obs):
+        pass
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        pass
+
+    def byte_stats(self):
+        return {}
+
+
+def _tree(seed, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {"params": {"w": (scale * rng.standard_normal(12)
+                             ).astype(np.float32),
+                       "b": (scale * rng.standard_normal(3)
+                             ).astype(np.float32)}}
+
+
+def _upload(sender, tree, n, version, seq=None):
+    msg = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, sender, 0)
+    msg.add(M.ARG_MODEL_PARAMS, tree)
+    msg.add(M.ARG_NUM_SAMPLES, float(n))
+    msg.add(M.ARG_ROUND_IDX, int(version))
+    if seq is not None:
+        msg.add(M.ARG_UPLOAD_SEQ, int(seq))
+    return msg
+
+
+def _server(num_clients=3, comm_round=10, **kw):
+    kw.setdefault("buffer_k", num_clients)
+    return BufferedFedAvgServer(_tree(0), comm_round, num_clients,
+                                comm=_CaptureComm(), **kw)
+
+
+# ------------------------------------------------ numerical contract
+
+
+def test_buffer_k_cohort_zero_staleness_is_sync_round_bitwise():
+    """THE equivalence pin: all-current uploads filling a cohort-sized
+    buffer reproduce one synchronous round — the very
+    ``survivor_weighted_mean`` (jitted ``tree_weighted_mean``) call the
+    synchronous server's ``_aggregate_and_advance`` makes over the same
+    upload set, bitwise."""
+    srv = _server(num_clients=3, buffer_k=3, staleness_alpha=0.7)
+    trees = [_tree(s + 1) for s in range(3)]
+    ns = [5.0, 9.0, 2.0]
+    for s, (t, n) in enumerate(zip(trees, ns), start=1):
+        srv._on_model(_upload(s, t, n, version=0, seq=0))
+    assert srv.round_idx == 1
+    expect = survivor_weighted_mean(trees, ns)
+    for k in ("w", "b"):
+        got, want = srv.params["params"][k], expect["params"][k]
+        assert got.tobytes() == want.tobytes()
+    # the recorded weights are EXACTLY the sample counts (tau == 0)
+    assert srv.history[0]["weights"] == ns
+    assert srv.history[0]["taus"] == [0, 0, 0]
+
+
+def test_staleness_weights_pinned_against_host_replay():
+    srv = _server(num_clients=2, buffer_k=1, staleness_alpha=0.5,
+                  max_staleness=10)
+    # two k=1 aggregations advance the version to 2
+    srv._on_model(_upload(1, _tree(1), 4.0, version=0, seq=0))
+    srv._on_model(_upload(1, _tree(2), 4.0, version=1, seq=1))
+    assert srv.round_idx == 2
+    # an upload still based on version 0 arrives: tau = 2
+    srv._on_model(_upload(2, _tree(3), 6.0, version=0, seq=0))
+    assert srv.round_idx == 3
+    entry = srv.history[-1]
+    assert entry["taus"] == [2]
+    replay = staleness_weight(6.0, 2, 0.5)
+    assert entry["weights"] == [replay]
+    assert replay == 6.0 * (1.0 + 2.0) ** -0.5
+    # zero staleness is an EXACT passthrough of the sample count
+    assert staleness_weight(7.0, 0, 0.5) == 7.0
+
+
+def test_stale_upload_is_delta_transported_to_current_base():
+    """A stale model u (trained from ring[v]) must contribute
+    ``u + (params_now - ring[v])`` — its learning delta applied to the
+    current anchor — replayed here in host numpy, bitwise."""
+    srv = _server(num_clients=2, buffer_k=2, comm_round=10)
+    ref0 = srv.params
+    srv._on_model(_upload(1, _tree(1), 4.0, version=0, seq=0))
+    srv._on_model(_upload(2, _tree(2), 4.0, version=0, seq=0))
+    assert srv.round_idx == 1
+    stale = _tree(5)
+    srv._on_model(_upload(1, stale, 4.0, version=0, seq=1))
+    buffered = srv._buffer[-1]["tree"]
+    for k in ("w", "b"):
+        want = (stale["params"][k]
+                + (srv.params["params"][k] - ref0["params"][k]))
+        assert buffered["params"][k].tobytes() == want.tobytes()
+
+
+# ------------------------------------------------ version-tagged codec
+
+
+def test_stale_delta_frame_decodes_against_its_ring_reference():
+    """PR 3 reference threading generalized: the server must decode a
+    delta frame against the EXACT tree it broadcast under the frame's
+    version tag, not the current model — pinned both ways."""
+    spec = codec.parse_wire_spec("delta")
+    srv = _server(num_clients=2, buffer_k=1, comm_round=10,
+                  max_staleness=5)
+    ref0 = srv.params
+    srv._on_model(_upload(2, _tree(9), 4.0, version=0, seq=0))
+    assert srv.round_idx == 1 and np.any(
+        srv.params["params"]["w"] != ref0["params"]["w"])
+    # client 1 trained from version 0 and encodes its delta against it
+    u = _tree(4)
+    frame, _ = codec.encode_update(spec, u, reference=ref0)
+    srv._on_model(_upload(1, frame, 4.0, version=0, seq=0))
+    assert srv.upload_stats["accepted"] == 2
+    # the aggregate consumed decode(frame, ref0) delta-transported to
+    # the current base — replay the whole pipeline on host
+    decoded = codec.decode_update(frame, like=ref0, reference=ref0)
+    agg = srv.history[-1]
+    u_eff = {"params": {
+        k: decoded["params"][k]
+        + (srv._ring[1]["params"][k] - ref0["params"][k])
+        for k in ("w", "b")}}
+    expect = survivor_weighted_mean([u_eff], agg["weights"])
+    for k in ("w", "b"):
+        assert srv.params["params"][k].tobytes() == \
+            expect["params"][k].tobytes()
+    # decoding against the WRONG (current) reference is provably a
+    # different update — the bug the ring exists to prevent
+    wrong = codec.decode_update(frame, like=ref0,
+                                reference=srv._ring[1])
+    assert np.any(wrong["params"]["w"] != decoded["params"]["w"])
+
+
+# ------------------------------------------------ admission control
+
+
+def test_max_staleness_future_and_seq_dedup_gates():
+    srv = _server(num_clients=2, buffer_k=1, max_staleness=2,
+                  comm_round=50)
+    # future tag
+    srv._on_model(_upload(1, _tree(1), 4.0, version=7, seq=0))
+    assert srv.upload_stats["dropped_future"] == 1
+    # advance 4 versions; a version-0 upload is now ancient
+    for i in range(4):
+        srv._on_model(_upload(1, _tree(i), 4.0, version=srv.round_idx,
+                              seq=i + 1))
+    assert srv.round_idx == 4
+    srv._on_model(_upload(2, _tree(2), 4.0, version=0, seq=0))
+    assert srv.upload_stats["dropped_stale"] == 1
+    # the ring holds exactly max_staleness + 1 versions
+    assert sorted(srv._ring) == [2, 3, 4]
+    # transport re-delivery (same seq) is dropped; an honest repeat
+    # contribution from the same base version (fresh seq) is accepted
+    srv._on_model(_upload(2, _tree(3), 4.0, version=4, seq=5))
+    srv._on_model(_upload(2, _tree(3), 4.0, version=srv.round_idx,
+                          seq=5))
+    assert srv.upload_stats["dropped_duplicate"] == 1
+    before = srv.upload_stats["accepted"]
+    srv._on_model(_upload(2, _tree(4), 4.0, version=srv.round_idx,
+                          seq=6))
+    assert srv.upload_stats["accepted"] == before + 1
+    audit = srv.upload_audit()
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+
+
+def test_every_upload_gets_a_sync_reply_and_nonfinite_rejected():
+    srv = _server(num_clients=2, buffer_k=2, comm_round=50)
+    comm = srv.com_manager
+    bad = _tree(1)
+    bad["params"]["w"][0] = np.nan
+    srv._on_model(_upload(1, bad, 4.0, version=0, seq=0))
+    assert srv.upload_stats["dropped_nonfinite"] == 1
+    srv._on_model(_upload(2, _tree(2), 4.0, version=0, seq=0))
+    # both senders were re-synced (liveness never depends on the verdict)
+    syncs = [m for m in comm.sent
+             if m.msg_type == M.MSG_TYPE_S2C_SYNC_MODEL]
+    assert {m.receiver_id for m in syncs} == {1, 2}
+    assert all(int(m.get(M.ARG_ROUND_IDX)) == srv.round_idx
+               for m in syncs)
+
+
+def test_duplicated_nonfinite_frame_strikes_once():
+    """A transport-duplicated REJECTED frame must repeat the VERDICT,
+    not the processing: the watermark advances at the gate, so the
+    re-delivery is duplicate-dropped and an honest silo's one transient
+    NaN cannot strike (and eventually quarantine) twice."""
+    srv = _server(num_clients=3, buffer_k=3, comm_round=50,
+                  quarantine_rounds=2, outlier_threshold=2)
+    bad = _tree(1)
+    bad["params"]["w"][0] = np.nan
+    srv._on_model(_upload(1, bad, 4.0, version=0, seq=0))
+    srv._on_model(_upload(1, bad, 4.0, version=0, seq=0))  # dup
+    assert srv.upload_stats["dropped_nonfinite"] == 1
+    assert srv.upload_stats["dropped_duplicate"] == 1
+    assert srv._strikes.get(1, 0) == 1
+    assert srv.quarantined_clients() == set()
+    audit = srv.upload_audit()
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+
+
+def test_register_has_no_barrier_and_resets_seq_watermark():
+    srv = _server(num_clients=3, buffer_k=3, comm_round=50)
+    comm = srv.com_manager
+    srv._on_register(M.Message(M.MSG_TYPE_C2S_REGISTER, 1, 0))
+    # ONE registration already got the model (no barrier)
+    assert comm.sent[-1].msg_type == M.MSG_TYPE_S2C_INIT_CONFIG
+    assert comm.sent[-1].receiver_id == 1
+    srv._on_model(_upload(1, _tree(1), 4.0, version=0, seq=7))
+    assert srv._seq_seen[1] == 7
+    # a restarted process re-registers and restarts its counter
+    srv._on_register(M.Message(M.MSG_TYPE_C2S_REGISTER, 1, 0))
+    assert comm.sent[-1].msg_type == M.MSG_TYPE_S2C_SYNC_MODEL
+    srv._on_model(_upload(1, _tree(2), 4.0, version=0, seq=0))
+    assert srv.upload_stats["dropped_duplicate"] == 0
+    assert srv.upload_stats["accepted"] == 2
+
+
+def test_fast_client_holds_one_buffer_slot():
+    """A client lapping the buffer REPLACES its own entry instead of
+    occupying extra slots — the armed defense's f-bound is per CLIENT
+    (robust._check_f validates entries, so entries must be clients),
+    and fast clients cannot outweigh slow ones by pace alone."""
+    srv = _server(num_clients=3, buffer_k=3, comm_round=50)
+    srv._on_model(_upload(1, _tree(1), 4.0, version=0, seq=0))
+    srv._on_model(_upload(1, _tree(2), 4.0, version=0, seq=1))
+    srv._on_model(_upload(1, _tree(3), 4.0, version=0, seq=2))
+    # three accepted uploads, ONE buffer slot, no aggregation yet
+    assert srv.upload_stats["accepted"] == 3
+    assert srv.upload_stats["superseded_in_buffer"] == 2
+    assert len(srv._buffer) == 1 and srv.round_idx == 0
+    # the surviving entry is the NEWEST
+    assert srv._buffer[0]["tree"]["params"]["w"].tobytes() == \
+        _tree(3)["params"]["w"].tobytes()
+    srv._on_model(_upload(2, _tree(4), 4.0, version=0, seq=0))
+    srv._on_model(_upload(3, _tree(5), 4.0, version=0, seq=0))
+    assert srv.round_idx == 1
+    assert srv.history[-1]["contributors"] == [1, 2, 3]
+    audit = srv.upload_audit()
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+
+
+def test_malformed_upload_fields_never_kill_dispatch():
+    """A frame that decodes as a Message but carries broken FIELDS
+    (missing num_samples, non-numeric tags) must be dropped and
+    counted, not raise through the dispatch thread."""
+    srv = _server(num_clients=2, buffer_k=2, comm_round=50)
+    bad = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    bad.add(M.ARG_MODEL_PARAMS, _tree(1))
+    bad.add(M.ARG_ROUND_IDX, 0)  # no ARG_NUM_SAMPLES
+    srv._on_model(bad)
+    worse = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 2, 0)
+    worse.add(M.ARG_MODEL_PARAMS, _tree(2))
+    worse.add(M.ARG_NUM_SAMPLES, 4.0)
+    worse.add(M.ARG_ROUND_IDX, "not-a-version")
+    srv._on_model(worse)
+    assert srv.upload_stats["dropped_malformed"] == 2
+    # the server still works afterwards
+    srv._on_model(_upload(1, _tree(1), 4.0, version=0, seq=0))
+    srv._on_model(_upload(2, _tree(2), 4.0, version=0, seq=0))
+    assert srv.round_idx == 1
+    audit = srv.upload_audit()
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+
+
+def test_aggregation_is_client_id_ordered_not_arrival_ordered():
+    """Float reduction order must not depend on OS scheduling: the
+    buffer aggregates in client-id order (the sync server's sorted-
+    senders discipline), so any arrival order of the same upload set
+    produces the same model bitwise."""
+    trees = {1: _tree(1), 2: _tree(2), 3: _tree(3)}
+    ns = {1: 5.0, 2: 9.0, 3: 2.0}
+
+    def run(order):
+        srv = _server(num_clients=3, buffer_k=3)
+        for s in order:
+            srv._on_model(_upload(s, trees[s], ns[s], version=0, seq=0))
+        assert srv.round_idx == 1
+        return srv
+    a, b = run([3, 1, 2]), run([1, 2, 3])
+    assert a.history[0]["contributors"] == [1, 2, 3]
+    for k in ("w", "b"):
+        assert a.params["params"][k].tobytes() == \
+            b.params["params"][k].tobytes()
+
+
+def test_loadgen_cohort_buffer_survives_permanent_crash():
+    """buffer_k=0 (cohort-sized) plus one PERMANENT crash must not hang
+    the harness: the corpse report shrinks the effective threshold."""
+    from neuroimagedisttraining_tpu.asyncfl.loadgen import run_load
+
+    r = run_load(mode="async", num_clients=8, aggregations=4,
+                 buffer_k=0, fault_spec="crash:3@1", seed=2)
+    assert r["rounds_or_aggregations"] == 4
+    assert r["frames_reconciled"], r
+    assert r["client_stats"]["crashes"] == 1
+
+
+def test_suspect_corpse_lowers_buffer_threshold():
+    """One slot per sender means a cohort-sized buffer can never fill
+    once a client is permanently gone — a new heartbeat suspect must
+    lower the effective threshold and flush the waiting buffer (what
+    the monitor's _maybe_complete call does), not deadlock."""
+    srv = _server(num_clients=3, buffer_k=3, comm_round=50)
+    srv._on_model(_upload(1, _tree(1), 4.0, version=0, seq=0))
+    srv._on_model(_upload(2, _tree(2), 4.0, version=0, seq=0))
+    assert srv.round_idx == 0  # still waiting for client 3
+    with srv._rlock:
+        srv._suspect.add(3)
+        srv._maybe_complete()
+    assert srv.round_idx == 1
+    assert srv.history[-1]["contributors"] == [1, 2]
+    audit = srv.upload_audit()
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+
+
+def test_run_cli_rejects_rejoin_fault_spec():
+    """The multiprocess runner cannot revive a crashed client process:
+    a rejoin: directive must die at startup, not silently never fire."""
+    from neuroimagedisttraining_tpu.distributed.run import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["--role", "client", "--rank", "1", "--num_clients", "2",
+              "--fault_spec", "crash:1@1,rejoin:1@3"])
+    assert e.value.code == 2
+
+
+def test_quarantine_discard_keeps_accounting_reconciled():
+    """An upload accepted into the buffer and then discarded because
+    THIS aggregation's outlier scoring quarantined its sender is the
+    one way accepted work is never aggregated — the audit must account
+    it explicitly, and the quarantined silo is excluded from the very
+    aggregation that convicted it."""
+    srv = _server(num_clients=3, buffer_k=3, comm_round=50,
+                  quarantine_rounds=3, outlier_threshold=1)
+    srv._on_model(_upload(1, _tree(1), 4.0, version=0, seq=0))
+    srv._on_model(_upload(2, _tree(2), 4.0, version=0, seq=0))
+    srv._on_model(_upload(3, _tree(3, scale=1e4), 4.0, version=0,
+                          seq=0))
+    assert srv.round_idx == 1
+    assert srv.quarantined_clients() == {3}
+    assert srv.history[-1]["contributors"] == [1, 2]
+    audit = srv.upload_audit()
+    assert audit["quarantine_discarded"] == 1
+    assert audit["accepted"] == 3 and audit["aggregated"] == 2
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+
+
+# ------------------------------------------------ startup rejections
+
+
+def test_async_misconfig_fails_at_startup():
+    with pytest.raises(ValueError, match="no round barrier"):
+        _server(round_deadline=5.0)
+    with pytest.raises(ValueError, match="staleness_alpha"):
+        _server(staleness_alpha=-1.0)
+    with pytest.raises(ValueError, match="max_staleness"):
+        _server(max_staleness=-1)
+    # an order-statistic defense must be feasible over the BUFFER
+    with pytest.raises(ValueError, match="trimmed_mean"):
+        _server(num_clients=8, buffer_k=2, defense="trimmed_mean",
+                byz_f=1)
+    # ... and over the COHORT: one slot per sender caps every real
+    # aggregation at num_clients, so buffer_k > cohort must not slip an
+    # infeasible defense past the startup check (it would silently fall
+    # back to the plain mean on every aggregation)
+    with pytest.raises(ValueError, match="krum"):
+        _server(num_clients=3, buffer_k=8, defense="krum", byz_f=1)
+
+
+def test_run_cli_rejects_async_combos():
+    from neuroimagedisttraining_tpu.distributed.run import main
+
+    for extra in (["--secure"], ["--transport", "broker"],
+                  ["--round_deadline", "5"]):
+        with pytest.raises(SystemExit) as e:
+            main(["--role", "server", "--num_clients", "2",
+                  "--async_server", *extra])
+        assert e.value.code == 2
+
+
+def test_config_roundtrips_async_fields():
+    from neuroimagedisttraining_tpu.config import (
+        ExperimentConfig, FedConfig,
+    )
+
+    cfg = ExperimentConfig(fed=FedConfig(
+        async_server=True, buffer_k=7, staleness_alpha=0.25,
+        max_staleness=11))
+    back = ExperimentConfig.from_dict(
+        __import__("json").loads(cfg.to_json()))
+    assert back.fed.async_server is True
+    assert back.fed.buffer_k == 7
+    assert back.fed.staleness_alpha == 0.25
+    assert back.fed.max_staleness == 11
+
+
+# ------------------------------------------------ selector comm core
+
+
+class _Collector(Observer):
+    def __init__(self):
+        self.msgs = []
+        self.evt = threading.Event()
+
+    def receive_message(self, msg_type, msg):
+        self.msgs.append(msg)
+        self.evt.set()
+
+
+def _raw_frame(msg):
+    raw = msg.to_bytes()
+    return struct.pack("!Q", len(raw)) + raw
+
+
+def _mk_selector(n=4):
+    port = free_port_block(2)
+    mgr = SelectorCommManager(0, n, base_port=port,
+                              max_pending_frames=4, send_timeout=5.0)
+    col = _Collector()
+    mgr.add_observer(col)
+    t = threading.Thread(target=mgr.handle_receive_message, daemon=True)
+    t.start()
+    return mgr, col, port, t
+
+
+def test_selector_survives_midframe_disconnect_and_malformed():
+    mgr, col, port, t = _mk_selector()
+    try:
+        # 1: a peer promises 100 bytes, sends 10, slams the connection
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(struct.pack("!Q", 100) + b"x" * 10)
+        # 2: a peer sends garbage with a valid length prefix
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(struct.pack("!Q", 5) + b"junk!")
+        # 3: a well-formed frame still gets through afterwards
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            s.sendall(_raw_frame(M.Message("hello", 3, 0)))
+        assert col.evt.wait(5.0)
+        assert [m.msg_type for m in col.msgs] == ["hello"]
+        stats = mgr.byte_stats()
+        assert stats["frames_recv"] == 1  # torn/garbage never counted
+    finally:
+        mgr.stop_receive_message()
+        t.join(5.0)
+
+
+def test_selector_slow_reader_backpressure_loses_nothing():
+    """A reader that stops draining must stall the sender on the bounded
+    write queue — and once it resumes, every frame arrives intact and in
+    order (bytes are never dropped, never interleaved)."""
+    mgr, col, port, t = _mk_selector()
+    n_frames, payload = 12, np.zeros(1_000_000, np.uint8)
+    sent_done = threading.Event()
+    try:
+        # a small receive window keeps the kernel from absorbing the
+        # whole burst — the pressure must land on the write queue
+        cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        cli.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 32768)
+        cli.connect(("127.0.0.1", port))
+        reg = M.Message(M.MSG_TYPE_C2S_REGISTER, 2, 0)
+        reg.add(M.ARG_CONN_PERSISTENT, True)
+        cli.sendall(_raw_frame(reg))
+        assert col.evt.wait(5.0)  # rank 2 is now routable
+
+        def _send_all():
+            for i in range(n_frames):
+                msg = M.Message("bulk", 0, 2)
+                msg.add("i", i)
+                msg.add("blob", payload)
+                mgr.send_message(msg)
+            sent_done.set()
+
+        sender = threading.Thread(target=_send_all, daemon=True)
+        sender.start()
+        # the un-drained client caps the queue: the sender must still be
+        # blocked after a grace period (4-frame bound << 12 frames)
+        time.sleep(0.5)
+        assert not sent_done.is_set(), \
+            "sender ran ahead of the bounded write queue"
+        # drain everything client-side; each frame must parse
+        got = []
+        buf = b""
+        cli.settimeout(10.0)
+        while len(got) < n_frames:
+            while len(buf) < 8:
+                buf += cli.recv(65536)
+            (length,) = struct.unpack("!Q", buf[:8])
+            while len(buf) < 8 + length:
+                buf += cli.recv(65536)
+            got.append(M.Message.from_bytes(buf[8:8 + length]))
+            buf = buf[8 + length:]
+        assert sent_done.wait(10.0)
+        assert [int(m.get("i")) for m in got] == list(range(n_frames))
+        assert all(np.asarray(m.get("blob")).nbytes == payload.nbytes
+                   for m in got)
+        assert mgr.byte_stats()["frames_sent"] == n_frames
+        cli.close()
+    finally:
+        mgr.stop_receive_message()
+        t.join(5.0)
+
+
+# ------------------------------------------------ e2e with real clients
+
+
+def test_threaded_clients_and_codec_against_async_server():
+    """The existing threaded client side plugs in unchanged: two
+    FedAvgClientProc (legacy dial-in transport, delta wire codec)
+    complete a 4-aggregation federation against the buffered server,
+    with at least one stale contribution decoded through the ring."""
+    port = free_port_block(8)
+    init = {"params": {"w": np.zeros(16, np.float32)}}
+    srv = BufferedFedAvgServer(init, 4, 2, buffer_k=1, max_staleness=10,
+                               base_port=port)
+    st = threading.Thread(target=srv.run, daemon=True)
+    st.start()
+
+    def mk_train(delta):
+        def train_fn(params, round_idx):
+            w = np.asarray(params["params"]["w"]) + np.float32(delta)
+            return {"params": {"w": w}}, 5.0
+        return train_fn
+
+    clients = [FedAvgClientProc(r, 2, mk_train(0.1 * r), base_port=port,
+                                wire_codec="delta") for r in (1, 2)]
+    cts = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for ct in cts:
+        ct.start()
+    st.join(60.0)
+    for ct in cts:
+        ct.join(20.0)
+    assert srv._done.is_set()
+    assert srv.round_idx == 4
+    audit = srv.upload_audit()
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+    assert audit["accepted"] == 4
+    assert np.all(np.isfinite(srv.params["params"]["w"]))
+
+
+# ------------------------------------------------ load harness
+
+
+@pytest.mark.slow
+def test_loadgen_200_clients_with_churn_smoke():
+    from neuroimagedisttraining_tpu.asyncfl.loadgen import run_load
+
+    r = run_load(mode="async", num_clients=200, aggregations=10,
+                 buffer_k=40, max_staleness=50,
+                 fault_spec="crash:7@2,rejoin:7@6,crash:11@3", seed=3)
+    assert r["rounds_or_aggregations"] == 10
+    assert r["peak_connections"] >= 200
+    assert r["frames_reconciled"], r
+    assert r["upload_audit"]["received_accounted"]
+    assert r["upload_audit"]["accepted_accounted"]
+    assert r["client_stats"]["crashes"] >= 2
+    assert r["client_stats"]["rejoins"] >= 1
+    assert r["client_stats"]["errors"] == 0

@@ -297,6 +297,7 @@ def test_finite_per_client_and_replacement():
 # ------------------------------------------------- engine integration
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): single-engine behavioral e2e, engine keeps dispatch/stream/cohort coverage
 def test_engine_nonfinite_guard_independent_of_defense(tmp_path,
                                                        synthetic_cohort):
     """A silo uploading NaN every round must not poison the aggregate —

@@ -106,7 +106,10 @@ def _one_round_outputs(eng):
     raise AssertionError(eng.name)
 
 
-@pytest.mark.parametrize("algorithm", ["fedavg", "salientgrads", "ditto"])
+@pytest.mark.parametrize("algorithm", [
+    "fedavg", "salientgrads",
+    pytest.param("ditto", marks=pytest.mark.slow),  # tier-1 window (PR 7)
+])
 def test_donated_round_bitwise_equals_undonated(tmp_path, synthetic_cohort,
                                                 algorithm):
     out_d = _one_round_outputs(
@@ -163,7 +166,7 @@ def test_fused_driver_bitwise_equal_sequential_fedavg(tmp_path,
     # fedprox shares FedAvg's program shape (a prox op on top) — its
     # variant rides the full suite; tier-1 keeps the two distinct shapes
     pytest.param("fedprox", marks=pytest.mark.slow),
-    "salientgrads",
+    pytest.param("salientgrads", marks=pytest.mark.slow),  # tier-1 window (PR 7): fedavg twin stays
 ])
 def test_fused_program_bitwise_equal_sequential(tmp_path, synthetic_cohort,
                                                 algorithm):

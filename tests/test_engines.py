@@ -38,6 +38,7 @@ def _engine(tmp_path, cohort, algorithm, comm_round=2, **fed_kw):
     return create_engine(algorithm, cfg, fed, trainer, mesh=mesh, logger=log)
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): single-engine behavioral e2e, engine keeps dispatch/stream/cohort coverage
 def test_local_engine_personal_models_diverge(tmp_path, synthetic_cohort):
     engine = _engine(tmp_path, synthetic_cohort, "local")
     result = engine.train()
@@ -47,6 +48,7 @@ def test_local_engine_personal_models_diverge(tmp_path, synthetic_cohort):
     assert np.isfinite(result["history"][-1]["train_loss"])
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): single-engine behavioral e2e, engine keeps dispatch/stream/cohort coverage
 def test_ditto_personal_pulled_toward_global(tmp_path, synthetic_cohort):
     engine = _engine(tmp_path, synthetic_cohort, "ditto", lamda=0.5,
                      local_epochs=1)
@@ -62,6 +64,7 @@ def test_ditto_personal_pulled_toward_global(tmp_path, synthetic_cohort):
     assert not np.allclose(np.asarray(g), np.asarray(p[0]))
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): single-engine behavioral e2e, engine keeps dispatch/stream/cohort coverage
 def test_fedprox_end_to_end_and_prox_pull_direction(tmp_path,
                                                     synthetic_cohort):
     """BASELINE.json configs[3] (FedProx half): the engine trains, and a
@@ -199,6 +202,7 @@ def _dispfl_engine(tmp_path, cohort, sparsity=None, **fed_kw):
     return create_engine("dispfl", cfg, fed, trainer, mesh=mesh, logger=log)
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): single-engine behavioral e2e, engine keeps dispatch/stream/cohort coverage
 def test_dispfl_end_to_end_with_dropout(tmp_path, synthetic_cohort):
     """active=0.7 fault injection: rounds run, metrics finite, masks evolve."""
     engine = _dispfl_engine(tmp_path, synthetic_cohort, active=0.7)
@@ -319,6 +323,7 @@ def test_subavg_end_to_end_prunes(tmp_path, synthetic_cohort):
     assert np.all(result["client_densities"] > 0.0)
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): single-engine behavioral e2e, engine keeps dispatch/stream/cohort coverage
 def test_subavg_accept_test_rejects(tmp_path, synthetic_cohort):
     """Impossible acc threshold -> no prune ever accepted, masks stay ones."""
     from neuroimagedisttraining_tpu.config import SparsityConfig
@@ -411,6 +416,7 @@ def test_fedfomo_partial_participation_uses_fomo_m(tmp_path,
     assert np.isfinite(result["history"][-1]["train_loss"])
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): single-engine behavioral e2e, engine keeps dispatch/stream/cohort coverage
 def test_fedfomo_neighbor_masked_eval_count(tmp_path, synthetic_cohort):
     """The val-loss/distance matrices are computed only at neighbor pairs
     (reference evaluates just the RECEIVED models, fedfomo_api.py:147-171):

@@ -96,6 +96,48 @@ def test_schedule_crash_semantics():
                                   np.arange(2))
 
 
+def test_rejoin_directive_parse_and_crash_windows():
+    """``rejoin:RANK@ROUND`` (ISSUE 7) ends a deterministic crash
+    window: crash/rejoin/crash directives alternate, and parse
+    validation rejects a rejoin with no earlier crash to return from."""
+    s = FaultSchedule(parse_fault_spec("crash:3@1,rejoin:3@4,crash:3@6"),
+                      seed=0)
+    assert [s.crashed(r, 3) for r in range(8)] == [
+        False, True, True, True, False, False, True, True]
+    # crash_round sees the FIRST window; survivors honor the rejoin
+    assert s.crash_round(3, horizon=10) == 1
+    np.testing.assert_array_equal(
+        s.survivors(4, np.arange(4)), np.arange(4))  # rank 3 is back
+    np.testing.assert_array_equal(
+        s.survivors(2, np.arange(4)), np.asarray([0, 1, 3]))
+    with pytest.raises(ValueError, match="rejoin:2@3 has no crash"):
+        parse_fault_spec("rejoin:2@3")
+    with pytest.raises(ValueError, match="no crash"):
+        # a rejoin must be STRICTLY after the crash it ends
+        parse_fault_spec("crash:2@5,rejoin:2@5")
+    with pytest.raises(ValueError, match="share a\n?.*round|share a round"):
+        # ... and a LATER crash may not tie an existing rejoin either —
+        # the event walk's 'rounds never tie' invariant is validated,
+        # not assumed (a tie would silently cancel the rejoin)
+        parse_fault_spec("crash:2@1,rejoin:2@5,crash:2@5")
+    # probabilistic crashes stay permanent: rejoin only pairs with
+    # deterministic crash directives
+    assert parse_fault_spec("crash:1@0,rejoin:1@2,crash_prob:0.5")
+
+
+def test_rejoin_schedule_replays_identically():
+    """The replay acceptance property extends to rejoin windows: the
+    full trace is a pure function of (spec, seed), any query order."""
+    text = "crash:2@1,rejoin:2@3,crash:4@2,rejoin:4@5,drop:0.2"
+    a = FaultSchedule(parse_fault_spec(text), seed=11)
+    b = FaultSchedule(parse_fault_spec(text), seed=11)
+    tb = [b.crashed(r, k) for r in reversed(range(7))
+          for k in reversed(range(1, 6))]
+    ta = [a.crashed(r, k) for r in range(7) for k in range(1, 6)]
+    assert ta == list(reversed(tb))
+    assert a.trace(7, range(6)) == b.trace(7, range(6))
+
+
 def test_activity_mask_matches_legacy_dispfl_formula():
     """The unified draw reproduces engines/dispfl.py's historical inline
     formula bit-for-bit, so seeds keep their meaning."""

@@ -262,6 +262,7 @@ def test_sparse_plan_rejects_dense_and_single_row_blocks():
     assert sparse_plan(_k_regular(8, 3, seed=0), mesh, 8) is None
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): heavy twin/artifact test, core pin covered by a lighter tier-1 sibling
 def test_dpsgd_random_round_sparse_matches_einsum(tmp_path):
     """Engine-level: a D-PSGD cs=random round (fresh k-regular draw) takes
     the routed-all_to_all plan and produces the same state as the

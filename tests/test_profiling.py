@@ -11,6 +11,7 @@ from neuroimagedisttraining_tpu.utils.profiling import (
 )
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): heavy twin/artifact test, core pin covered by a lighter tier-1 sibling
 def test_profile_trace_writes_artifacts(tmp_path):
     d = str(tmp_path / "trace")
     with profile_trace(d):

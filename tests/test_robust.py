@@ -101,6 +101,7 @@ def test_fedavg_with_defense_runs(tmp_path, synthetic_cohort):
     assert np.isfinite(result["history"][-1]["train_loss"])
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): heavy twin/artifact test, core pin covered by a lighter tier-1 sibling
 def test_fedavg_round_clipping_bounds_byzantine_update(tmp_path,
                                                        synthetic_cohort):
     """Engine-level: poison one client's data so its gradients explode;

@@ -82,6 +82,7 @@ def _scale_engine(tmp_path, cohort, algorithm, streaming=False, **fed_kw):
     return eng
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): heavy twin/artifact test, core pin covered by a lighter tier-1 sibling
 def test_fedavg_100clients_resident(tmp_path, scale_cohort):
     engine = _scale_engine(tmp_path, scale_cohort, "fedavg")
     assert engine.real_clients == C

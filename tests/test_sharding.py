@@ -159,6 +159,7 @@ def test_fedavg_round_identical_on_flat_and_two_level_mesh(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 window (PR 7): heavy twin/artifact test, core pin covered by a lighter tier-1 sibling
 def test_salientgrads_round_identical_on_flat_and_two_level_mesh(tmp_path):
     """VERDICT r4 #1: the FLAGSHIP's aggregation now routes through the
     silo-aware path — a masked SalientGrads round on the (2,4) silo mesh
