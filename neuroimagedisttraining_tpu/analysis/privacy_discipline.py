@@ -1,0 +1,81 @@
+"""Privacy-plane key discipline: DP noise and share-mask randomness must
+derive from config-threaded streams.
+
+The privacy guarantees (privacy/accountant.py's epsilon report, the
+secure_quant masking) are statements about WHERE randomness came from:
+noise drawn from an ad-hoc PRNG root minted at the call site is
+unauditable (the accountant charges for noise whose stream nothing
+pins), and a numpy global-stream draw is order-dependent across threads
+— the determinism family's objection, sharpened here because a
+perturbed noise stream silently changes the privacy the run actually
+delivered.
+
+- ``dp-key-discipline`` — inside ``privacy/`` modules, constructing a
+  jax PRNG root (``jax.random.key`` / ``jax.random.PRNGKey``) is
+  flagged: keys must be threaded in as arguments by the caller, derived
+  (``fold_in`` / ``split``) from the config seed. Repo-wide, calling
+  ``add_weak_dp_noise`` (core/robust.py) with an INLINE-minted root as
+  its rng argument is flagged for the same reason.
+
+The determinism family already covers numpy global-stream draws
+repo-wide (privacy/ included — nidtlint walks the whole package); this
+family adds the jax-key provenance rule the DP paths need on top.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from neuroimagedisttraining_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    normalize,
+    register,
+)
+
+_KEY_ROOTS = {"jax.random.key", "jax.random.PRNGKey"}
+
+
+def _is_key_root(node: ast.AST, aliases: dict[str, str]) -> bool:
+    return (isinstance(node, ast.Call)
+            and normalize(dotted_name(node.func), aliases) in _KEY_ROOTS)
+
+
+@register
+class PrivacyKeyDisciplineRule(Rule):
+    rule_ids = ("dp-key-discipline",)
+    description = ("privacy/ modules must not mint jax PRNG roots "
+                   "(jax.random.key/PRNGKey) — noise/mask keys are "
+                   "threaded in from config by the caller; repo-wide, "
+                   "add_weak_dp_noise must not take an inline-minted "
+                   "root as its rng")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        in_privacy = "privacy" in mod.path_parts
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if in_privacy and _is_key_root(node, mod.aliases):
+                yield Finding(
+                    mod.path, node.lineno, "dp-key-discipline",
+                    "jax PRNG root minted inside privacy/ — thread a "
+                    "config-derived key in as an argument instead (the "
+                    "accountant's epsilon is only meaningful for noise "
+                    "whose stream the config pins)")
+                continue
+            fname = dotted_name(node.func)
+            if fname and fname.split(".")[-1] == "add_weak_dp_noise":
+                args = list(node.args) + [kw.value for kw in node.keywords
+                                          if kw.arg == "rng"]
+                for a in args:
+                    if _is_key_root(a, mod.aliases):
+                        yield Finding(
+                            mod.path, node.lineno, "dp-key-discipline",
+                            "add_weak_dp_noise called with an inline "
+                            "jax.random.key(...) root — fold the key "
+                            "from the config seed (fold_in per "
+                            "round/client) so the noise stream is "
+                            "auditable and replayable")

@@ -115,7 +115,8 @@ class BufferedFedAvgServer(FedAvgServer):
                  buffer_k: int = 0, staleness_alpha: float = 0.5,
                  max_staleness: int = 20, world_size: int | None = None,
                  host_map: dict[int, str] | None = None,
-                 base_port: int | None = None, comm=None, **kw):
+                 base_port: int | None = None, comm=None,
+                 secure_quant=None, **kw):
         from neuroimagedisttraining_tpu.core import robust
 
         # --- async knobs fail loudly HERE (startup), never mid-run ---
@@ -135,6 +136,78 @@ class BufferedFedAvgServer(FedAvgServer):
                 "BufferedFedAvgServer has no round barrier: "
                 "round_deadline/quorum do not apply (uploads aggregate "
                 "every buffer_k arrivals instead)")
+        # secure QUANTIZED aggregation composes with the buffer (ISSUE
+        # 8): the one-phase protocol has no weight exchange — clients
+        # ship field-element frames of their UNWEIGHTED quantized update
+        # + n in the clear, and the staleness weights fold inside the
+        # field as integers (privacy/secure_quant.integer_weights). The
+        # dense two-phase --secure protocol remains rejected at the CLI
+        # (its weight exchange IS a round barrier). What secure mode
+        # costs here: no codec delta transport (frames are not deltas),
+        # no non-finite gate, no server-side defenses or outlier scoring
+        # (the server never sees plaintext), and frames fold UNSCALED —
+        # per-version references would disagree on leaf scales, so
+        # raw-moment leaves (BatchNorm stats) lean on the 32-bit
+        # field's range margin instead. ARCHITECTURE.md "Privacy plane"
+        # carries the full matrix.
+        self.secure_quant = secure_quant
+        if secure_quant is not None:
+            from neuroimagedisttraining_tpu.privacy import check_headroom
+
+            if kw.get("defense", "none") in robust.ROBUST_AGGREGATORS \
+                    or kw.get("quarantine_rounds", 0):
+                raise ValueError(
+                    "secure_quant supports neither order-statistic "
+                    "defenses nor quarantine on the buffered server: "
+                    "the buffer holds masked field elements, not "
+                    "per-silo updates (clip-family defenses run client-"
+                    "side; see ARCHITECTURE.md 'Privacy plane')")
+            if kw.get("wire_masks") is not None:
+                raise ValueError(
+                    "secure_quant is incompatible with the wire codec "
+                    "mask handoff (field-element frames, not model "
+                    "floats)")
+            check_headroom(secure_quant, min(self.buffer_k,
+                                             int(num_clients)))
+            from neuroimagedisttraining_tpu.privacy import secure_quant \
+                as sq
+
+            # one-phase folding applies the (integer-scaled) staleness
+            # weights INSIDE the field, so the aggregate range scales
+            # with the folded weight mass. The value bound the capacity
+            # is stated against starts from the init model's ACTUAL
+            # leaf magnitudes (doubled for drift) — raw-moment leaves
+            # like BatchNorm stats dwarf VALUE_BOUND; growth beyond 2x
+            # the largest observed startup magnitude still leans on the
+            # 32-bit field's remaining margin (frames fold UNSCALED —
+            # see the protocol note above). This check precludes
+            # weight-mass overflow under that bound, never value growth
+            # it cannot observe.
+            import jax
+
+            init_mag = max((float(np.max(np.abs(
+                np.asarray(x, np.float64))))
+                for x in jax.tree.leaves(init_params)
+                if np.asarray(x).size), default=0.0)
+            self._sq_value_bound = max(sq.VALUE_BOUND, 2.0 * init_mag)
+            k_cap = min(self.buffer_k, int(num_clients))
+            cap = sq.weighted_fold_capacity(secure_quant,
+                                            self._sq_value_bound)
+            if cap <= k_cap:
+                raise ValueError(
+                    f"secure_quant field too small for the buffered "
+                    f"one-phase fold: capacity {cap:.1f} weight units "
+                    f"< buffer of {k_cap} at value bound "
+                    f"{self._sq_value_bound:.0f} — use "
+                    "--secure_quant_field_bits 32 (the two-phase sync "
+                    "protocol keeps the small field; see "
+                    "ARCHITECTURE.md 'Privacy plane')")
+            #: expected frame leaf structure, computed ONCE — the
+            #: admission gate compares every upload against it on the
+            #: single dispatch thread (the model structure is fixed for
+            #: the run; version skew is exactly what the compare rejects)
+            self._sq_sizes = [(n, int(np.asarray(x).size))
+                              for n, x in sq._named_leaves(init_params)]
         if comm is None:
             # replies run on the single dispatch thread under _rlock: a
             # peer that uploads but stops READING would otherwise stall
@@ -192,6 +265,10 @@ class BufferedFedAvgServer(FedAvgServer):
             # accepted into the buffer, then discarded because THIS
             # aggregation's outlier scoring quarantined the sender
             "quarantine_discarded": 0,
+            # accepted, then discarded whole-buffer because a secure-
+            # quant aggregation failed mid-fold (structure skew past
+            # the admission gate) — the model stayed at its version
+            "aggregation_discarded": 0,
             # accepted, then replaced by a NEWER accepted upload from
             # the same sender before the buffer filled (one slot per
             # sender per aggregation — see _accept_async)
@@ -320,6 +397,43 @@ class BufferedFedAvgServer(FedAvgServer):
                         "%d (version %d; window ends at version %d)",
                         c, self.round_idx, self._quarantine_until[c])
             return False
+        if self.secure_quant is not None:
+            from neuroimagedisttraining_tpu.privacy import secure_quant as sq
+
+            frame = msg.get(M.ARG_MODEL_PARAMS)
+            try:
+                sq._validate_frame(frame, self.secure_quant)
+                # structure gate at ADMISSION (the plain path's decode
+                # gate, transposed): a frame whose leaf set differs from
+                # the model must never reach the aggregation fold, where
+                # it would be a mid-buffer failure instead of a drop
+                if sq.SlotAccumulator._frame_sizes(frame) != \
+                        self._sq_sizes:
+                    raise ValueError(
+                        "frame leaf structure differs from the model "
+                        "(version skew)")
+            except (ValueError, KeyError, TypeError) as e:
+                self.upload_stats["dropped_undecodable"] += 1
+                log.warning("server: dropping invalid secure-quant frame "
+                            "from %d (base version %d): %s", c, v, e)
+                return False
+            n = float(msg.get(M.ARG_NUM_SAMPLES))
+            if not (np.isfinite(n) and n >= 0):
+                # a NaN sample count would silently collapse the whole
+                # buffer's integer fold weights to uniform — treat it as
+                # the malformed field it is (raise into _on_model's
+                # dropped_malformed accounting, dispatch thread lives)
+                raise ValueError(f"non-finite num_samples {n!r}")
+            if seq is None:
+                self._contributed.setdefault(c, set()).add(v)
+            # no delta transport for stale frames (the server cannot see
+            # the update to re-anchor it) and no non-finite gate (masked
+            # field elements are always finite by construction — the
+            # quantize maps a client-side NaN to the neutral zero
+            # residue, never into the aggregate) — staleness is handled
+            # by the down-weighting alone
+            self._buffer_put(c, tau, n, {"frame": frame})
+            return True
         ref = self._ring[v]  # present by construction: tau <= ring span
         try:
             decoded = codec.decode_update(msg.get(M.ARG_MODEL_PARAMS),
@@ -347,6 +461,12 @@ class BufferedFedAvgServer(FedAvgServer):
                 self._contributed.setdefault(c, set()).add(v)
             return False
         n = float(msg.get(M.ARG_NUM_SAMPLES))
+        if not (np.isfinite(n) and n >= 0):
+            # a NaN/negative sample count poisons the staleness weight
+            # and, under weak_dp, the accountant's geometry — malformed
+            # field, same contract as the secure branch (raises into
+            # _on_model's dropped_malformed accounting)
+            raise ValueError(f"non-finite num_samples {n!r}")
         if tau == 0:
             u_eff = decoded  # bitwise passthrough (the equivalence pin)
         else:
@@ -364,14 +484,22 @@ class BufferedFedAvgServer(FedAvgServer):
                 decoded, self.params, ref)
         if seq is None:  # the watermark already advanced at the gate
             self._contributed.setdefault(c, set()).add(v)
-        # ONE buffer slot per sender: a client that laps the buffer
-        # (trains faster than it fills) REPLACES its older entry rather
-        # than occupying extra slots. This is what keeps the armed
-        # defense's threat model sound — robust._check_f(buffer_k,
-        # byz_f) bounds Byzantine ENTRIES, and without the cap a fast
-        # sign-flipping client could fill f+1 slots by pace alone — and
-        # it keeps the aggregation weighting unbiased toward fast
-        # clients (FedBuff's one-contribution-per-client shape).
+        self._buffer_put(c, tau, n, {"tree": u_eff})
+        return True
+
+    def _buffer_put(self, c: int, tau: int, n: float,
+                    payload: dict) -> None:
+        """Under ``_rlock``: ONE buffer slot per sender — a client that
+        laps the buffer (trains faster than it fills) REPLACES its
+        older entry rather than occupying extra slots. This is what
+        keeps the armed defense's threat model sound —
+        robust._check_f(buffer_k, byz_f) bounds Byzantine ENTRIES, and
+        without the cap a fast sign-flipping client could fill f+1
+        slots by pace alone — and it keeps the aggregation weighting
+        unbiased toward fast clients (FedBuff's one-contribution-per-
+        client shape). Shared by the plain ({"tree": ...}) and
+        secure-quant ({"frame": ...}) admission paths so the invariant
+        lives in exactly one place."""
         for i, e in enumerate(self._buffer):
             if e["client"] == c:
                 del self._buffer[i]
@@ -381,9 +509,9 @@ class BufferedFedAvgServer(FedAvgServer):
                          e["tau"], tau)
                 break
         self._buffer.append({
-            "client": c, "tree": u_eff, "n": n, "tau": tau,
-            "weight": staleness_weight(n, tau, self.staleness_alpha)})
-        return True
+            "client": c, "n": n, "tau": tau,
+            "weight": staleness_weight(n, tau, self.staleness_alpha),
+            **payload})
 
     # ---- aggregation ----
 
@@ -401,6 +529,9 @@ class BufferedFedAvgServer(FedAvgServer):
         # over the same upload set produce the same model bitwise — the
         # exact reason the synchronous server sorts its senders
         entries = sorted(self._buffer, key=lambda e: e["client"])
+        if self.secure_quant is not None:
+            self._aggregate_buffer_secure(entries)
+            return
         senders = [e["client"] for e in entries]
         trees = [e["tree"] for e in entries]
         self._score_survivors(senders, trees)
@@ -423,6 +554,7 @@ class BufferedFedAvgServer(FedAvgServer):
         senders = [e["client"] for e in entries]
         defense = robust.effective_defense(
             self.defense, len(entries), self.byz_f, warn=log.warning)
+        extra = None
         if defense == "none":
             self.params = survivor_weighted_mean(trees, ws)
         else:
@@ -436,11 +568,67 @@ class BufferedFedAvgServer(FedAvgServer):
                 rngs = jax.vmap(
                     lambda s: jax.random.fold_in(base, s))(
                     jnp.asarray(senders, jnp.uint32))
+                dp = self._note_weak_dp(senders, ws)
+                extra = {"weak_dp": dp} if dp is not None else None
             self.params = survivor_defended_mean(
                 trees, ws, self.params, defense=defense,
                 byz_f=self.byz_f, geomed_iters=self.geomed_iters,
                 norm_bound=self.norm_bound, stddev=self.stddev,
                 rngs=rngs)
+        self._advance_version(entries, senders, extra=extra)
+
+    def _aggregate_buffer_secure(self, entries: list) -> None:
+        """Under ``_rlock``: one buffered aggregation over secure-quant
+        field-element frames. Staleness weights fold INSIDE the field as
+        deterministic integer scalings (``integer_weights`` — the
+        largest fixed-point scale whose total keeps the aggregate in
+        headroom, re-derived per buffer so a replay is bitwise); the
+        dequantized total divided by the integer weight mass is the
+        staleness-weighted mean of the quantized updates. No plaintext
+        ever materializes, so outlier scoring and server-side defenses
+        are structurally out (rejected at startup); the weak_dp ledger
+        still charges (the noise was added client-side, its geometry is
+        config)."""
+        from neuroimagedisttraining_tpu.privacy import (
+            SlotAccumulator, integer_weights,
+        )
+
+        senders = [e["client"] for e in entries]
+        ws = [e["weight"] for e in entries]
+        try:
+            w_int, denom = integer_weights(ws, self.secure_quant,
+                                           self._sq_value_bound)
+            acc = SlotAccumulator(self.secure_quant, like=self.params)
+            for e, wi in zip(entries, w_int):
+                acc.fold(e["frame"], weight_int=int(wi))
+            new_params = acc.finalize(like=self.params,
+                                      rescale=1.0 / denom)
+        except (ValueError, KeyError, TypeError) as e:
+            # belt over the admission gate's braces: a fold failure here
+            # must cost one buffer, never the dispatch thread (this
+            # server's own 'a dropped upload, never a dead dispatch
+            # thread' contract) — the model stays at its last version
+            # and the federation keeps moving
+            log.error("server: secure-quant aggregation at version %d "
+                      "failed (%s: %s) - discarding the %d-upload "
+                      "buffer, model unchanged", self.round_idx,
+                      type(e).__name__, e, len(entries))
+            self.upload_stats["aggregation_discarded"] += len(entries)
+            self._buffer = []
+            return
+        self.params = new_params
+        extra = {"secure_quant": True,
+                 "weights_int": [int(w) for w in w_int]}
+        if self.defense == "weak_dp":
+            dp = self._note_weak_dp(senders, ws)
+            if dp is not None:
+                extra["weak_dp"] = dp
+        self._advance_version(entries, senders, extra=extra)
+
+    def _advance_version(self, entries: list, senders: list,
+                         extra: dict | None = None) -> None:
+        """Under ``_rlock``: the shared post-aggregation transition —
+        version++, ring/dedup maintenance, history, finish."""
         self._buffer = []
         self.round_idx += 1
         self._ring[self.round_idx] = self.params
@@ -456,7 +644,7 @@ class BufferedFedAvgServer(FedAvgServer):
             "contributors": senders,
             "taus": [int(e["tau"]) for e in entries],
             "weights": [float(e["weight"]) for e in entries],
-            "t": time.monotonic()})
+            "t": time.monotonic(), **(extra or {})})
         if self.round_idx >= self.comm_round:
             self._broadcast_finish()
             self._done.set()
@@ -520,5 +708,6 @@ class BufferedFedAvgServer(FedAvgServer):
                 "accepted_accounted":
                     s["accepted"] == (aggregated + len(self._buffer)
                                       + s["quarantine_discarded"]
+                                      + s["aggregation_discarded"]
                                       + s["superseded_in_buffer"]),
             }

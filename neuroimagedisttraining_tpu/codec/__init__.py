@@ -11,6 +11,7 @@ cross-silo federation would.
 from neuroimagedisttraining_tpu.codec.wire import (  # noqa: F401
     FRAME_KEY,
     FRAME_VERSION,
+    SECURE_QUANT_KEY,
     WireSpec,
     decode_update,
     encode_update,

@@ -65,6 +65,14 @@ PyTree = Any
 FRAME_KEY = "__nidt_codec__"
 FRAME_VERSION = 1
 
+#: magic of the OTHER tagged body on this wire: secure-quantized
+#: field-element frames (privacy/secure_quant.py). Defined here — not in
+#: privacy/ — so this module can recognize and loudly reject one that
+#: reaches the PLAIN decode path (a masked GF(p) residue array decoded
+#: as a dense float tree would silently poison the aggregate), without a
+#: codec -> privacy import cycle.
+SECURE_QUANT_KEY = "__nidt_secure_quant__"
+
 _QUANT_MODES = ("", "int8", "bf16")
 # sparse-record modes: how the receiver learns the support
 _SP_DENSE = 0      # all values shipped
@@ -338,6 +346,14 @@ def decode_update(obj: Any, *, like: PyTree,
     """
     from flax import serialization
 
+    if isinstance(obj, dict) and SECURE_QUANT_KEY in obj:
+        raise ValueError(
+            "received a secure-quant field-element frame on the plain "
+            "decode path: its values are masked GF(p) residues, not "
+            "model floats — the receiver must run the secure-quant "
+            "server (--secure_quant on every rank; see "
+            "privacy/secure_quant.py and ARCHITECTURE.md 'Privacy "
+            "plane')")
     if not is_codec_frame(obj):
         return obj  # dense fallback: always decodable
     ver = obj[FRAME_KEY]

@@ -122,6 +122,27 @@ class FedConfig:
     # communication boundary (the multi-aggregator cross-silo deployment
     # always uses the host toolkit — it crosses real process boundaries)
     mpc_backend: str = "device"
+    # Secure QUANTIZED aggregation (privacy/secure_quant.py, ISSUE 8):
+    # uploads become field-element frames in GF(p) for the largest prime
+    # below 2^field_bits — one wire-dtype residue per parameter plus
+    # seed-expanded mask slots, vs the dense secure protocol's n_shares
+    # int64 stacks. These fields mirror distributed/run.py's
+    # --secure_quant* flags (the encoded secure wire lives on the
+    # cross-silo/async control planes; the simulated engines' jitted
+    # counterpart is ops/mpc_device.py at this same (p, frac_bits)).
+    secure_quant: bool = False
+    secure_quant_field_bits: int = 16
+    secure_quant_frac_bits: int = 10
+    # Round-level differential privacy for the dpsgd engine (privacy/
+    # accountant.py, ISSUE 8): every client's post-training update delta
+    # vs its consensus point is clipped to dp_clip and noised with
+    # N(0, (dp_sigma * dp_clip)^2) INSIDE the jitted round (keys folded
+    # from the config seed), and the RDP accountant reports the running
+    # per-silo (epsilon, dp_delta) in stat_info. 0 disables; dp_sigma>0
+    # requires dp_clip>0 (the clip IS the sensitivity bound).
+    dp_clip: float = 0.0
+    dp_sigma: float = 0.0
+    dp_delta: float = 1e-5
     # Deterministic fault injection + tolerance (faults/, ISSUE 2).
     # fault_spec grammar: "crash:RANK@ROUND,crash_prob:P,straggle:P:MAX_S,
     # drop:P,dup:P,disconnect:P" (faults/schedule.parse_fault_spec); one

@@ -246,7 +246,7 @@ class FedAvgServer(ServerManager):
                  geomed_iters: int = 8, norm_bound: float = 5.0,
                  stddev: float = 0.05, defense_seed: int = 0,
                  quarantine_rounds: int = 0, outlier_threshold: int = 2,
-                 **kw):
+                 dp_delta: float = 1e-5, **kw):
         from neuroimagedisttraining_tpu.core import robust
 
         super().__init__(rank=0, world_size=world_size or num_clients + 1,
@@ -273,6 +273,13 @@ class FedAvgServer(ServerManager):
         self._ef_reset_pending: set[int] = set()
         self.byz_stats = {"nonfinite_rejected": 0, "outlier_flags": 0,
                           "quarantines": []}
+        #: weak_dp RDP ledger (privacy/accountant.py): per-silo Renyi
+        #: moments accumulated on every weak_dp aggregation the silo's
+        #: upload entered, converted to (epsilon, dp_delta) at report
+        #: time. Host numpy under _rlock — never touches a trace.
+        self.dp_delta = float(dp_delta)
+        self._dp_rdp: dict[int, np.ndarray] = {}
+        self._dp_round_info: dict | None = None
         self.params = _to_numpy_tree(init_params)
         self.wire_masks = (_to_numpy_tree(wire_masks)
                            if wire_masks is not None else None)
@@ -353,6 +360,68 @@ class FedAvgServer(ServerManager):
                     "its uploads are excluded from aggregation; its "
                     "first post-window sync will carry ef_reset", c,
                     self.round_idx + 1, until)
+
+    # ---- weak_dp accounting (privacy/, ISSUE 8) ----
+
+    def _note_weak_dp(self, senders: list[int],
+                      ws: list[float]) -> dict | None:
+        """Under ``_rlock``: charge one weak_dp round to every silo whose
+        upload entered this aggregation. The mechanism per round is a
+        full-participation (q=1) Gaussian with effective multiplier
+        ``weak_dp_noise_multiplier`` over the ACTUAL round weights; RDP
+        composes additively per silo, so deadline-truncated rounds
+        charge only the survivors. Returns the round's observability
+        record (clip bound, sigma, z, per-silo epsilon) for history — or
+        None when the configured geometry provides no DP to account
+        (stddev/norm_bound <= 0, a valid no-noise ablation: warn once,
+        never die mid-aggregation on a dispatch/timer thread)."""
+        from neuroimagedisttraining_tpu.privacy import accountant as acct
+
+        if self.stddev <= 0 or self.norm_bound <= 0:
+            if not getattr(self, "_warned_dp_disabled", False):
+                self._warned_dp_disabled = True
+                log.warning(
+                    "weak_dp with stddev=%s/norm_bound=%s adds no "
+                    "accountable noise — epsilon is infinite; the RDP "
+                    "ledger records nothing", self.stddev,
+                    self.norm_bound)
+            return None
+        try:
+            z = acct.weak_dp_noise_multiplier(self.stddev,
+                                              self.norm_bound, ws)
+        except ValueError as e:
+            # degenerate round weights (all-zero survivors, a NaN n the
+            # admission gates let through): skip the charge with a
+            # warning — this runs on dispatch/timer threads, where an
+            # escape would hang the federation
+            log.warning("weak_dp ledger: skipping round %d charge "
+                        "(%s)", self.round_idx, e)
+            return None
+        step = acct.rdp_gaussian(1.0, z)
+        eps = {}
+        for c in senders:
+            self._dp_rdp[c] = self._dp_rdp.get(c, 0.0) + step
+            eps[c] = acct.rdp_to_epsilon(self._dp_rdp[c],
+                                         delta=self.dp_delta)[0]
+        return {"norm_bound": self.norm_bound, "stddev": self.stddev,
+                "noise_multiplier": round(z, 6), "delta": self.dp_delta,
+                "epsilon_per_silo": {c: round(e, 4)
+                                     for c, e in eps.items()}}
+
+    def dp_report(self) -> dict | None:
+        """Run-end per-silo (epsilon, delta) from the weak_dp ledger, or
+        None when the defense never charged a round."""
+        from neuroimagedisttraining_tpu.privacy import accountant as acct
+
+        with self._rlock:
+            if not self._dp_rdp:
+                return None
+            return {"defense": "weak_dp", "delta": self.dp_delta,
+                    "norm_bound": self.norm_bound, "stddev": self.stddev,
+                    "epsilon_per_silo": {
+                        c: round(acct.rdp_to_epsilon(
+                            rdp, delta=self.dp_delta)[0], 4)
+                        for c, rdp in sorted(self._dp_rdp.items())}}
 
     def _score_survivors(self, senders: list[int], trees: list) -> None:
         """Under ``_rlock``: norm/cosine outlier scoring over this
@@ -558,6 +627,7 @@ class FedAvgServer(ServerManager):
                 rngs = jax.vmap(
                     lambda s: jax.random.fold_in(base, s))(
                     jnp.asarray(senders, jnp.uint32))
+                self._dp_round_info = self._note_weak_dp(senders, ws)
             self.params = survivor_defended_mean(
                 trees, ws, self.params, defense=defense,
                 byz_f=self.byz_f, geomed_iters=self.geomed_iters,
@@ -646,6 +716,11 @@ class FedAvgServer(ServerManager):
         entry = {"round": self.round_idx, "clients": n_clients}
         if survivors is not None:
             entry["survivors"] = list(survivors)
+        if self._dp_round_info is not None:
+            # weak_dp observability (ISSUE 8 satellite): the clip bound,
+            # sigma, and running per-silo epsilon this round applied
+            entry["weak_dp"] = self._dp_round_info
+            self._dp_round_info = None
         if self._suspect:
             entry["suspects"] = sorted(self._suspect)
         q = self._quarantined_now()
@@ -745,44 +820,90 @@ class SecureFedAvgServer(FedAvgServer):
     aggregator-j's OS process (``SlotAggregatorProc``), each aggregator
     folds ITS slot across all clients and forwards one cross-client
     total, and this server only ever sees K totals — no single node holds
-    enough to reconstruct any client (server included)."""
+    enough to reconstruct any client (server included).
+
+    Secure QUANTIZED mode (``quant_spec`` — privacy/secure_quant.py,
+    ISSUE 8): phase B uploads become field-element frames in a small
+    GF(p) (one wire-dtype residue per parameter + seed-expanded mask
+    slots) instead of int64 share stacks, folded slot-major by a
+    ``SlotAccumulator`` with the same atomic-discard dropout semantics
+    — and bitwise-equal to the plain quantized ``tree_weighted_mean``
+    over the survivor set. Quant mode lifts the clip-family defense
+    rejection (each silo clips/noises its OWN update pre-share, and the
+    weak_dp ledger charges here); order statistics, quarantine, the
+    codec, and the grouped aggregator deployment remain out — the full
+    matrix lives in ARCHITECTURE.md "Privacy plane"."""
 
     def __init__(self, init_params, comm_round: int, num_clients: int,
                  frac_bits: int = 16, n_aggregators: int = 0,
-                 record_trace: bool = False, **kw):
-        if kw.get("defense", "none") != "none" \
-                or kw.get("quarantine_rounds", 0):
-            # secure aggregation is a LINEAR sum over additive shares:
-            # the server never observes an individual silo's update, so
-            # there is nothing for an order-statistic defense to select
-            # over, nothing for the outlier scorer to score, and even
-            # clipping would have to run client-side (each silo clips
-            # its own update BEFORE sharing — the TurboAggregateEngine
-            # composition). Robustness and secrecy trade off here by
-            # construction; ARCHITECTURE.md "Byzantine robustness"
-            # documents the tension.
+                 record_trace: bool = False, quant_spec=None, **kw):
+        from neuroimagedisttraining_tpu.core import robust
+
+        defense = kw.get("defense", "none")
+        if quant_spec is None and (defense != "none"
+                                   or kw.get("quarantine_rounds", 0)):
+            # secure-DENSE aggregation is a LINEAR sum over additive
+            # shares: the server never observes an individual silo's
+            # update, so there is nothing for an order-statistic defense
+            # to select over, nothing for the outlier scorer to score,
+            # and even clipping would have to run client-side (each silo
+            # clips its own update BEFORE sharing — the
+            # TurboAggregateEngine composition). The QUANTIZED path
+            # (--secure_quant) realizes exactly that composition for the
+            # clip family; the full matrix lives in ARCHITECTURE.md
+            # "Privacy plane".
             raise ValueError(
                 "SecureFedAvgServer supports neither --defense nor "
-                "quarantine: additive-share aggregation never reveals "
-                "per-silo updates to defend over (clip client-side "
-                "instead; see ARCHITECTURE.md)")
+                "quarantine in dense mode: additive-share aggregation "
+                "never reveals per-silo updates to defend over. The "
+                "clip-family defenses compose with --secure_quant "
+                "(enforced CLIENT-side, pre-share); see ARCHITECTURE.md "
+                "'Privacy plane'")
+        if quant_spec is not None and (
+                defense in robust.ROBUST_AGGREGATORS
+                or kw.get("quarantine_rounds", 0)):
+            raise ValueError(
+                "secure_quant supports neither order-statistic defenses "
+                "nor quarantine: the server still only ever sees masked "
+                "field elements — there are no per-silo updates to "
+                "select over or score. Clip-family defenses "
+                "(norm_diff_clipping, weak_dp) run client-side, "
+                "pre-share; see ARCHITECTURE.md 'Privacy plane'")
         if kw.get("wire_masks") is not None:
-            # Secure aggregation stays DENSE by design: each upload is a
-            # tree of additive share slots — uniformly random GF(p)
-            # residues. Delta/quantization would destroy the share
-            # algebra (the slots must sum mod p to the quantized
-            # weighted update), and any sparsification would leak the
-            # client's mask support, the very structure the additive
-            # masking hides. The wire codec therefore never composes
-            # with --secure (distributed/run.py rejects the flag combo).
+            # Secure aggregation stays structurally DENSE: each upload
+            # is masked GF(p) material. Sparsification would leak the
+            # client's mask support — the very structure the masking
+            # hides — and the codec's float stages would destroy the
+            # share algebra. Bandwidth comes from --secure_quant's small
+            # field + seed-expanded masks instead (privacy/).
             raise ValueError(
                 "SecureFedAvgServer is incompatible with the wire codec "
                 "(shares are uniform field elements; encoding them would "
-                "break the share algebra or leak mask support)")
+                "break the share algebra or leak mask support — use "
+                "--secure_quant for the compressed secure wire)")
+        if quant_spec is not None and n_aggregators:
+            raise ValueError(
+                "secure_quant does not compose with --n_aggregators: its "
+                "mask slots ride as PRG seeds, and any node holding a "
+                "client's seeds can expand every non-data slot — the "
+                "grouped deployment's no-single-node property would be "
+                "void. Use the dense --secure protocol for grouped "
+                "aggregation (see ARCHITECTURE.md 'Privacy plane')")
         super().__init__(init_params, comm_round, num_clients,
                          world_size=num_clients + 1 + n_aggregators, **kw)
+        self.quant_spec = quant_spec
+        if quant_spec is not None:
+            from neuroimagedisttraining_tpu.privacy import check_headroom
+
+            # accumulator + aggregate-range headroom vs p and the cohort
+            # fails HERE (startup), never as silent field wraparound
+            check_headroom(quant_spec, num_clients)
         self.frac_bits = frac_bits
         self.n_aggregators = n_aggregators
+        #: secure-quant slot accumulator (one per round, lazily built)
+        self._sq_acc = None
+        #: when record_trace, every post-fold slot-accumulator state
+        self.sq_trace: list = [] if record_trace else None
         self._slot_acc: dict | None = None
         self._n_by_client: dict[int, float] = {}
         self._slot_totals: dict[int, dict] = {}
@@ -882,6 +1003,31 @@ class SecureFedAvgServer(FedAvgServer):
     def _fold_shares(self, msg: M.Message) -> None:
         from neuroimagedisttraining_tpu.ops import mpc
 
+        if self.quant_spec is not None:
+            from neuroimagedisttraining_tpu.privacy import SlotAccumulator
+
+            if self._sq_acc is None:
+                # like=self.params locks the expected leaf structure, so
+                # a structurally skewed frame (version-skewed silo) is
+                # rejected BEFORE any accumulator mutation — the fold
+                # stays atomic even for the round's first frame
+                self._sq_acc = SlotAccumulator(self.quant_spec,
+                                               trace=self.sq_trace,
+                                               like=self.params)
+            try:
+                # atomic: the frame folds whole or not at all (Bonawitz
+                # discard — a validation failure leaves the accumulators
+                # untouched and the sender a straggler for the
+                # deadline/quorum machinery, like an undecodable codec
+                # frame on the plain server)
+                self._sq_acc.fold(msg.get(M.ARG_MODEL_PARAMS))
+            except (ValueError, KeyError, TypeError) as e:
+                log.warning("server: dropping invalid secure-quant frame "
+                            "from %d (round %d): %s", msg.sender_id,
+                            self.round_idx, e)
+                return
+            self._folded.add(msg.sender_id)
+            return
         shares_tree = msg.get(M.ARG_MODEL_PARAMS)  # leaves: [n_shares, ...]
         if self._slot_acc is None:
             self._slot_acc = jax.tree.map(
@@ -908,13 +1054,35 @@ class SecureFedAvgServer(FedAvgServer):
         rescale = (1.0 / w_sum
                    if self._folded != set(self._weights_sent) and w_sum > 0
                    else 1.0)
-        self.params = jax.tree.map(
-            lambda slots, old: (rescale * mpc.dequantize(
-                np.mod(slots.sum(axis=0), mpc.P_DEFAULT),
-                frac_bits=self.frac_bits)).astype(np.asarray(old).dtype),
-            self._slot_acc, self.params)
-        self._slot_acc = None
+        if self.quant_spec is not None:
+            from neuroimagedisttraining_tpu.privacy.secure_quant import (
+                leaf_scales,
+            )
+
+            # self.params is still THE round's broadcast reference here
+            # (it only advances below), so these scales are the very
+            # ones every uploading client derived from its sync
+            self.params = self._sq_acc.finalize(
+                like=self.params, rescale=rescale,
+                scales=leaf_scales(self.params))
+            self._sq_acc = None
+        else:
+            self.params = jax.tree.map(
+                lambda slots, old: (rescale * mpc.dequantize(
+                    np.mod(slots.sum(axis=0), mpc.P_DEFAULT),
+                    frac_bits=self.frac_bits)).astype(
+                        np.asarray(old).dtype),
+                self._slot_acc, self.params)
+            self._slot_acc = None
         survivors = sorted(self._folded)
+        if self.quant_spec is not None and self.defense == "weak_dp" \
+                and survivors:
+            # the noise was added CLIENT-side (pre-share), but its
+            # geometry is config — the server still owns the ledger and
+            # the per-silo epsilon report
+            self._dp_round_info = self._note_weak_dp(
+                survivors, [self._weights_sent.get(c, 0.0)
+                            for c in survivors])
         self._folded = set()
         self._weights_sent = {}
         self._phase = "A"
@@ -1192,18 +1360,28 @@ class SecureFedAvgClientProc(FedAvgClientProc):
 
     def __init__(self, rank: int, num_clients: int, train_fn: Callable,
                  n_shares: int = 3, frac_bits: int = 16, mpc_seed: int = 0,
-                 n_aggregators: int = 0, **kw):
+                 n_aggregators: int = 0, quant_spec=None,
+                 one_phase: bool = False, defense: str = "none",
+                 norm_bound: float = 5.0, stddev: float = 0.05,
+                 defense_seed: int = 0, **kw):
+        from neuroimagedisttraining_tpu.core import robust
+
         if n_aggregators and n_aggregators != n_shares:
             raise ValueError(
                 f"n_aggregators ({n_aggregators}) must equal n_shares "
                 f"({n_shares}): slot j routes to aggregator j")
+        if n_aggregators and quant_spec is not None:
+            raise ValueError(
+                "secure_quant does not compose with --n_aggregators "
+                "(seed-expanded mask slots; see SecureFedAvgServer)")
         if kw.get("wire_codec", "none") != "none" or \
                 kw.get("wire_masks") is not None:
             raise ValueError(
                 "SecureFedAvgClientProc is incompatible with the wire "
-                "codec: share slots must ride the wire dense (see "
-                "SecureFedAvgServer — encoding breaks the GF(p) share "
-                "algebra or leaks mask support)")
+                "codec: secure uploads must ride the wire as field "
+                "elements (see SecureFedAvgServer — encoding breaks the "
+                "GF(p) share algebra or leaks mask support; "
+                "--secure_quant IS the compressed secure wire)")
         sched = kw.get("fault_schedule")
         if sched is not None and sched.spec.any_value_faults:
             raise ValueError(
@@ -1212,24 +1390,113 @@ class SecureFedAvgClientProc(FedAvgClientProc):
                 "value hook could run, and the server has no plaintext "
                 "updates to defend — the attack would go both "
                 "uninjected and undefended (see ARCHITECTURE.md)")
+        if one_phase and quant_spec is None:
+            raise ValueError(
+                "one_phase (the async buffered protocol) requires a "
+                "quant_spec: the dense two-phase weight exchange IS a "
+                "round barrier (see asyncfl/server.py)")
+        if defense != "none":
+            robust.validate_defense(defense)
+            if quant_spec is None or defense not in robust.CLIP_DEFENSES:
+                raise ValueError(
+                    f"client-side defense {defense!r} composes only with "
+                    "secure_quant and only for the clip family "
+                    "(norm_diff_clipping, weak_dp) — each silo clips/"
+                    "noises its OWN update before sharing; see "
+                    "ARCHITECTURE.md 'Privacy plane'")
         super().__init__(rank, num_clients, train_fn,
                          world_size=num_clients + 1 + n_aggregators, **kw)
         self.n_shares = n_shares
         self.frac_bits = frac_bits
         self.n_aggregators = n_aggregators
+        self.quant_spec = quant_spec
+        self.one_phase = bool(one_phase)
+        self.defense = defense
+        self.norm_bound = float(norm_bound)
+        self.stddev = float(stddev)
+        self.defense_seed = int(defense_seed)
         self._rng = np.random.default_rng(mpc_seed * 7919 + rank)
         self._trained = None  # params awaiting the weight reply
+        self._sync_ref = None  # the sync tree (client-side clip baseline)
 
     def register_message_receive_handlers(self) -> None:
         super().register_message_receive_handlers()
         self.register_message_receive_handler(
             M.MSG_TYPE_S2C_AGG_WEIGHTS, self._on_weights)
 
+    def _client_side_defense(self, trained, round_idx: int):
+        """Clip-family enforcement at the only place secure aggregation
+        allows it — the silo's own update, BEFORE quantize/share (the
+        TurboAggregateEngine composition). THE core/robust.py transforms
+        run verbatim (``norm_diff_clip``, then ``add_weak_dp_noise``
+        from a jax key folded from (defense_seed, round, rank) — the
+        config-threaded stream discipline nidtlint's dp-key-discipline
+        rule enforces), so a secure-quant silo applies bit-for-bit the
+        defense a plain server would have."""
+        if self.defense == "none" or self._sync_ref is None:
+            return trained
+        from neuroimagedisttraining_tpu.core import robust
+
+        ref = jax.tree.map(lambda x: jnp.asarray(x, jnp.float32),
+                           self._sync_ref)
+        out = robust.norm_diff_clip(
+            jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), trained),
+            ref, self.norm_bound)
+        if self.defense == "weak_dp":
+            key = jax.random.fold_in(jax.random.fold_in(
+                jax.random.key(self.defense_seed), round_idx), self.rank)
+            out = robust.add_weak_dp_noise(out, key, self.stddev)
+        return _to_numpy_tree(out)
+
+    def _sq_upload(self, payload, round_idx, weight: float) -> None:
+        """Encode one secure-quant field-element frame and ship it (the
+        one upload message of this round — folds whole or not at all).
+        Per-leaf scales derive from the sync reference — the identical
+        tree the server holds for this round tag, so both ends compute
+        the identical scales with nothing extra on the wire."""
+        from neuroimagedisttraining_tpu.privacy import encode_secure_quant
+        from neuroimagedisttraining_tpu.privacy.secure_quant import (
+            leaf_scales,
+        )
+
+        frame = encode_secure_quant(payload, weight, self.quant_spec,
+                                    self._rng,
+                                    scales=leaf_scales(self._sync_ref))
+        out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+        out.add(M.ARG_MODEL_PARAMS, frame)
+        if round_idx is not None:
+            out.add(M.ARG_ROUND_IDX, int(round_idx))
+        out.add(M.ARG_UPLOAD_SEQ, self._upload_seq)
+        self._upload_seq += 1
+        self.send_message(out)
+
     def _on_sync(self, msg: M.Message) -> None:
         params = msg.get(M.ARG_MODEL_PARAMS)
         round_idx = int(msg.get(M.ARG_ROUND_IDX))
         new_params, n = self.train_fn(params, round_idx)
-        self._trained = _to_numpy_tree(new_params)
+        self._sync_ref = _to_numpy_tree(params)
+        trained = self._client_side_defense(_to_numpy_tree(new_params),
+                                            round_idx)
+        if self.one_phase:
+            # async buffered protocol: no phase-A weight exchange (it IS
+            # a round barrier) — ship the UNWEIGHTED quantized update +
+            # n in the clear; the server folds integer-scaled staleness
+            # weights inside the field (asyncfl/server.py)
+            from neuroimagedisttraining_tpu.privacy import (
+                encode_secure_quant,
+            )
+
+            frame = encode_secure_quant(trained, 1.0, self.quant_spec,
+                                        self._rng)
+            out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+            out.add(M.ARG_MODEL_PARAMS, frame)
+            out.add(M.ARG_NUM_SAMPLES, float(n))
+            out.add(M.ARG_ROUND_IDX, round_idx)
+            out.add(M.ARG_UPLOAD_SEQ, self._upload_seq)
+            self._upload_seq += 1
+            self.send_message(out)
+            return
+        self._trained = trained
         out = M.Message(M.MSG_TYPE_C2S_NUM_SAMPLES, self.rank, 0)
         out.add(M.ARG_NUM_SAMPLES, float(n))
         out.add(M.ARG_ROUND_IDX, round_idx)
@@ -1240,6 +1507,10 @@ class SecureFedAvgClientProc(FedAvgClientProc):
 
         round_idx = msg.get(M.ARG_ROUND_IDX)
         w = float(msg.get(M.ARG_AGG_WEIGHT))
+        if self.quant_spec is not None:
+            payload, self._trained = self._trained, None
+            self._sq_upload(payload, round_idx, w)
+            return
         shares_tree = jax.tree.map(
             lambda x: mpc.additive_shares(
                 mpc.quantize(w * np.asarray(x, np.float64),

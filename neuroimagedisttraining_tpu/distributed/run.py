@@ -430,7 +430,31 @@ def main(argv=None) -> int:
                          "(mask handoff). 0 = dense training")
     ap.add_argument("--secure", action="store_true",
                     help="TurboAggregate additive-share aggregation over "
-                         "the control plane")
+                         "the control plane (dense int64 share slots)")
+    ap.add_argument("--secure_quant", action="store_true",
+                    help="secure QUANTIZED aggregation "
+                         "(privacy/secure_quant.py): uploads ride as "
+                         "field-element frames in a small GF(p) — one "
+                         "uintN residue per parameter plus seed-expanded "
+                         "mask slots — so secure aggregation costs a "
+                         "FRACTION of the dense wire instead of 6x it. "
+                         "Implies the secure protocol; composes with "
+                         "clip-family --defense (enforced client-side) "
+                         "and with --async_server (one-phase, integer-"
+                         "scaled staleness weights); see ARCHITECTURE.md "
+                         "'Privacy plane' for the full matrix")
+    ap.add_argument("--secure_quant_field_bits", type=int, default=16,
+                    choices=(8, 16, 32),
+                    help="secure_quant field width: p = largest prime "
+                         "below 2^bits; the wire ships one uintN residue "
+                         "per parameter (16 -> uint16)")
+    ap.add_argument("--secure_quant_frac_bits", type=int, default=10,
+                    help="secure_quant fixed-point fraction bits; the "
+                         "aggregate headroom vs p and the cohort is "
+                         "validated at startup")
+    ap.add_argument("--dp_delta", type=float, default=1e-5,
+                    help="target delta for the weak_dp RDP accountant's "
+                         "(epsilon, delta) report (privacy/accountant.py)")
     ap.add_argument("--mpc_n_shares", type=int, default=3)
     ap.add_argument("--mpc_frac_bits", type=int, default=16)
     ap.add_argument("--model", type=str, default="3dcnn_tiny")
@@ -470,6 +494,23 @@ def main(argv=None) -> int:
                          "(cohort sharding lives in the simulated "
                          "engines, parallel/cohort.py)")
     args = ap.parse_args(argv)
+    quant_spec = None
+    if args.secure_quant:
+        args.secure = True  # the quantized path IS the secure protocol
+        from neuroimagedisttraining_tpu.privacy import (
+            QuantSpec, check_headroom,
+        )
+
+        try:
+            # field-geometry headroom (aggregate range vs p, int64
+            # accumulators vs the cohort) fails HERE, at argparse on
+            # every rank — never as silent field wraparound mid-round
+            quant_spec = QuantSpec.from_bits(
+                args.secure_quant_field_bits,
+                args.secure_quant_frac_bits, args.mpc_n_shares)
+            check_headroom(quant_spec, args.num_clients)
+        except ValueError as e:
+            ap.error(str(e))
     if args.rounds_per_dispatch > 1:
         print(f"[dispatch] {dispatch_fallback_note(args.rounds_per_dispatch)}",
               flush=True)
@@ -498,9 +539,18 @@ def main(argv=None) -> int:
                  "multi-aggregator deployment needs --transport socket")
     if args.secure and (args.wire_codec != "none"
                         or args.wire_mask_density > 0):
-        ap.error("--secure shares must ride the wire dense: the codec "
-                 "would break the GF(p) share algebra or leak mask "
-                 "support (see cross_silo.SecureFedAvgServer)")
+        ap.error("--secure uploads must ride the wire as field elements: "
+                 "the codec would break the GF(p) share algebra or leak "
+                 "mask support. The COMPRESSED secure wire is "
+                 "--secure_quant (small-field frames, "
+                 "privacy/secure_quant.py) — drop --wire_codec/"
+                 "--wire_mask_density and add --secure_quant")
+    if args.secure_quant and args.n_aggregators > 0:
+        ap.error("--secure_quant does not compose with --n_aggregators: "
+                 "mask slots ride as PRG seeds, and any node holding a "
+                 "client's seeds can expand every non-data slot — use "
+                 "the dense --secure protocol for the grouped "
+                 "deployment (see ARCHITECTURE.md 'Privacy plane')")
     if not 0.0 <= args.wire_mask_density < 1.0:
         ap.error(f"--wire_mask_density ({args.wire_mask_density}) must "
                  "be in [0, 1)")
@@ -538,11 +588,25 @@ def main(argv=None) -> int:
                  "the asyncfl load harness (asyncfl/loadgen.py) whose "
                  "simulated clients honor rejoin deterministically")
     if args.secure:
-        if args.defense != "none" or args.quarantine_rounds > 0:
-            ap.error("--secure is incompatible with --defense/"
-                     "--quarantine_rounds: additive-share aggregation "
-                     "never reveals per-silo updates to defend over "
-                     "(see cross_silo.SecureFedAvgServer)")
+        if args.quarantine_rounds > 0:
+            ap.error("secure aggregation is incompatible with "
+                     "--quarantine_rounds: the outlier scorer has no "
+                     "per-silo plaintext to score (see ARCHITECTURE.md "
+                     "'Privacy plane')")
+        if args.defense != "none" and not args.secure_quant:
+            ap.error("--secure (dense) is incompatible with --defense: "
+                     "additive-share aggregation never reveals per-silo "
+                     "updates to defend over. The clip-family defenses "
+                     "(norm_diff_clipping, weak_dp) compose with "
+                     "--secure_quant, enforced CLIENT-side pre-share — "
+                     "add --secure_quant (see ARCHITECTURE.md 'Privacy "
+                     "plane')")
+        if args.secure_quant and args.defense in robust.ROBUST_AGGREGATORS:
+            ap.error(f"--defense {args.defense} is incompatible with "
+                     "secure aggregation (quantized included): order "
+                     "statistics have no per-silo plaintext to select "
+                     "over; only the clip family composes (client-side) "
+                     "— see ARCHITECTURE.md 'Privacy plane'")
         if fault_spec is not None and fault_spec.any_value_faults:
             ap.error("--secure cannot simulate byz: value faults (the "
                      "share algebra hides the very values the attack "
@@ -550,13 +614,15 @@ def main(argv=None) -> int:
     if args.async_server:
         # async incompatibilities fail at STARTUP on every rank, like
         # the secure/codec rejections — never mid-run
-        if args.secure:
-            ap.error("--async_server is incompatible with --secure: the "
-                     "two-phase secure weight exchange (every client's "
-                     "normalized weight depends on every other phase-A "
-                     "reporter) IS a round barrier — exactly what the "
-                     "buffered asynchronous protocol removes (see "
-                     "asyncfl/server.py)")
+        if args.secure and not args.secure_quant:
+            ap.error("--async_server is incompatible with dense "
+                     "--secure: the two-phase secure weight exchange "
+                     "(every client's normalized weight depends on every "
+                     "other phase-A reporter) IS a round barrier — "
+                     "exactly what the buffered asynchronous protocol "
+                     "removes. --secure_quant composes: its one-phase "
+                     "frames need no weight exchange (staleness weights "
+                     "fold inside the field; see asyncfl/server.py)")
         if args.transport == "broker":
             ap.error("--async_server pairs with the selector socket "
                      "core (asyncfl/loop.py); the broker daemon is a "
@@ -571,6 +637,20 @@ def main(argv=None) -> int:
                 or args.staleness_alpha < 0:
             ap.error("--buffer_k/--max_staleness/--staleness_alpha "
                      "must be >= 0")
+        if quant_spec is not None:
+            from neuroimagedisttraining_tpu.privacy import secure_quant \
+                as _sq
+
+            k_cap = min(args.buffer_k or args.num_clients,
+                        args.num_clients)
+            if _sq.weighted_fold_capacity(quant_spec) <= k_cap:
+                ap.error(
+                    "--async_server --secure_quant folds integer-scaled "
+                    "staleness weights inside the field, which needs "
+                    "headroom the "
+                    f"{args.secure_quant_field_bits}-bit field lacks "
+                    f"for a {k_cap}-upload buffer — pass "
+                    "--secure_quant_field_bits 32")
     if args.round_deadline > 0 and args.quorum == 0:
         args.quorum = args.num_clients // 2 + 1  # simple majority
     if args.heartbeat_timeout > 0 and not (
@@ -621,20 +701,40 @@ def main(argv=None) -> int:
         init = {"params": jax.tree.map(np.asarray, gs.params),
                 "batch_stats": jax.tree.map(np.asarray, gs.batch_stats)}
         cls = SecureFedAvgServer if args.secure else FedAvgServer
-        kw = ({"frac_bits": args.mpc_frac_bits,
-               "n_aggregators": args.n_aggregators} if args.secure
-              else {"wire_masks": wire_masks,
-                    "defense": args.defense, "byz_f": args.byz_f,
-                    "geomed_iters": args.geomed_iters,
-                    "norm_bound": args.norm_bound,
-                    "stddev": args.stddev, "defense_seed": args.seed,
-                    "quarantine_rounds": args.quarantine_rounds,
-                    "outlier_threshold": args.outlier_threshold})
+        if args.secure:
+            kw = {"frac_bits": args.mpc_frac_bits,
+                  "n_aggregators": args.n_aggregators,
+                  "quant_spec": quant_spec}
+            if args.secure_quant and args.defense != "none":
+                # clip-family defense under secure_quant is enforced
+                # CLIENT-side; the server keeps the geometry so the
+                # weak_dp accountant can charge the ledger it reports
+                kw.update(defense=args.defense,
+                          norm_bound=args.norm_bound,
+                          stddev=args.stddev, defense_seed=args.seed,
+                          dp_delta=args.dp_delta)
+        else:
+            kw = {"wire_masks": wire_masks,
+                  "defense": args.defense, "byz_f": args.byz_f,
+                  "geomed_iters": args.geomed_iters,
+                  "norm_bound": args.norm_bound,
+                  "stddev": args.stddev, "defense_seed": args.seed,
+                  "quarantine_rounds": args.quarantine_rounds,
+                  "outlier_threshold": args.outlier_threshold,
+                  "dp_delta": args.dp_delta}
         if args.async_server:
             from neuroimagedisttraining_tpu.asyncfl import (
                 BufferedFedAvgServer,
             )
 
+            if args.secure_quant:
+                # the buffered server speaks one-phase secure_quant
+                # natively; the dense-secure kw set does not apply
+                kw = {"secure_quant": quant_spec,
+                      "defense": args.defense,
+                      "norm_bound": args.norm_bound,
+                      "stddev": args.stddev, "defense_seed": args.seed,
+                      "dp_delta": args.dp_delta}
             server = BufferedFedAvgServer(
                 init, args.comm_round, args.num_clients,
                 buffer_k=args.buffer_k,
@@ -675,9 +775,15 @@ def main(argv=None) -> int:
                      "staleness_taus": sorted({
                          t for h in server.history
                          for t in h.get("taus", ())})}
+        dp = server.dp_report()
+        if dp is not None:
+            # run-end privacy audit: per-silo (epsilon, delta) from the
+            # weak_dp RDP ledger (privacy/accountant.py)
+            extra["dp"] = dp
         print(json.dumps({"rounds_completed": len(server.history),
                           "clients": args.num_clients,
                           "secure": bool(args.secure),
+                          "secure_quant": bool(args.secure_quant),
                           "transport": args.transport,
                           "wire_codec": args.wire_codec,
                           "wire_mask_density": args.wire_mask_density,
@@ -692,12 +798,22 @@ def main(argv=None) -> int:
 
     train_fn, wire_masks = _make_train_fn(args)
     cls = SecureFedAvgClientProc if args.secure else FedAvgClientProc
-    kw = ({"n_shares": args.mpc_n_shares, "frac_bits": args.mpc_frac_bits,
-           "mpc_seed": args.seed,
-           "n_aggregators": args.n_aggregators} if args.secure
-          else {"wire_codec": args.wire_codec,
-                "wire_masks": wire_masks,
-                "wire_topk_ratio": args.wire_topk_ratio})
+    if args.secure:
+        kw = {"n_shares": args.mpc_n_shares,
+              "frac_bits": args.mpc_frac_bits, "mpc_seed": args.seed,
+              "n_aggregators": args.n_aggregators,
+              "quant_spec": quant_spec,
+              # async buffered plane: one-phase frames (no weight
+              # exchange); clip-family defenses are enforced HERE, on
+              # this silo's own update, pre-share
+              "one_phase": bool(args.async_server)}
+        if args.secure_quant and args.defense != "none":
+            kw.update(defense=args.defense, norm_bound=args.norm_bound,
+                      stddev=args.stddev, defense_seed=args.seed)
+    else:
+        kw = {"wire_codec": args.wire_codec,
+              "wire_masks": wire_masks,
+              "wire_topk_ratio": args.wire_topk_ratio}
     if not args.secure and fault_spec is not None \
             and fault_spec.any_value_faults:
         # value faults live in the CLIENT, not the transport wrapper:

@@ -64,6 +64,12 @@ class FederatedEngine:
     #: parallel/cohort.py); others fall back to the unsharded round with
     #: a logged reason (same pattern as fused-dispatch fallback)
     supports_cohort_sharding = False
+    #: engines whose round program realizes the --dp_clip/--dp_sigma
+    #: round-level DP transform (clip each client's update delta, add
+    #: Gaussian noise from config-folded jax keys — privacy/, ISSUE 8);
+    #: others must reject the flags loudly instead of silently training
+    #: without the noise the accountant would be charging for
+    supports_dp = False
 
     def __init__(self, cfg: ExperimentConfig, fed_data: FederatedData | None,
                  trainer: LocalTrainer, mesh=None,
@@ -128,6 +134,35 @@ class FederatedEngine:
             # before any data loads rather than at first-trace time
             robust._check_f(cfg.fed.client_num_per_round,
                             cfg.fed.byz_f, cfg.fed.defense_type)
+        # round-level DP (--dp_clip/--dp_sigma, privacy/ ISSUE 8) fails
+        # at STARTUP on engines whose round never applies the transform:
+        # an unapplied noise config with a running accountant would
+        # report epsilon for privacy nobody got
+        if cfg.fed.dp_sigma < 0 or cfg.fed.dp_clip < 0:
+            raise ValueError(
+                f"dp_sigma/dp_clip must be >= 0 (got "
+                f"{cfg.fed.dp_sigma}/{cfg.fed.dp_clip})")
+        if cfg.fed.dp_sigma > 0 and cfg.fed.dp_clip <= 0:
+            raise ValueError(
+                "--dp_sigma needs --dp_clip > 0: the clip bound IS the "
+                "sensitivity the noise multiplier is stated against "
+                "(privacy/accountant.py)")
+        if (cfg.fed.dp_sigma > 0 or cfg.fed.dp_clip > 0) \
+                and not self.supports_dp:
+            from neuroimagedisttraining_tpu.engines import ENGINES
+            ok = sorted({c.name for c in ENGINES.values()
+                         if c.supports_dp})
+            raise ValueError(
+                f"algorithm {self.name!r} does not apply the "
+                "--dp_clip/--dp_sigma round-level DP transform (its "
+                "round program would train un-noised while the "
+                f"accountant reported epsilon); supported: {ok}")
+        #: privacy ledger (privacy/accountant.py): per-round RDP of the
+        #: armed noise path — weak_dp defense (subsampled cohorts) or
+        #: the engine DP transform (full participation) — recorded
+        #: through ``record_privacy`` at host boundaries
+        self._dp_rdp = None
+        self._dp_recorded_through = -1
         # wire codec (codec/, ISSUE 3): the lossy value transform the
         # cross-silo wire would apply to this engine's uploads, run
         # in-sim before aggregation so round metrics reflect the encoded
@@ -729,6 +764,81 @@ class FederatedEngine:
                                                            1e-9)
         return new_params, new_bstats, mean_loss, n_bad
 
+    # ---------- privacy accounting (privacy/, ISSUE 8) ----------
+
+    def record_privacy(self, round_idx: int) -> None:
+        """Charge the RDP ledger for every round completed through
+        ``round_idx`` and publish the running (epsilon, delta) in
+        ``stat_info`` — one entry PER ROUND (the weak_dp observability
+        the defense never had: the clip bound and sigma it actually
+        applied were invisible). Pure host numpy, called from
+        ``_flush_nonfinite``'s host boundaries (and the dpsgd driver),
+        never inside a trace.
+
+        Two armed sources, mutually exclusive by construction (weak_dp
+        is a server-side defense, dp_clip/dp_sigma a client-side
+        transform dpsgd owns):
+
+        - ``defense_type == "weak_dp"``: per round, a subsampled
+          Gaussian at q = cohort/total with the effective multiplier
+          over the round's ACTUAL sample-count weights
+          (``weak_dp_noise_multiplier``) — cohorts re-derived from the
+          deterministic sampling contract, so accounting replays
+          exactly.
+        - ``dp_sigma > 0`` (dpsgd): full participation (q = 1, every
+          silo reveals its noised model to neighbors every round) at
+          noise multiplier ``dp_sigma``.
+        """
+        from neuroimagedisttraining_tpu.privacy import accountant as acct
+
+        f = self.cfg.fed
+        weak = f.defense_type == "weak_dp"
+        dp = f.dp_sigma > 0
+        if not (weak or dp) or round_idx <= self._dp_recorded_through:
+            return
+        if weak and (f.stddev <= 0 or f.norm_bound <= 0):
+            # degenerate-but-runnable ablation (no noise / no clip
+            # sensitivity): warn once, never die at an eval boundary —
+            # the same guard cross_silo._note_weak_dp keeps
+            if not getattr(self, "_warned_dp_disabled", False):
+                self._warned_dp_disabled = True
+                self.log.warning(
+                    "weak_dp with stddev=%s/norm_bound=%s adds no "
+                    "accountable noise — epsilon is infinite; the "
+                    "accountant records nothing", f.stddev, f.norm_bound)
+            return
+        key = "weak_dp" if weak else "dp"
+        stats = self.stat_info.setdefault(key, {
+            "norm_bound": f.norm_bound if weak else f.dp_clip,
+            "stddev": f.stddev if weak else f.dp_sigma * f.dp_clip,
+            "delta": f.dp_delta, "noise_multiplier_per_round": [],
+            "epsilon_per_round": [], "epsilon": 0.0})
+        if self._dp_rdp is None:
+            self._dp_rdp = np.zeros(len(acct.DEFAULT_ORDERS), np.float64)
+        for r in range(self._dp_recorded_through + 1, round_idx + 1):
+            if weak:
+                sampled = self.client_sampling(r)
+                w = self._n_train_host[np.asarray(sampled)]
+                q = len(sampled) / max(1, self.real_clients)
+                z = acct.weak_dp_noise_multiplier(f.stddev, f.norm_bound,
+                                                  w)
+            else:
+                q, z = 1.0, f.dp_sigma
+            self._dp_rdp = self._dp_rdp + acct.rdp_gaussian(q, z)
+            eps = acct.rdp_to_epsilon(self._dp_rdp,
+                                      delta=f.dp_delta)[0]
+            stats["noise_multiplier_per_round"].append(round(z, 6))
+            stats["epsilon_per_round"].append(round(eps, 4))
+        stats["epsilon"] = stats["epsilon_per_round"][-1]
+        # per-silo report: under the sampling model every silo's loss is
+        # identical (the subsampling is the amplifier), so the per-silo
+        # map is uniform — the cross-silo server's ledger (which sees
+        # deterministic survivor sets, no amplification) is the
+        # per-silo-varying counterpart (cross_silo.dp_report)
+        stats["epsilon_per_silo"] = {
+            int(c): stats["epsilon"] for c in range(self.real_clients)}
+        self._dp_recorded_through = round_idx
+
     # ---------- non-finite upload guard (ISSUE 5 satellite) ----------
 
     def _note_nonfinite(self, n_bad) -> None:
@@ -742,7 +852,13 @@ class FederatedEngine:
         """Drain the queued counts (one batched device_get) and emit the
         counted warning when any upload was rejected. Call at host-sync
         boundaries — eval rounds and end of training — where the driver
-        already blocks on device results."""
+        already blocks on device results.
+
+        Doubles as the privacy-ledger boundary: every driver that can
+        arm weak_dp already calls this at exactly the host-sync points
+        where per-round accounting should publish, so the accountant
+        records here instead of asking each engine for a second hook."""
+        self.record_privacy(round_idx)
         if not self._nonfinite_pending:
             return
         counts = jax.device_get(self._nonfinite_pending)

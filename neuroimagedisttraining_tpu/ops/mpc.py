@@ -23,6 +23,25 @@ import numpy as np
 
 P_DEFAULT = 2**31 - 1  # Mersenne prime; p^2 < 2^63 keeps int64 products exact
 
+#: wire-size-tiered fields for secure QUANTIZED aggregation (privacy/):
+#: the largest prime below each wire width. The share algebra is the
+#: same mod any prime; a smaller field means fewer bytes per masked
+#: element on the wire (uint16 shares are 4x smaller than the dense
+#: protocol's int64 slots). Keyed by field_bits.
+FIELD_PRIMES = {8: 251, 16: 65521, 32: P_DEFAULT}
+
+
+def wire_dtype_for(p: int) -> np.dtype:
+    """Smallest unsigned numpy dtype that holds every residue of GF(p) —
+    what a field-element frame ships per masked value."""
+    if p <= 1 << 8:
+        return np.dtype(np.uint8)
+    if p <= 1 << 16:
+        return np.dtype(np.uint16)
+    if p < 1 << 32:
+        return np.dtype(np.uint32)
+    raise ValueError(f"field modulus {p} exceeds the uint32 wire width")
+
 
 def _asfield(x, p: int) -> np.ndarray:
     return np.mod(np.asarray(x, np.int64), p)
@@ -228,3 +247,32 @@ def dequantize(q, p: int = P_DEFAULT, frac_bits: int = 16) -> np.ndarray:
     q = _asfield(q, p)
     centered = np.where(q > p // 2, q - p, q)
     return centered.astype(np.float64) / (1 << frac_bits)
+
+
+def quantize32(x, p: int = P_DEFAULT, frac_bits: int = 16) -> np.ndarray:
+    """Host embedding BITWISE-identical to the device one
+    (ops/mpc_device.py::quantize_device): float32 scale/round with the
+    same sign-preserving saturation at the field edge. The float64
+    ``quantize`` above can differ from the device by one LSB per element
+    (test_mpc notes it); the secure-QUANTIZED aggregation parity pin
+    (privacy/secure_quant.py — host protocol == device program ==
+    plain quantized weighted mean, bitwise) needs the embeddings to
+    agree exactly, so the host path uses this float32 twin."""
+    lim = np.float32((p - 1) // 2)
+    if int(lim) > (p - 1) // 2:  # float32 rounded UP past the field edge
+        lim = np.nextafter(lim, np.float32(0.0))
+    scaled = np.rint(np.asarray(x, np.float32) * np.float32(1 << frac_bits))
+    # NaN passes through clip and the int cast would yield INT_MIN — an
+    # arbitrary out-of-field "residue" that corrupts the aggregate. Map
+    # it to the zero residue (a neutral contribution) instead; +/-inf
+    # saturates sign-preservingly via the clip. Mirrored on device.
+    scaled = np.where(np.isnan(scaled), np.float32(0.0), scaled)
+    v = np.clip(scaled, -lim, lim).astype(np.int32).astype(np.int64)
+    return np.where(v < 0, v + p, v)
+
+
+def dequantize32(q, p: int = P_DEFAULT, frac_bits: int = 16) -> np.ndarray:
+    """float32 centered lift matching ``dequantize_device`` bitwise."""
+    q = _asfield(q, p)
+    centered = np.where(q > p // 2, q - p, q).astype(np.int32)
+    return centered.astype(np.float32) / np.float32(1 << frac_bits)

@@ -53,6 +53,10 @@ def quantize_device(x: jax.Array, p: int = P_DEFAULT,
     if int(lim) > (p - 1) // 2:  # float32 rounded UP past the field edge
         lim = _np.nextafter(lim, _np.float32(0.0))
     scaled = jnp.rint(x.astype(jnp.float32) * (1 << frac_bits))
+    # NaN would survive the clip and hit the int cast as an undefined
+    # conversion — map it to the zero residue (neutral contribution),
+    # bitwise-matching the host quantize32's NaN rule
+    scaled = jnp.where(jnp.isnan(scaled), jnp.float32(0.0), scaled)
     v = jnp.clip(scaled, -lim, lim).astype(jnp.int32)
     return jnp.where(v < 0, v + p, v).astype(jnp.uint32)
 
@@ -95,6 +99,13 @@ def secure_sum_device(stack: jax.Array, key: jax.Array, n_shares: int,
             f"secure_sum_device needs n_shares >= 2 ({n_shares} given): "
             "with a single share there is no masking material and the "
             "'secure' aggregation would be the plaintext sum")
+    if not 1 < p < 1 << 31:
+        # the whole pipeline rides uint32 residues whose pairwise sums
+        # must not wrap before the % p that follows each add; this also
+        # admits the SMALL fields of the secure-quantized path
+        # (privacy/secure_quant.py ships uint16 frames over
+        # p = FIELD_PRIMES[16] and aggregates through this same program)
+        raise ValueError(f"field modulus p must be in (1, 2^31), got {p}")
     S = stack.shape[0]
     pp = jnp.uint32(p)
     q = quantize_device(stack, p=p, frac_bits=frac_bits)       # [S, ...]

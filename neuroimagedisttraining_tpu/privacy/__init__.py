@@ -1,0 +1,37 @@
+"""Privacy plane: secure quantized aggregation + a real RDP accountant.
+
+Two pillars (ROADMAP item 5, ARCHITECTURE.md "Privacy plane"):
+
+- ``secure_quant`` — secure aggregation over uniform-QUANTIZED updates
+  in a small GF(p): field-element frames (one wire-dtype residue per
+  parameter + seed-expanded mask slots) replace the dense protocol's
+  int64 share stacks, so privacy finally composes with the bandwidth
+  story instead of costing 6x the plain wire. Bitwise-exact vs the
+  plain quantized weighted mean on the same survivor set, Bonawitz
+  dropout semantics preserved.
+- ``accountant`` — an RDP/moments accountant (subsampled Gaussian,
+  integer order grid, Mironov epsilon conversion) wired into the
+  ``weak_dp`` defense and the dpsgd clip+noise path, reporting per-silo
+  (epsilon, delta) in ``stat_info`` and the run-end audit.
+
+Key discipline (nidtlint ``dp-key-discipline``): nothing in this
+package constructs a PRNG root — mask/noise randomness arrives as
+caller-threaded generators or jax keys derived from the config seed.
+"""
+
+from neuroimagedisttraining_tpu.privacy.accountant import (  # noqa: F401
+    DEFAULT_ORDERS,
+    RDPAccountant,
+    rdp_gaussian,
+    rdp_to_epsilon,
+    weak_dp_noise_multiplier,
+)
+from neuroimagedisttraining_tpu.privacy.secure_quant import (  # noqa: F401
+    QuantSpec,
+    SlotAccumulator,
+    check_headroom,
+    encode_secure_quant,
+    integer_weights,
+    is_secure_quant_frame,
+    quantized_weighted_mean,
+)

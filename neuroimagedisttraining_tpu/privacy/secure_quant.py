@@ -1,0 +1,454 @@
+"""Secure QUANTIZED aggregation: field-element frames over small GF(p).
+
+The dense secure protocol (cross_silo.SecureFedAvgServer) ships every
+client upload as ``n_shares`` int64 slot arrays — ~``8 * n_shares``
+bytes per parameter, 6x MORE than the plain dense wire it is protecting.
+Bonawitz et al. 2017's observation is that secure aggregation is just a
+sum inside a finite ring, and the ring only needs to hold the AGGREGATE:
+uniform-quantize each update into a small field, mask it there, and the
+wire carries one small residue per parameter instead of a stack of
+int64 slots.
+
+Two composed ideas:
+
+- **Small field.** With the two-phase weight exchange (each client
+  shares ``quantize(w_c * update)``, ``sum w_c <= 1``) the aggregate is
+  the weighted MEAN, so ``|sum_c v_c| < B * 2^frac_bits`` for a value
+  bound B independent of cohort size — a 16-bit prime
+  (``mpc.FIELD_PRIMES[16] = 65521``) holds it with room to spare.
+  Individual residues may wrap (quantization is mod p); only the
+  aggregate needs headroom, and ``check_headroom`` verifies it at
+  STARTUP against the configured field/frac_bits/cohort.
+- **Seed-expanded masks.** Additive sharing splits ``q`` into
+  ``n_shares`` slots of which ``n_shares - 1`` are pure randomness.
+  Those slots are shipped as 64-bit PRG SEEDS; only the data slot
+  ``q - sum(masks) mod p`` rides the wire as field elements. The server
+  re-expands the seeds and folds every slot SLOT-MAJOR into int64
+  accumulators — the same privacy invariant as the dense protocol (no
+  server-side intermediate equals a client's quantized update; the
+  ``trace`` hook lets tests assert it) under the same trust model as
+  the single-aggregator degenerate mode (the server holds everything
+  needed to unmask ONE client and is trusted not to — exactly as it is
+  trusted not to combine one client's slots in the dense protocol).
+
+Wire cost: ``wire_dtype_for(p)`` bytes per parameter + 8 bytes per
+extra share — ~2 B/param at the default 16-bit field vs ~24 B/param
+for the dense secure protocol at ``n_shares = 3`` (measured for real in
+scripts/run_secure_bench.sh -> bench_matrix/secure_bench.json).
+
+Exactness contract (the parity pin, tests/test_privacy.py): the folded,
+dequantized aggregate equals ``quantized_weighted_mean`` — the plain
+quantized ``tree_weighted_mean`` over the same survivor set — BITWISE,
+and equals the jitted device program (ops/mpc_device.py
+``secure_sum_device`` at this p/frac_bits) bitwise too, because host
+(``mpc.quantize32``) and device (``quantize_device``) use the identical
+float32 embedding and the mask material cancels exactly in the field.
+
+Dropout (Bonawitz semantics, inherited from PR 2): a client's frame
+folds whole or not at all — there is no partial fold — and a phase-B
+dropout leaves the survivors' weight mass W < 1, which the server
+repairs by rescaling 1/W after dequantize (survivor re-weighting).
+
+Host numpy only (the OS-process federation runs deviceless); the jitted
+counterpart for simulated engines is the existing
+``ops/mpc_device.secure_aggregate_tree`` parameterized with this spec's
+``(p, frac_bits)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import numpy as np
+
+from neuroimagedisttraining_tpu.codec.wire import SECURE_QUANT_KEY
+from neuroimagedisttraining_tpu.ops import mpc
+
+PyTree = Any
+
+SQ_VERSION = 1
+
+#: aggregate-magnitude bound the startup headroom check assumes: the
+#: weighted mean of model updates (weights summing to <= 1) stays below
+#: this per coordinate. 3D-CNN params here live in [-1, 1]; 16 leaves a
+#: 16x margin (and fits the default 16-bit field at frac_bits 10 with
+#: 2x to spare), and a violation is a defined sign-preserving
+#: saturation (quantize32's field-edge clamp), never silent wraparound
+#: garbage.
+VALUE_BOUND = 16.0
+
+#: fixed-point bits for integer-scaled aggregation weights (the async
+#: one-phase path, where weights are staleness-discounted floats): a
+#: weight is folded as round(w * 2^WEIGHT_FRAC_BITS) inside the field
+WEIGHT_FRAC_BITS = 6
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantSpec:
+    """Field + fixed-point geometry of one secure-quant deployment.
+    Hashable (jit-static); both endpoints must agree — frames carry the
+    triple and the server validates it on every fold."""
+
+    p: int = mpc.FIELD_PRIMES[16]
+    frac_bits: int = 10
+    n_shares: int = 3
+
+    @staticmethod
+    def from_bits(field_bits: int, frac_bits: int = 10,
+                  n_shares: int = 3) -> "QuantSpec":
+        if field_bits not in mpc.FIELD_PRIMES:
+            raise ValueError(
+                f"secure_quant_field_bits must be one of "
+                f"{sorted(mpc.FIELD_PRIMES)} (got {field_bits})")
+        return QuantSpec(p=mpc.FIELD_PRIMES[field_bits],
+                         frac_bits=int(frac_bits),
+                         n_shares=int(n_shares))
+
+    @property
+    def wire_dtype(self) -> np.dtype:
+        return mpc.wire_dtype_for(self.p)
+
+
+def check_headroom(spec: QuantSpec, cohort: int,
+                   value_bound: float = VALUE_BOUND) -> None:
+    """STARTUP validation of the field geometry (never mid-round):
+
+    - the dequantized AGGREGATE must fit the centered field range
+      (``value_bound * 2^frac_bits < p/2``) — individual residues may
+      wrap, the sum may not;
+    - the int64 slot accumulators must never overflow over the cohort
+      (weighted folds scale by up to ``2^WEIGHT_FRAC_BITS * n_max``);
+    - the device program's uint32 add-mod lattice needs ``p < 2^31``.
+    """
+    if spec.n_shares < 2:
+        raise ValueError(
+            f"secure_quant needs n_shares >= 2 (got {spec.n_shares}): one "
+            "share is the plaintext")
+    if not 1 < spec.p < 1 << 31:
+        raise ValueError(f"field modulus {spec.p} outside (1, 2^31)")
+    if spec.frac_bits < 1:
+        raise ValueError(f"frac_bits must be >= 1, got {spec.frac_bits}")
+    agg_range = value_bound * (1 << spec.frac_bits)
+    if agg_range >= spec.p // 2:
+        raise ValueError(
+            f"secure_quant headroom exceeded: aggregate range "
+            f"value_bound * 2^frac_bits = {agg_range:.0f} must stay below "
+            f"p/2 = {spec.p // 2} — lower secure_quant_frac_bits or raise "
+            f"secure_quant_field_bits (p={spec.p}, "
+            f"frac_bits={spec.frac_bits})")
+    if cohort > 0 and cohort * (spec.p - 1) >= 1 << 62:
+        raise ValueError(
+            f"slot accumulator headroom exceeded: cohort {cohort} x "
+            f"(p-1) overflows int64")
+
+
+# ---------------------------------------------------------------------------
+# frames
+# ---------------------------------------------------------------------------
+
+def _named_leaves(tree: PyTree) -> list[tuple[str, np.ndarray]]:
+    from neuroimagedisttraining_tpu.codec.wire import (
+        _named_leaves as named,
+    )
+
+    return named(tree)
+
+
+def _mask_slot(seed: int, sizes: list[tuple[str, int]],
+               p: int) -> dict[str, np.ndarray]:
+    """Expand one share seed into per-leaf uniform GF(p) material —
+    identical on client and server (one sequential seeded stream per
+    slot, walked in the frame's leaf order, which both ends derive from
+    the same tree structure). The seed itself is the client's secret
+    entropy; this expansion is a deterministic function of it."""
+    rng = np.random.default_rng(np.uint64(seed))
+    return {name: rng.integers(0, p, size=n, dtype=np.int64)
+            for name, n in sizes}
+
+
+def is_secure_quant_frame(obj: Any) -> bool:
+    return isinstance(obj, dict) and SECURE_QUANT_KEY in obj
+
+
+def leaf_scales(reference: PyTree,
+                value_bound: float = VALUE_BOUND) -> dict[str, float]:
+    """Per-leaf power-of-two scale factors derived from the round's
+    broadcast ``reference`` — both endpoints hold the identical tree
+    (the round-tag gate guarantees it), so both derive the identical
+    scales with NOTHING extra on the wire. Values are quantized as
+    ``x / scale`` and the aggregate multiplied back at finalize; powers
+    of two make the float32 divide/multiply exact, so the bitwise
+    parity pin survives scaling.
+
+    Why: model PARAMS live well inside ``value_bound``, but BatchNorm
+    running statistics track raw activation moments and can reach the
+    hundreds — without scaling they'd saturate the 16-bit field's range
+    (defined, sign-preserving, but a wrong aggregate). The scale gives
+    each leaf ``2 * max(|ref|, 1)`` of headroom: updates are residuals
+    of the reference, so a leaf would have to quadruple in one round to
+    reach the saturation edge."""
+    out = {}
+    for name, leaf in _named_leaves(reference):
+        m = float(np.max(np.abs(np.asarray(leaf, np.float32))))  \
+            if np.asarray(leaf).size else 0.0
+        need = 2.0 * max(m, 1.0)
+        out[name] = float(2.0 ** math.ceil(math.log2(need / value_bound))) \
+            if need > value_bound else 1.0
+    return out
+
+
+def encode_secure_quant(update: PyTree, weight: float, spec: QuantSpec,
+                        rng: np.random.Generator,
+                        scales: dict[str, float] | None = None) -> dict:
+    """One client's field-element frame: quantize ``weight * update``
+    into GF(p) (float32 embedding — ``mpc.quantize32``), draw
+    ``n_shares - 1`` mask seeds from the client's OWN rng, and ship
+    ``q - sum(masks) mod p`` as the data slot in the field's wire dtype
+    plus the seeds. ``weight`` is the phase-A normalized FedAvg weight
+    (two-phase sync protocol) or 1.0 (one-phase async protocol — the
+    server folds integer-scaled weights instead). ``scales`` are the
+    per-leaf ``leaf_scales`` both endpoints derive from the round's
+    reference (None = unscaled)."""
+    named = _named_leaves(update)
+    sizes = [(name, int(np.asarray(x).size)) for name, x in named]
+    seeds = rng.integers(0, np.iinfo(np.uint64).max, size=spec.n_shares - 1,
+                         dtype=np.uint64)
+    masked = {name: mpc.quantize32(
+        np.float32(weight) * np.asarray(x, np.float32).reshape(-1)
+        / np.float32(scales[name] if scales else 1.0),
+        p=spec.p, frac_bits=spec.frac_bits) for name, x in named}
+    for seed in seeds:
+        mat = _mask_slot(int(seed), sizes, spec.p)
+        masked = {name: np.mod(masked[name] - mat[name], spec.p)
+                  for name, _ in sizes}
+    leaves = {}
+    for name, x in named:
+        arr = np.asarray(x)
+        leaves[name] = {"sh": list(arr.shape), "dt": str(arr.dtype),
+                        "v": masked[name].astype(spec.wire_dtype)}
+    return {SECURE_QUANT_KEY: SQ_VERSION, "p": int(spec.p),
+            "fb": int(spec.frac_bits), "k": int(spec.n_shares),
+            "seeds": seeds, "leaves": leaves}
+
+
+def _validate_frame(frame: dict, spec: QuantSpec) -> None:
+    if not is_secure_quant_frame(frame):
+        raise ValueError(
+            "expected a secure-quant field-element frame; got a "
+            f"{type(frame).__name__} without the frame magic — the sender "
+            "is not running --secure_quant (config skew)")
+    ver = int(frame[SECURE_QUANT_KEY])
+    if ver != SQ_VERSION:
+        raise ValueError(f"secure-quant frame version {ver} != supported "
+                         f"{SQ_VERSION}")
+    got = (int(frame["p"]), int(frame["fb"]), int(frame["k"]))
+    want = (spec.p, spec.frac_bits, spec.n_shares)
+    if got != want:
+        raise ValueError(
+            f"secure-quant spec mismatch: frame carries (p, frac_bits, "
+            f"n_shares) = {got}, server configured {want} — every rank "
+            "must share one --secure_quant_field_bits / "
+            "--secure_quant_frac_bits / --mpc_n_shares configuration")
+    n_seeds = int(np.asarray(frame["seeds"]).size)
+    if n_seeds != spec.n_shares - 1:
+        raise ValueError(
+            f"secure-quant frame carries {n_seeds} mask seeds, expected "
+            f"n_shares - 1 = {spec.n_shares - 1}")
+
+
+# ---------------------------------------------------------------------------
+# server-side fold
+# ---------------------------------------------------------------------------
+
+class SlotAccumulator:
+    """Slot-major GF(p) accumulation over arriving frames — the secure
+    server's only model-sized state. Slot j of every client folds into
+    accumulator j; accumulators combine only in ``finalize`` (the
+    privacy invariant the dense protocol pins: no stored intermediate
+    equals any client's quantized update). ``trace`` (tests-only)
+    records every post-fold accumulator state."""
+
+    def __init__(self, spec: QuantSpec, trace: list | None = None,
+                 like: PyTree | None = None):
+        self.spec = spec
+        self.trace = trace
+        self._slots: list[dict[str, np.ndarray]] | None = None
+        #: expected (leaf name, flat size) structure: from ``like`` when
+        #: the caller owns a template (the server's params), else locked
+        #: to the first folded frame — every later frame must match
+        #: BEFORE any accumulator mutation (fold atomicity)
+        self._sizes: list[tuple[str, int]] | None = None
+        if like is not None:
+            self._sizes = [(name, int(np.asarray(x).size))
+                           for name, x in _named_leaves(like)]
+        self.folded = 0
+
+    @staticmethod
+    def _frame_sizes(frame: dict) -> list[tuple[str, int]]:
+        return [(name, int(np.prod(rec["sh"])) if rec["sh"] else 1)
+                for name, rec in frame["leaves"].items()]
+
+    def _expand(self, frame: dict) -> list[dict[str, np.ndarray]]:
+        sizes = self._frame_sizes(frame)
+        slots = [_mask_slot(int(s), sizes, self.spec.p)
+                 for s in np.asarray(frame["seeds"]).tolist()]
+        slots.append({name: np.asarray(rec["v"], np.int64)
+                      for name, rec in frame["leaves"].items()})
+        return slots
+
+    def fold(self, frame: dict, weight_int: int = 1) -> None:
+        """Fold one client's frame WHOLE or not at all (the Bonawitz
+        discard contract): the frame's leaf structure is validated
+        against the template/first frame BEFORE any accumulator
+        mutation, so a structurally skewed frame raises with the
+        accumulators untouched. ``weight_int`` scales every slot inside
+        the field — 1 for the two-phase protocol (weights were applied
+        client-side), the integer-scaled staleness weight for the async
+        one-phase path."""
+        _validate_frame(frame, self.spec)
+        w = int(weight_int)
+        if w < 1:
+            raise ValueError(f"weight_int must be >= 1, got {w}")
+        sizes = self._frame_sizes(frame)
+        if self._sizes is None:
+            self._sizes = sizes
+        elif sizes != self._sizes:
+            raise ValueError(
+                "secure-quant frame leaf structure mismatch: frame "
+                f"carries {sizes[:3]}... vs expected {self._sizes[:3]}"
+                "... — sender and receiver model trees differ (version "
+                "skew); frame discarded whole")
+        slots = self._expand(frame)
+        if self._slots is None:
+            self._slots = [
+                {name: (w * v) % self.spec.p for name, v in s.items()}
+                for s in slots]
+        else:
+            for acc, s in zip(self._slots, slots):
+                for name, v in s.items():
+                    # w * v < 2^? : w <= 2^WEIGHT_FRAC_BITS * n_max and
+                    # v < p < 2^31; check_headroom bounds the product
+                    acc[name] = (acc[name] + w * v) % self.spec.p
+        self.folded += 1
+        if self.trace is not None:
+            self.trace.extend(np.concatenate(
+                [a.reshape(-1) for a in s.values()]).copy()
+                for s in self._slots)
+
+    def finalize(self, like: PyTree, rescale: float = 1.0,
+                 scales: dict[str, float] | None = None) -> PyTree:
+        """Combine slots, dequantize (float32 centered lift — bitwise
+        the device program's), undo the per-leaf ``leaf_scales``,
+        rescale (1/W survivor re-weighting or 1/sum(w_int) for weighted
+        folds), reshape like ``like``."""
+        if self._slots is None:
+            raise ValueError("finalize() before any frame folded")
+        total = self._slots[0]
+        for s in self._slots[1:]:
+            total = {name: (total[name] + s[name]) % self.spec.p
+                     for name in total}
+        out = {}
+        for name, t in total.items():
+            deq = mpc.dequantize32(t, p=self.spec.p,
+                                   frac_bits=self.spec.frac_bits)
+            if scales:
+                deq = deq * np.float32(scales[name])
+            out[name] = np.asarray(rescale * deq, np.float64)
+        self._slots = None
+        self.folded = 0
+        from neuroimagedisttraining_tpu.codec.wire import _rebuild_like
+
+        named = _named_leaves(like)
+        rebuilt = {}
+        for name, x in named:
+            arr = np.asarray(x)
+            rebuilt[name] = out[name].reshape(arr.shape).astype(arr.dtype)
+        return _rebuild_like(like, rebuilt)
+
+
+# ---------------------------------------------------------------------------
+# references + helpers
+# ---------------------------------------------------------------------------
+
+def quantized_weighted_mean(trees: list, weights, spec: QuantSpec,
+                            rescale: float = 1.0,
+                            scales: dict[str, float] | None = None
+                            ) -> PyTree:
+    """THE parity reference: the plain (mask-free) quantized weighted
+    mean ``dequantize(sum_c quantize(w_c * u_c))`` over normalized
+    weights, computed with the identical float32 embedding and the same
+    per-leaf scales — what the secure fold must equal BITWISE on the
+    same survivor set."""
+    w = np.asarray(weights, np.float64)
+    wn = w / max(float(np.sum(w)), 1e-12)
+    acc: dict[str, np.ndarray] | None = None
+    for tree, wc in zip(trees, wn):
+        named = _named_leaves(tree)
+        q = {name: mpc.quantize32(
+            np.float32(wc) * np.asarray(x, np.float32).reshape(-1)
+            / np.float32(scales[name] if scales else 1.0),
+            p=spec.p, frac_bits=spec.frac_bits) for name, x in named}
+        acc = q if acc is None else {
+            name: (acc[name] + q[name]) % spec.p for name in acc}
+    from neuroimagedisttraining_tpu.codec.wire import _rebuild_like
+
+    named = _named_leaves(trees[0])
+    out = {}
+    for name, x in named:
+        arr = np.asarray(x)
+        deq = mpc.dequantize32(acc[name] % spec.p, p=spec.p,
+                               frac_bits=spec.frac_bits)
+        if scales:
+            deq = deq * np.float32(scales[name])
+        out[name] = np.asarray(rescale * deq, np.float64).reshape(
+            arr.shape).astype(arr.dtype)
+    return _rebuild_like(trees[0], out)
+
+
+def weighted_fold_capacity(spec: QuantSpec,
+                           value_bound: float = VALUE_BOUND) -> float:
+    """Total integer weight mass one aggregation can fold before the
+    weighted aggregate leaves the field's centered range — the
+    feasibility bound the async server checks at STARTUP against its
+    buffer size (a 16-bit field folds ~2 weight units; the one-phase
+    buffered path effectively needs field_bits 32)."""
+    return (spec.p // 2) / (value_bound * (1 << spec.frac_bits))
+
+
+def integer_weights(weights, spec: QuantSpec,
+                    value_bound: float = VALUE_BOUND
+                    ) -> tuple[np.ndarray, float]:
+    """Integer-scaled fold weights for the one-phase (async) path.
+    Only weight RATIOS matter (the dequantized total is divided by the
+    integer mass), so weights are normalized by their max and scaled by
+    the largest ``2^s, s <= WEIGHT_FRAC_BITS`` whose total stays inside
+    ``weighted_fold_capacity`` — the staleness ratios are preserved to
+    ~2^-s relative precision. Deterministic in the weights, so a replay
+    reproduces the aggregation bitwise. Returns ``(w_int[C], denom)``
+    with the weighted mean = dequantized total / denom."""
+    from neuroimagedisttraining_tpu.privacy.accountant import (
+        validate_weights,
+    )
+
+    w = validate_weights(weights)
+    wn = w / float(np.max(w))
+    limit = weighted_fold_capacity(spec, value_bound)
+    for s in range(WEIGHT_FRAC_BITS, -1, -1):
+        wi = np.maximum(np.rint(wn * (1 << s)).astype(np.int64), 1)
+        # an accepted upload never folds at 0 ^ (it was admitted)
+        if float(np.sum(wi)) < limit:
+            return wi, float(np.sum(wi))
+    raise ValueError(
+        f"secure_quant weighted-fold headroom exhausted: {w.size} "
+        f"buffered uploads cannot fold inside p={spec.p} at "
+        f"frac_bits={spec.frac_bits} (capacity {limit:.1f} weight "
+        "units) — use --secure_quant_field_bits 32 for the buffered "
+        "one-phase path, or shrink --buffer_k")
+
+
+def frame_nbytes(frame: dict) -> int:
+    from neuroimagedisttraining_tpu.codec import wire as codec_wire
+
+    return codec_wire.frame_nbytes(frame)

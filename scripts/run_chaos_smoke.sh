@@ -13,7 +13,12 @@
 #     the selector comm core, kill-k churn + trimmed_mean armed, no
 #     round barrier — every aggregation must land, the model stay
 #     finite, and BOTH accounting audits (received == accepted +
-#     dropped; accepted == aggregated + buffered) come back green.
+#     dropped; accepted == aggregated + buffered) come back green;
+#   - secure_quant + kill-k (ISSUE 8): client 3 crashes at round 1
+#     under secure QUANTIZED aggregation (privacy/secure_quant.py) —
+#     the two-phase Bonawitz discard drops the corpse's frame whole,
+#     the survivor re-weighting keeps the aggregate a true weighted
+#     mean, and every round still completes over field-element frames.
 #
 # Heavier than the tier-1 suite (each run trains the tiny 3D CNN in 5
 # real OS processes), so it lives here as a CI smoke, not a pytest.
@@ -143,10 +148,58 @@ print(f"OK(async): {res['rounds_completed']} aggregations, "
 EOF
 }
 
+run_secure_quant() {
+    local port
+    port=$($PY -c "from neuroimagedisttraining_tpu.distributed.ports \
+import free_port_block; print(free_port_block(16))")
+    local common=(--num_clients "$CLIENTS" --comm_round "$ROUNDS"
+                  --model 3dcnn_tiny --dataset synthetic
+                  --synthetic_num_subjects 24
+                  --synthetic_shape 12 14 12 --batch_size 4
+                  --base_port "$port" --force_cpu
+                  --secure_quant
+                  --fault_spec "crash:3@1"
+                  --round_deadline 30 --quorum 2
+                  --heartbeat_interval 0.5 --heartbeat_timeout 5)
+    echo "== chaos smoke (secure_quant cell, port $port): kill client 3" \
+         "at round 1 under secure quantized aggregation =="
+    local out="/tmp/chaos_smoke_secure_quant.log"
+    $PY -m neuroimagedisttraining_tpu.distributed.run \
+        --role server "${common[@]}" > "$out" 2>&1 &
+    local server_pid=$!
+    local pids=()
+    for r in $(seq 1 "$CLIENTS"); do
+        $PY -m neuroimagedisttraining_tpu.distributed.run \
+            --role client --rank "$r" "${common[@]}" \
+            > "/tmp/chaos_smoke_secure_quant_c${r}.log" 2>&1 &
+        pids+=($!)
+    done
+    if ! wait "$server_pid"; then
+        echo "FAIL(secure_quant): server exited non-zero"
+        cat "$out"; return 1
+    fi
+    for p in "${pids[@]}"; do wait "$p" 2>/dev/null || true; done
+    local json
+    json=$(grep -a -o '^{.*}' "$out" | tail -1)
+    echo "$json"
+    $PY - "$json" <<EOF
+import json, math, sys
+res = json.loads(sys.argv[1])
+assert res["secure_quant"] is True, res
+assert res["rounds_completed"] == $ROUNDS, res
+assert 3 in res["suspects"], f"killed client not flagged suspect: {res}"
+assert math.isfinite(res["final_param_norm"]), res
+print(f"OK(secure_quant/crash): {res['rounds_completed']} rounds over "
+      f"field-element frames, suspects={res['suspects']}, "
+      f"|params|={res['final_param_norm']:.3f}")
+EOF
+}
+
 rc=0
 run_one socket crash || rc=1
 run_one broker crash || rc=1
 run_one socket byz   || rc=1
 run_one broker byz   || rc=1
 run_async            || rc=1
+run_secure_quant     || rc=1
 exit $rc

@@ -1,0 +1,117 @@
+#!/usr/bin/env bash
+# Secure-aggregation wire A/B over the REAL socket transport (ISSUE 8
+# acceptance): four 2-silo federations through distributed/run.py —
+#   plain         dense float32 pytrees (the baseline wire)
+#   codec         --wire_codec delta+quant (the compression story)
+#   secure_dense  --secure (int64 share slots: privacy at 6x the wire)
+#   secure_quant  --secure_quant (field-element frames: privacy at a
+#                 FRACTION of the dense-secure wire)
+# The server's transport byte counters give true server-received bytes;
+# wall time per run / per round rides along. The summary asserts
+#   - secure_quant >= 5x fewer server-received bytes than secure_dense,
+#   - final_param_norm parity between secure_quant and plain (same
+#     seeds => same trajectories up to fixed-point quantization),
+# and writes the artifact to bench_matrix/secure_bench.json.
+#
+# The model is 3dcnn_tiny on small volumes: bytes ratios are param-tree
+# properties (uintN residues + seeds vs n_shares x int64 slots per
+# parameter), not input-size properties — CPU step time is what the
+# small shape buys.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PY=${PYTHON:-python}
+ROUNDS=${SECURE_BENCH_ROUNDS:-3}
+CLIENTS=2
+MODEL=${SECURE_BENCH_MODEL:-3dcnn_tiny}
+SHAPE=${SECURE_BENCH_SHAPE:-"12 14 12"}
+OUT=bench_matrix/secure_bench.json
+mkdir -p bench_matrix /tmp/secure_bench
+
+run_one() {
+    local tag=$1; shift
+    local port
+    port=$($PY -c "from neuroimagedisttraining_tpu.distributed.ports \
+import free_port_block; print(free_port_block(8))")
+    # shellcheck disable=SC2086 — SHAPE expands to three ints
+    local common=(--num_clients "$CLIENTS" --comm_round "$ROUNDS"
+                  --model "$MODEL" --dataset synthetic
+                  --synthetic_num_subjects 24
+                  --synthetic_shape $SHAPE --batch_size 4
+                  --base_port "$port" --force_cpu --seed 7 "$@")
+    echo "== secure bench [$tag] (port $port): $* =="
+    local out="/tmp/secure_bench/${tag}.log"
+    local t0
+    t0=$($PY -c "import time; print(time.monotonic())")
+    $PY -m neuroimagedisttraining_tpu.distributed.run \
+        --role server "${common[@]}" > "$out" 2>&1 &
+    local server_pid=$!
+    local pids=()
+    for r in $(seq 1 "$CLIENTS"); do
+        $PY -m neuroimagedisttraining_tpu.distributed.run \
+            --role client --rank "$r" "${common[@]}" \
+            > "/tmp/secure_bench/${tag}_c${r}.log" 2>&1 &
+        pids+=($!)
+    done
+    if ! wait "$server_pid"; then
+        echo "FAIL($tag): server exited non-zero"; tail -20 "$out"; return 1
+    fi
+    for p in "${pids[@]}"; do wait "$p" 2>/dev/null || true; done
+    local t1
+    t1=$($PY -c "import time; print(time.monotonic())")
+    grep -a -o '^{.*}' "$out" | tail -1 > "/tmp/secure_bench/${tag}.json"
+    $PY - "$tag" "$t0" "$t1" <<'PYEOF'
+import json, sys
+tag, t0, t1 = sys.argv[1], float(sys.argv[2]), float(sys.argv[3])
+path = f"/tmp/secure_bench/{tag}.json"
+res = json.load(open(path))
+res["wall_s"] = round(t1 - t0, 3)
+json.dump(res, open(path, "w"))
+print(json.dumps({k: res[k] for k in
+                  ("rounds_completed", "bytes_recv", "wall_s")}))
+PYEOF
+}
+
+rc=0
+run_one plain                                  || rc=1
+run_one codec         --wire_codec delta+quant || rc=1
+run_one secure_dense  --secure                 || rc=1
+run_one secure_quant  --secure_quant           || rc=1
+[ $rc -ne 0 ] && exit $rc
+
+$PY - "$OUT" "$ROUNDS" "$MODEL" "$SHAPE" <<'EOF'
+import json, sys
+
+out_path, rounds, model, shape = (sys.argv[1], int(sys.argv[2]),
+                                  sys.argv[3], sys.argv[4])
+runs = {t: json.load(open(f"/tmp/secure_bench/{t}.json"))
+        for t in ("plain", "codec", "secure_dense", "secure_quant")}
+summary = {"rounds": rounds, "model": model, "shape": shape,
+           "runs": runs,
+           "cells": {t: {"bytes_recv": runs[t]["bytes_recv"],
+                         "wall_s": runs[t]["wall_s"],
+                         "round_wall_s": round(
+                             runs[t]["wall_s"] / rounds, 3)}
+                     for t in runs}}
+ratio = runs["secure_dense"]["bytes_recv"] / max(
+    runs["secure_quant"]["bytes_recv"], 1)
+vs_plain = runs["plain"]["bytes_recv"] / max(
+    runs["secure_quant"]["bytes_recv"], 1)
+a = runs["secure_quant"]["final_param_norm"]
+b = runs["plain"]["final_param_norm"]
+parity = abs(a - b) / max(abs(b), 1e-9)
+summary["secure_quant_vs_dense"] = {
+    "bytes_reduction_x": round(ratio, 2), "target_x": 5.0,
+    "bytes_vs_plain_x": round(vs_plain, 2),
+    "param_norm_rel_err_vs_plain": round(parity, 6),
+    "pass": bool(ratio >= 5.0 and parity < 2e-2),
+}
+print(f"secure_quant vs secure_dense: {ratio:.2f}x fewer bytes "
+      f"(target >= 5x); vs plain dense wire: {vs_plain:.2f}x; "
+      f"param-norm rel err {parity:.2e} -> "
+      f"{'PASS' if summary['secure_quant_vs_dense']['pass'] else 'FAIL'}")
+json.dump(summary, open(out_path, "w"), indent=1, sort_keys=True)
+print(f"artifact -> {out_path}")
+sys.exit(0 if summary["secure_quant_vs_dense"]["pass"] else 1)
+EOF

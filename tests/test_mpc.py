@@ -307,3 +307,112 @@ def test_turboaggregate_engine_matches_fedavg(tmp_path, synthetic_cohort):
         # trajectories stay close but not bitwise
         np.testing.assert_allclose(np.asarray(lp), np.asarray(ls),
                                    atol=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# GF(p) host==device boundary sweep (ISSUE 8 satellite): the float32
+# embedding (mpc.quantize32) must be BITWISE-identical to the device one
+# across the secure-quant field tier, including the field-edge clamp,
+# and a dropped-client round must reconstruct over the survivors only
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("p,frac_bits", [
+    (mpc.FIELD_PRIMES[8], 2),
+    (mpc.FIELD_PRIMES[16], 8),
+    (mpc.FIELD_PRIMES[16], 10),
+    (mpc.FIELD_PRIMES[32], 16),
+])
+def test_quantize32_host_device_bitwise_sweep(p, frac_bits):
+    """Host int64 path (x64 numpy) vs device path (x64-disabled jax):
+    identical residues over ordinary values, the exact field-edge
+    neighborhood, and the saturating overflow region."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    edge = (p - 1) // 2 / float(1 << frac_bits)
+    rng = np.random.default_rng(p % 1000 + frac_bits)
+    xs = np.concatenate([
+        (rng.standard_normal(64) * 0.5).astype(np.float32),
+        np.asarray([edge, -edge, edge * 0.999, -edge * 0.999,
+                    edge * 2, -edge * 2, 1e9, -1e9, 0.0],
+                   np.float32),
+    ])
+    host = mpc.quantize32(xs, p=p, frac_bits=frac_bits)
+    dev = np.asarray(jax.jit(
+        lambda v: D.quantize_device(v, p=p, frac_bits=frac_bits))(
+        jnp.asarray(xs))).astype(np.int64)
+    np.testing.assert_array_equal(host, dev)
+    assert (host < p).all()  # residues stay strictly inside the field
+    # the centered lifts agree bitwise too
+    hback = mpc.dequantize32(host, p=p, frac_bits=frac_bits)
+    dback = np.asarray(D.dequantize_device(jnp.asarray(dev, jnp.uint32),
+                                           p=p, frac_bits=frac_bits))
+    assert hback.tobytes() == dback.tobytes()
+
+
+@pytest.mark.parametrize("p,frac_bits", [
+    (mpc.FIELD_PRIMES[16], 10),
+    (mpc.FIELD_PRIMES[32], 16),
+])
+def test_secure_sum_device_small_field_matches_host_fold(p, frac_bits):
+    """The device fori_loop pipeline at the secure-quant field tiers
+    equals the host slot fold bitwise — the mask material cancels in
+    both lattices, leaving the identical quantized sum."""
+    import jax
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    rng = np.random.default_rng(17)
+    stack = (rng.standard_normal((5, 33)) * 0.3).astype(np.float32)
+    dev = np.asarray(D.secure_sum_device(stack, jax.random.key(3),
+                                         n_shares=3,
+                                         frac_bits=frac_bits, p=p))
+    acc = np.zeros(33, np.int64)
+    for row in stack:
+        acc = (acc + mpc.quantize32(row, p=p, frac_bits=frac_bits)) % p
+    host = mpc.dequantize32(acc, p=p, frac_bits=frac_bits)
+    assert dev.tobytes() == host.tobytes()
+
+
+def test_secure_quant_dropped_client_round_host_device():
+    """Dropped-client reconstruction (the Bonawitz discard): the host
+    fold over the SURVIVOR frames equals the device program over the
+    survivor stack bitwise — the dropped client's mask material never
+    entered either side, so nothing needs unmasking."""
+    import jax
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+    from neuroimagedisttraining_tpu.privacy import (
+        QuantSpec, SlotAccumulator, encode_secure_quant,
+    )
+
+    spec = QuantSpec()
+    rng = np.random.default_rng(23)
+    trees = [{"w": (rng.standard_normal(21) * 0.4).astype(np.float32)}
+             for _ in range(4)]
+    ws = [0.4, 0.3, 0.2, 0.1]
+    surv = [0, 2, 3]  # client 1 dies mid-round
+    acc = SlotAccumulator(spec)
+    for i in surv:
+        acc.fold(encode_secure_quant(trees[i], ws[i], spec,
+                                     np.random.default_rng(80 + i)))
+    host = acc.finalize(like=trees[0])["w"]
+    stack = np.stack([np.float32(ws[i]) * trees[i]["w"] for i in surv])
+    dev = np.asarray(D.secure_sum_device(stack, jax.random.key(5),
+                                         n_shares=spec.n_shares,
+                                         frac_bits=spec.frac_bits,
+                                         p=spec.p))
+    assert host.tobytes() == dev.tobytes()
+
+
+def test_secure_sum_device_rejects_oversized_field():
+    import jax
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    with pytest.raises(ValueError, match="2\\^31"):
+        D.secure_sum_device(np.ones((2, 3), np.float32),
+                            jax.random.key(0), n_shares=2, p=1 << 31)

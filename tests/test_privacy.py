@@ -1,0 +1,796 @@
+"""Privacy plane (privacy/, ISSUE 8): RDP accountant pins, secure
+quantized aggregation (bitwise parity, dropout, wire size, headroom),
+the cross-silo/async protocol integration, and the CLI startup matrix."""
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.distributed.ports import free_port_block
+from neuroimagedisttraining_tpu.ops import mpc
+from neuroimagedisttraining_tpu.privacy import (
+    DEFAULT_ORDERS,
+    QuantSpec,
+    RDPAccountant,
+    SlotAccumulator,
+    check_headroom,
+    encode_secure_quant,
+    integer_weights,
+    quantized_weighted_mean,
+    rdp_gaussian,
+    rdp_to_epsilon,
+    weak_dp_noise_multiplier,
+)
+
+SPEC = QuantSpec()  # 16-bit field, frac_bits 10, 3 shares
+
+
+# ------------------------------------------------ accountant
+
+
+def test_rdp_gaussian_q1_closed_form():
+    """Full participation collapses to the Gaussian mechanism's
+    RDP(alpha) = alpha / (2 sigma^2) — THE single-round reference."""
+    for sigma in (0.5, 1.0, 2.0, 7.3):
+        got = rdp_gaussian(1.0, sigma, orders=(2, 3, 8, 64))
+        want = np.asarray([2, 3, 8, 64]) / (2.0 * sigma * sigma)
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_rdp_subsampling_amplifies():
+    """q < 1 strictly reduces per-step RDP at every order, and RDP is
+    monotone in q (more sampling, more loss)."""
+    full = rdp_gaussian(1.0, 1.5)
+    for q in (0.01, 0.1, 0.5):
+        sub = rdp_gaussian(q, 1.5)
+        assert np.all(sub < full)
+    a, b = rdp_gaussian(0.05, 1.5), rdp_gaussian(0.2, 1.5)
+    assert np.all(a < b)
+    assert np.all(rdp_gaussian(0.0, 1.5) == 0.0)
+
+
+def test_epsilon_single_round_pinned_against_hand_conversion():
+    """epsilon(delta) must equal the hand-computed min over the order
+    grid of alpha/(2 sigma^2) + log(1/delta)/(alpha-1) for one q=1
+    round — the closed-form pin the acceptance criteria name."""
+    sigma, delta = 2.0, 1e-5
+    acct = RDPAccountant(delta=delta)
+    acct.step(1.0, sigma)
+    hand = min(a / (2 * sigma * sigma) + math.log(1 / delta) / (a - 1)
+               for a in DEFAULT_ORDERS)
+    assert acct.epsilon() == pytest.approx(hand, rel=1e-12)
+    # and the accountant is additive: T rounds = T * rdp before the
+    # conversion, NOT T * epsilon (the whole point of RDP composition)
+    acct10 = RDPAccountant(delta=delta)
+    acct10.step(1.0, sigma, steps=10)
+    hand10 = min(10 * a / (2 * sigma * sigma)
+                 + math.log(1 / delta) / (a - 1) for a in DEFAULT_ORDERS)
+    assert acct10.epsilon() == pytest.approx(hand10, rel=1e-12)
+    assert acct10.epsilon() < 10 * acct.epsilon()
+
+
+def test_epsilon_monotonicity():
+    """More steps -> more epsilon; more noise -> less; more delta ->
+    less. The sanity surface a broken accountant fails first."""
+    def eps(sigma=1.0, steps=10, q=0.1, delta=1e-5):
+        a = RDPAccountant(delta=delta)
+        a.step(q, sigma, steps=steps)
+        return a.epsilon()
+
+    assert eps(steps=1) < eps(steps=10) < eps(steps=100)
+    assert eps(sigma=4.0) < eps(sigma=1.0) < eps(sigma=0.5)
+    assert eps(q=0.01) < eps(q=0.1) < eps(q=1.0)
+    assert eps(delta=1e-3) < eps(delta=1e-7)
+    assert RDPAccountant().epsilon() == 0.0
+
+
+def test_accountant_validation():
+    with pytest.raises(ValueError, match="q must be"):
+        rdp_gaussian(1.5, 1.0)
+    with pytest.raises(ValueError, match="noise_multiplier"):
+        rdp_gaussian(0.5, 0.0)
+    with pytest.raises(ValueError, match="orders"):
+        rdp_gaussian(0.5, 1.0, orders=(1.5, 2))
+    with pytest.raises(ValueError, match="delta"):
+        rdp_to_epsilon(np.zeros(len(DEFAULT_ORDERS)), delta=0.0)
+    with pytest.raises(ValueError, match="norm_bound"):
+        weak_dp_noise_multiplier(0.0, 5.0, [1.0])
+
+
+def test_weak_dp_noise_multiplier_geometry():
+    """Uniform weights: z = stddev * sqrt(C) / norm_bound; skewed
+    weights use the exact sqrt(sum w^2)/max(w) ratio (a heavy silo gets
+    LESS amplification, never more)."""
+    assert weak_dp_noise_multiplier(0.05, 5.0, [3.0] * 4) == \
+        pytest.approx(0.05 * 2 / 5.0)
+    w = [10.0, 1.0, 1.0]
+    z = weak_dp_noise_multiplier(0.05, 5.0, w)
+    assert z == pytest.approx(
+        0.05 * math.sqrt(102.0) / (5.0 * 10.0))
+    assert z < weak_dp_noise_multiplier(0.05, 5.0, [1.0] * 3)
+
+
+# ------------------------------------------------ secure_quant core
+
+
+def _trees(n=4, seed=0, size=40):
+    rng = np.random.default_rng(seed)
+    return [{"w": (rng.standard_normal(size) * 0.5).astype(np.float32),
+             "b": (rng.standard_normal(3) * 0.5).astype(np.float32)}
+            for _ in range(n)]
+
+
+def test_fold_equals_quantized_weighted_mean_bitwise():
+    """THE parity pin: seed-expanded masked frames folded slot-major and
+    dequantized == the plain quantized weighted mean, BITWISE (the mask
+    material cancels exactly in GF(p))."""
+    trees, ns = _trees(), [10.0, 20.0, 5.0, 7.0]
+    W = sum(ns)
+    acc = SlotAccumulator(SPEC)
+    for i, (t, n) in enumerate(zip(trees, ns)):
+        acc.fold(encode_secure_quant(t, n / W, SPEC,
+                                     np.random.default_rng(100 + i)))
+    got = acc.finalize(like=trees[0])
+    want = quantized_weighted_mean(trees, ns, SPEC)
+    for k in ("w", "b"):
+        assert got[k].tobytes() == want[k].tobytes()
+
+
+def test_fold_matches_device_program_bitwise():
+    """host==device pin: the jitted uint32 mod-p pipeline
+    (ops/mpc_device.secure_sum_device) at this spec's (p, frac_bits)
+    over the client-weighted stack lands on the identical bytes — both
+    reduce to the same float32 embedding, and masks cancel in both."""
+    import jax
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    trees, ns = _trees(), [10.0, 20.0, 5.0, 7.0]
+    W = sum(ns)
+    want = quantized_weighted_mean(trees, ns, SPEC)
+    stack = np.stack([np.concatenate([np.float32(n / W) * t["w"],
+                                      np.float32(n / W) * t["b"]])
+                      for t, n in zip(trees, ns)])
+    dev = np.asarray(D.secure_sum_device(
+        stack, jax.random.key(0), n_shares=SPEC.n_shares,
+        frac_bits=SPEC.frac_bits, p=SPEC.p))
+    assert dev.tobytes() == np.concatenate([want["w"],
+                                            want["b"]]).tobytes()
+
+
+def test_dropout_fold_rescale_parity():
+    """Bonawitz discard: a dropped client's frame is simply never
+    folded (atomic), and the 1/W survivor rescale recovers the weighted
+    mean over the survivors — bitwise vs the survivor-only reference."""
+    trees, ns = _trees(seed=3), [10.0, 20.0, 5.0, 7.0]
+    W = sum(ns)
+    frames = [encode_secure_quant(t, n / W, SPEC,
+                                  np.random.default_rng(7 + i))
+              for i, (t, n) in enumerate(zip(trees, ns))]
+    surv = [0, 1, 3]  # client 2 dies between phases
+    acc = SlotAccumulator(SPEC)
+    for i in surv:
+        acc.fold(frames[i])
+    w_surv = sum(ns[i] for i in surv) / W
+    got = acc.finalize(like=trees[0], rescale=1.0 / w_surv)
+    # reference: same client-side weights w_i = n_i / W, then rescale
+    ref_acc = None
+    for i in surv:
+        q = {k: mpc.quantize32(
+            np.float32(ns[i] / W) * trees[i][k].reshape(-1),
+            p=SPEC.p, frac_bits=SPEC.frac_bits) for k in trees[i]}
+        ref_acc = q if ref_acc is None else {
+            k: (ref_acc[k] + q[k]) % SPEC.p for k in q}
+    for k in ("w", "b"):
+        deq = mpc.dequantize32(ref_acc[k], p=SPEC.p,
+                               frac_bits=SPEC.frac_bits)
+        want = np.asarray((1.0 / w_surv) * deq, np.float64).reshape(
+            trees[0][k].shape).astype(np.float32)
+        assert got[k].tobytes() == want.tobytes()
+
+
+def test_slot_intermediates_never_equal_plaintext():
+    """Privacy invariant (the dense protocol's, preserved): no recorded
+    slot-accumulator state equals any client's quantized update."""
+    trees, ns = _trees(seed=5), [1.0, 1.0, 1.0, 1.0]
+    tr = []
+    acc = SlotAccumulator(SPEC, trace=tr)
+    for i, (t, n) in enumerate(zip(trees, ns)):
+        acc.fold(encode_secure_quant(t, 0.25, SPEC,
+                                     np.random.default_rng(50 + i)))
+    qs = [np.concatenate([
+        mpc.quantize32(np.float32(0.25) * t["w"], p=SPEC.p,
+                       frac_bits=SPEC.frac_bits),
+        mpc.quantize32(np.float32(0.25) * t["b"], p=SPEC.p,
+                       frac_bits=SPEC.frac_bits)]) for t in trees]
+    assert len(tr) == 4 * SPEC.n_shares
+    for inter in tr:
+        for q in qs:
+            assert not np.array_equal(inter, q), \
+                "slot accumulator equals a client's plaintext update"
+
+
+def test_wire_bytes_beat_dense_secure_5x():
+    """The bandwidth claim at unit level (the socket-measured version
+    lives in scripts/run_secure_bench.sh): a field-element frame is
+    >= 5x smaller than the dense protocol's int64 share slots for the
+    same update — uint16 residues + 8-byte seeds vs n_shares x int64."""
+    from neuroimagedisttraining_tpu.codec.wire import frame_nbytes
+
+    tree = {"w": np.random.default_rng(0).standard_normal(4096)
+            .astype(np.float32)}
+    frame = encode_secure_quant(tree, 0.5, SPEC,
+                                np.random.default_rng(1))
+    dense_shares = {"w": mpc.additive_shares(
+        mpc.quantize(0.5 * np.asarray(tree["w"], np.float64)),
+        SPEC.n_shares, rng=np.random.default_rng(2))}
+    ratio = frame_nbytes(dense_shares) / frame_nbytes(frame)
+    assert ratio >= 5.0, f"only {ratio:.1f}x smaller than dense-secure"
+
+
+def test_leaf_scales_extend_range_bitwise():
+    """Per-leaf power-of-two scales (derived from the shared reference)
+    carry BatchNorm-magnitude leaves through the 16-bit field: values
+    far beyond VALUE_BOUND aggregate correctly, and the scaled fold
+    still equals the scaled reference BITWISE (powers of two are exact
+    in float32)."""
+    from neuroimagedisttraining_tpu.privacy.secure_quant import (
+        leaf_scales,
+    )
+
+    ref = {"params": np.zeros(8, np.float32),
+           "bn_var": np.full(8, 300.0, np.float32)}
+    scales = leaf_scales(ref)
+    assert scales["params"] == 1.0
+    assert scales["bn_var"] >= 300.0 * 2 / 16.0
+    assert math.log2(scales["bn_var"]) == int(math.log2(
+        scales["bn_var"]))
+    rng = np.random.default_rng(0)
+    trees = [{"params": (rng.standard_normal(8) * 0.3
+                         ).astype(np.float32),
+              "bn_var": (300.0 + rng.standard_normal(8) * 20
+                         ).astype(np.float32)} for _ in range(3)]
+    ns = [1.0, 2.0, 3.0]
+    acc = SlotAccumulator(SPEC)
+    for i, (t, n) in enumerate(zip(trees, ns)):
+        acc.fold(encode_secure_quant(t, n / 6.0, SPEC,
+                                     np.random.default_rng(i),
+                                     scales=scales))
+    got = acc.finalize(like=trees[0], scales=scales)
+    want = quantized_weighted_mean(trees, ns, SPEC, scales=scales)
+    for k in ref:
+        assert got[k].tobytes() == want[k].tobytes()
+    # and the scaled aggregate is actually CLOSE to the float mean
+    # (unscaled it would saturate at VALUE_BOUND and be wildly wrong)
+    fmean = np.average(np.stack([t["bn_var"] for t in trees]), axis=0,
+                       weights=ns)
+    np.testing.assert_allclose(got["bn_var"], fmean,
+                               atol=scales["bn_var"] * 2.0 ** -10 * 4)
+
+
+def test_headroom_checked_at_startup():
+    check_headroom(SPEC, 21)  # the flagship geometry fits
+    with pytest.raises(ValueError, match="headroom"):
+        check_headroom(QuantSpec(p=mpc.FIELD_PRIMES[16], frac_bits=16), 4)
+    with pytest.raises(ValueError, match="n_shares"):
+        check_headroom(QuantSpec(n_shares=1), 4)
+    with pytest.raises(ValueError, match="field_bits"):
+        QuantSpec.from_bits(12)
+
+
+def test_frame_spec_mismatch_rejected():
+    frame = encode_secure_quant({"w": np.ones(4, np.float32)}, 1.0,
+                                SPEC, np.random.default_rng(0))
+    other = QuantSpec.from_bits(32)
+    acc = SlotAccumulator(other)
+    with pytest.raises(ValueError, match="spec mismatch"):
+        acc.fold(frame)
+    with pytest.raises(ValueError, match="frame magic"):
+        SlotAccumulator(SPEC).fold({"w": np.ones(4)})
+
+
+def test_plain_codec_rejects_secure_quant_frame():
+    """A field-element frame reaching the PLAIN decode path must die
+    loudly (masked residues decoded as floats would silently poison the
+    aggregate), with the fix named."""
+    from neuroimagedisttraining_tpu.codec import decode_update
+
+    frame = encode_secure_quant({"w": np.ones(4, np.float32)}, 1.0,
+                                SPEC, np.random.default_rng(0))
+    with pytest.raises(ValueError, match="secure_quant"):
+        decode_update(frame, like={"w": np.ones(4, np.float32)})
+
+
+def test_integer_weights_preserve_ratios_and_cap():
+    spec32 = QuantSpec.from_bits(32)
+    w = [6.0, 3.0, 1.5]
+    wi, denom = integer_weights(w, spec32)
+    assert denom == float(np.sum(wi))
+    np.testing.assert_allclose(wi / wi[0], np.asarray(w) / w[0],
+                               rtol=0.02)
+    # a 16-bit field cannot fold a buffer of integer weights
+    with pytest.raises(ValueError, match="field_bits 32"):
+        integer_weights([5.0, 4.0, 3.0, 2.0], SPEC)
+
+
+def test_quantize32_nan_is_neutral_and_matches_device():
+    """A NaN coordinate maps to the ZERO residue (neutral contribution)
+    on host and device alike — never INT_MIN's arbitrary out-of-field
+    value — so one diverged client cannot corrupt the aggregate through
+    the cast."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuroimagedisttraining_tpu.ops import mpc_device as D
+
+    xs = np.asarray([np.nan, 1.0, -np.inf, np.inf, 0.5], np.float32)
+    host = mpc.quantize32(xs, p=SPEC.p, frac_bits=SPEC.frac_bits)
+    dev = np.asarray(jax.jit(
+        lambda v: D.quantize_device(v, p=SPEC.p,
+                                    frac_bits=SPEC.frac_bits))(
+        jnp.asarray(xs))).astype(np.int64)
+    np.testing.assert_array_equal(host, dev)
+    assert host[0] == 0  # NaN -> zero residue
+    assert (host < SPEC.p).all()
+    # inf saturates sign-preservingly
+    back = mpc.dequantize32(host, p=SPEC.p, frac_bits=SPEC.frac_bits)
+    assert back[2] < 0 < back[3]
+
+
+def test_fold_is_atomic_on_structure_skew():
+    """A frame with a mismatched leaf set must be rejected BEFORE any
+    accumulator mutation — the Bonawitz 'folds whole or not at all'
+    contract — so the surviving fold still finalizes correctly."""
+    good = [{"a": np.full(4, 0.5, np.float32),
+             "b": np.full(2, 0.25, np.float32)} for _ in range(2)]
+    acc = SlotAccumulator(SPEC)
+    for i, t in enumerate(good):
+        acc.fold(encode_secure_quant(t, 0.5, SPEC,
+                                     np.random.default_rng(i)))
+    skew = encode_secure_quant({"a": np.ones(4, np.float32),
+                                "c": np.ones(2, np.float32)}, 0.5,
+                               SPEC, np.random.default_rng(9))
+    with pytest.raises(ValueError, match="structure mismatch"):
+        acc.fold(skew)
+    got = acc.finalize(like=good[0])
+    want = quantized_weighted_mean(good, [1.0, 1.0], SPEC)
+    for k in ("a", "b"):
+        assert got[k].tobytes() == want[k].tobytes()
+    # with a template, even the FIRST frame is gated pre-mutation
+    acc2 = SlotAccumulator(SPEC, like=good[0])
+    with pytest.raises(ValueError, match="structure mismatch"):
+        acc2.fold(skew)
+    # seed-count skew (a truncated sharing) is rejected too
+    bad = encode_secure_quant(good[0], 0.5, SPEC,
+                              np.random.default_rng(1))
+    bad["seeds"] = bad["seeds"][:1]
+    with pytest.raises(ValueError, match="mask seeds"):
+        SlotAccumulator(SPEC).fold(bad)
+
+
+# ------------------------------------------------ protocol integration
+
+
+def _make_train_fn(c, lr=0.5):
+    def train_fn(params, round_idx):
+        p = {k: np.asarray(v, np.float32) for k, v in params.items()}
+        p["w"] = p["w"] + lr * ((c + 1) - p["w"])
+        return p, 10.0 * (c + 1)
+
+    return train_fn
+
+
+def _run(server, clients, timeout=60):
+    threads = [threading.Thread(target=m.run)
+               for m in [server] + clients]
+    for t in threads:
+        t.start()
+    assert server._done.wait(timeout=timeout), "protocol stalled"
+    for t in threads:
+        t.join(timeout=10)
+    return server
+
+
+def test_cross_silo_secure_quant_bitwise_vs_quantized_replay():
+    """The full two-phase protocol over REAL sockets: the secure-quant
+    aggregate equals a host replay of the plain quantized weighted mean
+    round by round, BITWISE — and stays within quantization tolerance
+    of the plain dense protocol."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        FedAvgClientProc, FedAvgServer, SecureFedAvgClientProc,
+        SecureFedAvgServer,
+    )
+
+    num_clients, comm_round = 3, 2
+    init = {"w": np.zeros((3,), np.float32)}
+    bp = free_port_block(8)
+    plain = _run(
+        FedAvgServer(init, comm_round, num_clients, base_port=bp),
+        [FedAvgClientProc(c + 1, num_clients, _make_train_fn(c),
+                          base_port=bp) for c in range(num_clients)])
+    bp = free_port_block(8)
+    sq = _run(
+        SecureFedAvgServer(init, comm_round, num_clients, base_port=bp,
+                           quant_spec=SPEC),
+        [SecureFedAvgClientProc(c + 1, num_clients, _make_train_fn(c),
+                                quant_spec=SPEC, mpc_seed=c,
+                                base_port=bp)
+         for c in range(num_clients)])
+    assert len(sq.history) == comm_round
+    np.testing.assert_allclose(sq.params["w"], plain.params["w"],
+                               atol=4 * 2.0 ** -SPEC.frac_bits)
+    from neuroimagedisttraining_tpu.privacy.secure_quant import (
+        leaf_scales,
+    )
+
+    params = init
+    for r in range(comm_round):
+        trees = [_make_train_fn(c)(params, r)[0]
+                 for c in range(num_clients)]
+        params = quantized_weighted_mean(
+            trees, [10.0 * (c + 1) for c in range(num_clients)], SPEC,
+            scales=leaf_scales(params))
+    assert params["w"].tobytes() == sq.params["w"].tobytes()
+
+
+class _NullComm:
+    def send_message(self, msg, **kw):
+        pass
+
+    def add_observer(self, o):
+        pass
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        pass
+
+    def byte_stats(self):
+        return {}
+
+
+def test_secure_quant_phase_b_dropout_kill_one():
+    """kill-1 between phases (the Bonawitz dropout cell): a client that
+    got a weight but never uploads its frame is discarded atomically at
+    the deadline, and the survivor aggregate is re-weighted — equal to
+    the survivor-only quantized mean bitwise."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        SecureFedAvgServer,
+    )
+
+    server = SecureFedAvgServer({"w": np.zeros(2, np.float32)}, 5, 2,
+                                comm=_NullComm(), round_deadline=60.0,
+                                quorum=1, quant_spec=SPEC)
+    server.register_message_receive_handlers()
+    for c in (1, 2):
+        server._on_register(M.Message(M.MSG_TYPE_C2S_REGISTER, c, 0))
+    for c, n in ((1, 10.0), (2, 30.0)):  # -> w_1 = 0.25, w_2 = 0.75
+        msg = M.Message(M.MSG_TYPE_C2S_NUM_SAMPLES, c, 0)
+        msg.add(M.ARG_NUM_SAMPLES, n)
+        msg.add(M.ARG_ROUND_IDX, 0)
+        server._on_num_samples(msg)
+    assert server._phase == "B"
+    x = {"w": np.asarray([1.5, -2.0], np.float32)}
+    up = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    up.add(M.ARG_MODEL_PARAMS,
+           encode_secure_quant(x, 0.25, SPEC, np.random.default_rng(0)))
+    up.add(M.ARG_ROUND_IDX, 0)
+    server._on_model(up)
+    # client 2 never uploads; quorum=1 holds at the deadline
+    server._on_deadline(0, server._deadline_gen)
+    if server._timer is not None:
+        server._timer.cancel()
+    assert server.round_idx == 1
+    q = mpc.quantize32(np.float32(0.25) * x["w"], p=SPEC.p,
+                       frac_bits=SPEC.frac_bits)
+    want = np.asarray(
+        (1.0 / 0.25) * mpc.dequantize32(q, p=SPEC.p,
+                                        frac_bits=SPEC.frac_bits),
+        np.float64).astype(np.float32)
+    assert server.params["w"].tobytes() == want.tobytes()
+
+
+def test_weak_dp_server_accounting_pinned():
+    """The plain server's weak_dp rounds report per-silo epsilon from
+    the RDP ledger, pinned against the closed-form single-round
+    conversion; a silo absent from a round is not charged for it."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        FedAvgServer,
+    )
+
+    init = {"w": np.zeros(3, np.float32)}
+    server = FedAvgServer(init, 3, 2, comm=_NullComm(),
+                          defense="weak_dp", stddev=0.05,
+                          norm_bound=5.0, dp_delta=1e-5)
+    t1 = {"w": np.full(3, 1.0, np.float32)}
+    t2 = {"w": np.full(3, 2.0, np.float32)}
+    with server._rlock:
+        server._updates = {1: (t1, 10.0), 2: (t2, 20.0)}
+        server._aggregate_and_advance()
+        server._updates = {1: (t1, 10.0)}  # silo 2 misses round 1
+        server._aggregate_and_advance()
+    e0 = server.history[0]["weak_dp"]
+    assert e0["norm_bound"] == 5.0 and e0["stddev"] == 0.05
+    z0 = weak_dp_noise_multiplier(0.05, 5.0, [10.0, 20.0])
+    assert e0["noise_multiplier"] == pytest.approx(z0, abs=1e-6)
+    eps1 = rdp_to_epsilon(rdp_gaussian(1.0, z0), delta=1e-5)[0]
+    assert e0["epsilon_per_silo"][1] == pytest.approx(eps1, abs=5e-4)
+    rep = server.dp_report()
+    # silo 1: two rounds (z0 then z1); silo 2: one round — less spent
+    assert rep["epsilon_per_silo"][1] > rep["epsilon_per_silo"][2]
+    assert rep["epsilon_per_silo"][2] == pytest.approx(eps1, abs=5e-4)
+
+
+def test_async_secure_quant_one_phase_buffer():
+    """The buffered server + secure_quant (the lifted rejection):
+    one-phase frames fold with integer-scaled staleness weights; the
+    16-bit field is rejected at startup with the fix named."""
+    from neuroimagedisttraining_tpu.asyncfl.server import (
+        BufferedFedAvgServer,
+    )
+
+    init = {"w": np.zeros((3,), np.float32)}
+    with pytest.raises(ValueError, match="field_bits 32"):
+        BufferedFedAvgServer(init, 3, 3, buffer_k=3, comm=_NullComm(),
+                             secure_quant=SPEC)
+    spec32 = QuantSpec.from_bits(32)
+    srv = BufferedFedAvgServer(init, 3, 3, buffer_k=3, comm=_NullComm(),
+                               secure_quant=spec32)
+    trees = [_make_train_fn(c)(init, 0)[0] for c in range(3)]
+    ns = [10.0, 20.0, 30.0]
+    for c, (t, n) in enumerate(zip(trees, ns), start=1):
+        m = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, c, 0)
+        m.add(M.ARG_MODEL_PARAMS, encode_secure_quant(
+            t, 1.0, spec32, np.random.default_rng(c)))
+        m.add(M.ARG_NUM_SAMPLES, float(n))
+        m.add(M.ARG_ROUND_IDX, 0)
+        m.add(M.ARG_UPLOAD_SEQ, 0)
+        srv._on_model(m)
+    assert srv.round_idx == 1, srv.upload_stats
+    want = np.average(np.stack([t["w"] for t in trees]), axis=0,
+                      weights=ns)
+    # integer-scaled weights quantize the ratios to ~2^-6 relative
+    np.testing.assert_allclose(srv.params["w"], want, atol=0.02)
+    assert srv.history[0]["secure_quant"] is True
+    audit = srv.upload_audit()
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+    # a malformed frame (spec skew) is dropped, never a dead thread
+    bad = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    bad.add(M.ARG_MODEL_PARAMS, encode_secure_quant(
+        trees[0], 1.0, SPEC, np.random.default_rng(9)))
+    bad.add(M.ARG_NUM_SAMPLES, 1.0)
+    bad.add(M.ARG_ROUND_IDX, 1)
+    bad.add(M.ARG_UPLOAD_SEQ, 1)
+    srv._on_model(bad)
+    assert srv.upload_stats["dropped_undecodable"] == 1
+    # a STRUCTURALLY skewed frame (right spec, wrong leaf set) is also
+    # dropped at admission — never a mid-buffer fold failure
+    skew = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 2, 0)
+    skew.add(M.ARG_MODEL_PARAMS, encode_secure_quant(
+        {"other": np.ones(5, np.float32)}, 1.0, spec32,
+        np.random.default_rng(11)))
+    skew.add(M.ARG_NUM_SAMPLES, 1.0)
+    skew.add(M.ARG_ROUND_IDX, 1)
+    skew.add(M.ARG_UPLOAD_SEQ, 1)
+    srv._on_model(skew)
+    assert srv.upload_stats["dropped_undecodable"] == 2
+    audit = srv.upload_audit()
+    assert audit["received_accounted"] and audit["accepted_accounted"]
+
+
+def test_weak_dp_zero_stddev_is_warning_not_crash():
+    """--defense weak_dp --stddev 0 (a no-noise ablation that predates
+    the accountant) must keep aggregating — the ledger records nothing
+    and warns once, instead of raising on the dispatch/timer thread."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        FedAvgServer,
+    )
+
+    server = FedAvgServer({"w": np.zeros(3, np.float32)}, 2, 2,
+                          comm=_NullComm(), defense="weak_dp",
+                          stddev=0.0, norm_bound=5.0)
+    t = {"w": np.full(3, 1.0, np.float32)}
+    with server._rlock:
+        server._updates = {1: (t, 10.0), 2: (t, 20.0)}
+        server._aggregate_and_advance()
+    assert server.round_idx == 1
+    assert "weak_dp" not in server.history[0]
+    assert server.dp_report() is None
+
+
+def test_secure_server_quant_matrix():
+    """The ctor compatibility matrix: quant lifts the clip-family
+    rejection (client-side enforcement), keeps the order-statistic +
+    quarantine + aggregator + codec rejections."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        SecureFedAvgClientProc, SecureFedAvgServer,
+    )
+
+    init = {"w": np.zeros(3, np.float32)}
+    bp = free_port_block(4)
+    # clip family now composes (was rejected outright in dense mode)
+    SecureFedAvgServer(init, 1, 2, base_port=bp, quant_spec=SPEC,
+                       defense="weak_dp")._done.set()
+    with pytest.raises(ValueError, match="neither order-statistic"):
+        SecureFedAvgServer(init, 1, 2, base_port=bp, quant_spec=SPEC,
+                           defense="trimmed_mean")
+    with pytest.raises(ValueError, match="neither order-statistic"):
+        SecureFedAvgServer(init, 1, 2, base_port=bp, quant_spec=SPEC,
+                           quarantine_rounds=2)
+    with pytest.raises(ValueError, match="n_aggregators"):
+        SecureFedAvgServer(init, 1, 2, base_port=bp, quant_spec=SPEC,
+                           n_aggregators=3)
+    with pytest.raises(ValueError, match="incompatible"):
+        SecureFedAvgServer(init, 1, 2, base_port=bp, quant_spec=SPEC,
+                           wire_masks={"w": np.ones(3)})
+    # dense mode still rejects the clip family (pointing at the fix)
+    with pytest.raises(ValueError, match="secure_quant"):
+        SecureFedAvgServer(init, 1, 2, base_port=bp,
+                           defense="norm_diff_clipping")
+    with pytest.raises(ValueError, match="clip family"):
+        SecureFedAvgClientProc(1, 2, lambda p, r: (p, 1.0),
+                               base_port=bp + 2, quant_spec=SPEC,
+                               defense="median")
+    with pytest.raises(ValueError, match="one_phase"):
+        SecureFedAvgClientProc(1, 2, lambda p, r: (p, 1.0),
+                               base_port=bp + 2, one_phase=True)
+
+
+def test_client_side_defense_clips_before_share():
+    """secure_quant + norm_diff_clipping: the CLIENT bounds its own
+    update — a huge trained delta reaches the server clipped to
+    norm_bound (verified through the full two-phase protocol)."""
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        SecureFedAvgClientProc, SecureFedAvgServer,
+    )
+
+    init = {"w": np.zeros((4,), np.float32)}
+
+    def wild(params, round_idx):
+        return {"w": np.full(4, 100.0, np.float32)}, 10.0
+
+    bp = free_port_block(8)
+    server = _run(
+        SecureFedAvgServer(init, 1, 1, base_port=bp, quant_spec=SPEC,
+                           defense="norm_diff_clipping", norm_bound=2.0),
+        [SecureFedAvgClientProc(1, 1, wild, quant_spec=SPEC,
+                                defense="norm_diff_clipping",
+                                norm_bound=2.0, base_port=bp)])
+    norm = float(np.linalg.norm(server.params["w"]))
+    assert norm == pytest.approx(2.0, abs=0.01), \
+        f"update delta reached the server unclipped (|w| = {norm})"
+
+
+# ------------------------------------------------ CLI startup matrix
+
+
+def test_run_cli_privacy_matrix_rejections(capsys):
+    from neuroimagedisttraining_tpu.distributed.run import main
+
+    def err(argv, n="2"):
+        with pytest.raises(SystemExit) as e:
+            main(["--role", "server", "--num_clients", n, *argv])
+        assert e.value.code == 2
+        return capsys.readouterr().err
+
+    # --secure + codec points at --secure_quant
+    assert "--secure_quant" in err(["--secure", "--wire_codec",
+                                    "delta+quant"])
+    # --secure + defense points at --secure_quant
+    assert "--secure_quant" in err(["--secure", "--defense", "weak_dp"])
+    # secure_quant + order statistic stays rejected (n=4 keeps the
+    # breakdown-point check out of the way — this is the secure error)
+    assert "clip family" in err(["--secure_quant", "--defense",
+                                 "trimmed_mean"], n="4")
+    # secure_quant + aggregators rejected (seed expansion)
+    assert "seeds" in err(["--secure_quant", "--n_aggregators", "3",
+                           "--mpc_n_shares", "3"])
+    # dense secure + async still rejected, quant named as the fix
+    assert "--secure_quant" in err(["--async_server", "--secure"])
+    # async + quant at 16 bits: capacity error names the 32-bit fix
+    assert "field_bits 32" in err(["--async_server", "--secure_quant"])
+    # headroom misconfig dies at argparse
+    assert "headroom" in err(["--secure_quant",
+                              "--secure_quant_frac_bits", "16"])
+
+
+def test_main_cli_privacy_rejections(capsys):
+    from neuroimagedisttraining_tpu.__main__ import main
+
+    def err(argv):
+        with pytest.raises(SystemExit) as e:
+            main(argv)
+        assert e.value.code == 2
+        return capsys.readouterr().err
+
+    assert "secure_quant" in err(["--algorithm", "turboaggregate",
+                                  "--wire_codec", "delta+quant"])
+    assert "clip family" in err(["--algorithm", "turboaggregate",
+                                 "--defense", "krum"])
+    assert "--dp_clip" in err(["--algorithm", "dpsgd",
+                               "--dp_sigma", "1.0"])
+    assert "dpsgd" in err(["--algorithm", "fedavg", "--dp_clip", "1.0"])
+
+
+# ------------------------------------------------ engine integration
+
+
+def test_dpsgd_dp_noise_and_accounting(tmp_path, synthetic_cohort):
+    """dpsgd with --dp_clip/--dp_sigma: noise actually perturbs the
+    models (vs the un-noised run), everything stays finite, and
+    stat_info reports the accountant's per-round epsilon pinned against
+    the closed-form full-participation composition."""
+    import jax
+
+    from tests.test_fedavg import _make_engine
+
+    rounds = 2
+    plain = _make_engine(tmp_path, synthetic_cohort, algorithm="dpsgd",
+                         comm_round=rounds)
+    noised = _make_engine(tmp_path, synthetic_cohort, algorithm="dpsgd",
+                          comm_round=rounds, dp_clip=1.0, dp_sigma=1.0)
+    res_p = plain.train()
+    res_n = noised.train()
+    leaves_p = jax.tree.leaves(res_p["global_params"])
+    leaves_n = jax.tree.leaves(res_n["global_params"])
+    assert all(np.all(np.isfinite(np.asarray(x))) for x in leaves_n)
+    assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(leaves_p, leaves_n)), \
+        "dp_sigma=1.0 left the models bitwise identical to the " \
+        "un-noised run — the noise path never ran"
+    dp = noised.stat_info["dp"]
+    assert len(dp["epsilon_per_round"]) == rounds
+    want = [rdp_to_epsilon(r * rdp_gaussian(1.0, 1.0),
+                           delta=1e-5)[0] for r in (1, 2)]
+    np.testing.assert_allclose(dp["epsilon_per_round"], want, atol=5e-4)
+    assert dp["epsilon"] == dp["epsilon_per_round"][-1]
+    assert set(dp["epsilon_per_silo"]) == set(range(plain.real_clients))
+    assert "dp" not in plain.stat_info
+
+
+def test_engine_rejects_dp_flags_without_support(tmp_path,
+                                                 synthetic_cohort):
+    from tests.test_fedavg import _make_engine
+
+    with pytest.raises(ValueError, match="dpsgd"):
+        _make_engine(tmp_path, synthetic_cohort, algorithm="fedavg",
+                     dp_clip=1.0, dp_sigma=1.0)
+    with pytest.raises(ValueError, match="dp_clip"):
+        _make_engine(tmp_path, synthetic_cohort, algorithm="dpsgd",
+                     dp_sigma=1.0)
+
+
+def test_fedavg_weak_dp_stat_info_observability(tmp_path,
+                                                synthetic_cohort):
+    """The weak_dp observability gap (satellite): the clip bound, sigma,
+    effective noise multiplier, and running epsilon land in stat_info
+    EVERY round, pinned against a direct ledger replay over the same
+    deterministic cohorts."""
+    from tests.test_fedavg import _make_engine
+
+    rounds = 3
+    eng = _make_engine(tmp_path, synthetic_cohort,
+                       defense_type="weak_dp", comm_round=rounds,
+                       norm_bound=5.0, stddev=0.05)
+    eng.train()
+    wd = eng.stat_info["weak_dp"]
+    assert wd["norm_bound"] == 5.0 and wd["stddev"] == 0.05
+    assert len(wd["epsilon_per_round"]) == rounds
+    assert len(wd["noise_multiplier_per_round"]) == rounds
+    # replay: same sampling contract, same weights, same ledger
+    rdp = 0.0
+    for r in range(rounds):
+        sampled = eng.client_sampling(r)
+        w = eng._n_train_host[np.asarray(sampled)]
+        z = weak_dp_noise_multiplier(0.05, 5.0, w)
+        assert wd["noise_multiplier_per_round"][r] == \
+            pytest.approx(z, abs=1e-6)
+        rdp = rdp + rdp_gaussian(len(sampled) / eng.real_clients, z)
+        eps = rdp_to_epsilon(rdp, delta=1e-5)[0]
+        assert wd["epsilon_per_round"][r] == pytest.approx(eps,
+                                                           abs=5e-4)
+    assert wd["epsilon"] == wd["epsilon_per_round"][-1]
