@@ -297,7 +297,122 @@ def cohort_sharding_cell(n_devices: int) -> dict:
     }
 
 
+def obs_overhead_cell() -> dict:
+    """Obs overhead guard (ISSUE 9): the SAME smoke round loop timed
+    with the telemetry plane disarmed (tracer off, registry disabled)
+    and armed (tracer writing spans, registry enabled, stat_info
+    published per round — a HARSHER cadence than the shipped driver,
+    which publishes at eval boundaries only). Because instrumentation
+    sits only at host dispatch boundaries, the per-round cost is a few
+    microseconds against a multi-millisecond round — acceptance:
+    overhead <= 2% (bench_matrix/obs_overhead.json).
+
+    Env: BENCH_OBS_OVERHEAD=1 arms this cell (main() prints ONLY it);
+    BENCH_OBS_ROUNDS / BENCH_REPS size the loop."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import FederatedData
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+    from neuroimagedisttraining_tpu.obs import trace as obs_trace
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+    n_local = int(os.environ.get("BENCH_LOCAL", 16))
+    n_clients = 4
+    # floor of 1: zero rounds/reps would leave the timed legs undefined
+    rounds = max(1, int(os.environ.get("BENCH_OBS_ROUNDS", 6)))
+    reps = max(1, int(os.environ.get("BENCH_REPS", 5)))
+    shape = tuple(int(s) for s in
+                  os.environ.get("BENCH_SHAPE", "12,14,12").split(","))
+    model_name = os.environ.get("BENCH_MODEL", "3dcnn_tiny")
+
+    cfg = ExperimentConfig(
+        model=model_name, num_classes=1, algorithm="fedavg",
+        data=DataConfig(dataset="synthetic"),
+        optim=OptimConfig(lr=1e-3, batch_size=batch, epochs=1),
+        fed=FedConfig(client_num_in_total=n_clients, comm_round=rounds,
+                      frequency_of_the_test=10 ** 9),
+        log_dir="/tmp/nidt_bench", tag="obs-overhead")
+    kx, ky = jax.random.split(jax.random.key(7))
+    X = jax.random.randint(kx, (n_clients, n_local) + shape, 0, 255,
+                           dtype=jnp.int32).astype(jnp.uint8)
+    y = jax.random.randint(ky, (n_clients, n_local), 0, 2,
+                           dtype=jnp.int32)
+    n = jnp.full((n_clients,), n_local, jnp.int32)
+    fed = FederatedData(X_train=X, y_train=y, n_train=n,
+                        X_test=X[:, :4], y_test=y[:, :4],
+                        n_test=jnp.full((n_clients,), 4, jnp.int32))
+    trainer = LocalTrainer(create_model(model_name, num_classes=1),
+                           cfg.optim, num_classes=1)
+    log = ExperimentLogger("/tmp/nidt_bench", "synthetic",
+                           "obs_overhead_cell", console=False)
+    engine = create_engine("fedavg", cfg, fed, trainer, logger=log)
+    engine._donate = False  # the legs replay one state through the jit
+    gs = engine.init_global_state()
+    sampled = jnp.asarray(engine.client_sampling(0))
+
+    def run_rounds(armed: bool) -> float:
+        p, b = gs.params, gs.batch_stats
+        for r in range(rounds):
+            rngs = engine.per_client_rngs(r, np.arange(n_clients))
+            with obs_trace.span("round", round=r):
+                p, b, loss, _ = engine._round_jit(
+                    p, b, fed, sampled, rngs, engine.round_lr(r))
+            if armed:
+                # harsher-than-shipped publish cadence: every round
+                engine.stat_info["sum_training_flops"] += 1.0
+                engine.publish_stat_info(r)
+        return float(loss)  # full sync closes the timed region
+
+    def set_leg(armed: bool) -> None:
+        if armed:
+            obs_metrics.enable()
+            obs_trace.arm("/tmp/nidt_bench/obs_overhead_trace.json",
+                          tags={"bench": "obs_overhead"})
+        else:
+            obs_metrics.disable()
+            obs_trace.disarm()
+
+    run_rounds(False)  # compile + warm
+    legs = {"disarmed": float("inf"), "armed": float("inf")}
+    # legs INTERLEAVED per repeat: the shared-box load drifts on the
+    # seconds scale, and back-to-back leg blocks would alias that drift
+    # into a fake (even negative) "overhead"
+    for _ in range(reps):
+        for name, armed in (("disarmed", False), ("armed", True)):
+            set_leg(armed)
+            t0 = time.perf_counter()
+            run_rounds(armed)
+            legs[name] = min(legs[name], time.perf_counter() - t0)
+    obs_metrics.enable()
+    obs_trace.disarm()
+    overhead = legs["armed"] / legs["disarmed"] - 1.0
+    return {
+        "metric": "obs_overhead",
+        "model": model_name, "shape": "x".join(map(str, shape)),
+        "batch": batch, "clients": n_clients, "rounds_per_leg": rounds,
+        "disarmed_s": round(legs["disarmed"], 4),
+        "armed_s": round(legs["armed"], 4),
+        "overhead_frac": round(overhead, 4),
+        "acceptance": "overhead_frac <= 0.02 (armed = span per round + "
+                      "stat_info publish per round + tracer buffering)",
+        "timing": f"best of {reps} repeats x {rounds} rounds",
+    }
+
+
 def main() -> None:
+    if os.environ.get("BENCH_OBS_OVERHEAD", "0") == "1":
+        # standalone cell (ISSUE 9): one JSON line, no flagship phases
+        print(json.dumps(obs_overhead_cell()))
+        return
     cohort_devices = int(os.environ.get("BENCH_COHORT_DEVICES", "0"))
     if cohort_devices > 1:
         # standalone cell: provisions (optionally virtual) devices before

@@ -276,6 +276,31 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", type=str, default="",
                         help="capture a jax.profiler trace of training "
                              "into this dir (TensorBoard-loadable)")
+    # observability plane (obs/, ISSUE 9)
+    parser.add_argument("--trace_out", type=str, default="",
+                        help="write the run's host-span timeline "
+                             "(round/window/eval spans at dispatch "
+                             "boundaries) as Chrome trace-event JSON, "
+                             "Perfetto-loadable (obs/trace.py); with "
+                             "--profile_dir each span also opens a "
+                             "jax.profiler.TraceAnnotation so host "
+                             "spans line up with the XLA timeline")
+    parser.add_argument("--metrics_port", type=int, default=0,
+                        help="serve /metrics (Prometheus text "
+                             "exposition of the obs registry: stat_info "
+                             "accumulators, round metrics, DP epsilon) "
+                             "+ /healthz during training (obs/http.py); "
+                             "0 = off. The endpoint is unauthenticated "
+                             "— bind scope via --metrics_host")
+    parser.add_argument("--metrics_host", type=str, default="0.0.0.0",
+                        help="interface the metrics endpoint binds "
+                             "(default all interfaces; pass 127.0.0.1 "
+                             "on shared hosts)")
+    parser.add_argument("--flight_events", type=int, default=256,
+                        help="flight-recorder ring capacity "
+                             "(obs/flight.py); the ring dumps to "
+                             "LOG/<dataset>/<identity>.flight.json on "
+                             "any fatal failure (failure_context)")
     parser.add_argument("--compile_cache", "--compile_cache_dir",
                         dest="compile_cache_dir", type=str, default=None,
                         help="persistent XLA compile cache dir (repeat "
@@ -367,7 +392,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         checkpoint_every=args.checkpoint_every,
         remat=args.remat,
         stream_chunk_clients=args.stream_chunk_clients,
-        log_dir=args.log_dir)
+        log_dir=args.log_dir,
+        trace_out=args.trace_out, metrics_port=args.metrics_port,
+        flight_events=args.flight_events)
 
 
 def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
@@ -593,16 +620,46 @@ def main(argv: list[str] | None = None) -> int:
     from neuroimagedisttraining_tpu.utils.profiling import (
         failure_context, profile_trace,
     )
-    with failure_context(name=cfg.identity()), \
-            profile_trace(args.profile_dir, enabled=bool(args.profile_dir)):
-        result = engine.train()
+    # observability plane (obs/, ISSUE 9): span tracer (annotating the
+    # XLA timeline when --profile_dir is also set), live /metrics
+    # endpoint, and the flight recorder's failure-dump destination —
+    # all host-side, armed only when asked for
+    import os
+
+    from neuroimagedisttraining_tpu.obs import flight as obs_flight
+    from neuroimagedisttraining_tpu.obs import trace as obs_trace
+    from neuroimagedisttraining_tpu.obs.http import start_metrics_server
+
+    obs_flight.configure(
+        capacity=cfg.flight_events,
+        path=os.path.join(engine.log.dir,
+                          cfg.identity() + ".flight.json"))
+    if cfg.trace_out:
+        obs_trace.arm(cfg.trace_out,
+                      annotate=bool(args.profile_dir),
+                      tags={"algorithm": cfg.algorithm,
+                            "seed": cfg.seed})
+    msrv = start_metrics_server(cfg.metrics_port,
+                                host=args.metrics_host)
+    try:
+        with failure_context(name=cfg.identity()), \
+                profile_trace(args.profile_dir,
+                              enabled=bool(args.profile_dir)):
+            result = engine.train()
+    finally:
+        if cfg.trace_out:
+            out = obs_trace.dump()
+            if out:
+                print(f"[obs] host-span trace written to {out} "
+                      "(load in Perfetto / chrome://tracing)",
+                      flush=True)
+        if msrv is not None:
+            msrv.close()
 
     # persist the stat accumulators (the reference pickles stat_info at end
     # of training and crashed when the results dir was missing,
     # subavg_api.py:218-220 / subavg/error3437295.err — the logger already
     # created its dir, which is the single source of truth for the layout)
-    import os
-
     from neuroimagedisttraining_tpu.utils.logging import _jsonable
 
     stats_path = os.path.join(engine.log.dir, cfg.identity() + ".stats.json")

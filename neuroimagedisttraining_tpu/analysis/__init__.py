@@ -35,6 +35,7 @@ from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
     engine_contract,
     lock_discipline,
     mesh_discipline,
+    obs_discipline,
     privacy_discipline,
     trace_safety,
 )

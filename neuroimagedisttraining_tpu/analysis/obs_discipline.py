@@ -1,0 +1,108 @@
+"""obs-discipline rules: telemetry stays OUT of traced code (ISSUE 9).
+
+The obs/ plane's contract is host-boundary-only instrumentation. Inside
+a function handed to jit/vmap/shard_map/lax combinators,
+
+- a wall/monotonic clock read (``time.time``/``monotonic``/
+  ``perf_counter``/...) executes ONCE at trace time and bakes that one
+  Python float into the compiled executable — every subsequent dispatch
+  reports the same "timestamp", which is worse than no timestamp
+  because it looks plausible;
+- a metrics-registry / flight-recorder / span-tracer mutation
+  (``.inc()``, ``.observe()``, anything in
+  ``neuroimagedisttraining_tpu.obs``) likewise runs once at trace time:
+  the counter moves by one forever, the flight ring records one
+  phantom event, and the span measures tracing, not execution.
+
+Both rules ride the trace-safety resolver (``collect_traced``: decorated
+jits, functions passed to tracers, lambdas, self-methods, and the
+transitive call closure), so an instrumented helper CALLED from a round
+body is caught just like a decorated one.
+
+Lexical honesty: ``.set(...)`` is NOT flagged — the attribute name is
+too generic (``jnp.ndarray.at[...].set`` is the single most common call
+in the round programs). A gauge set inside a trace is still wrong; it
+is covered whenever it is spelled through the obs package
+(``obs_metrics.gauge(...)...``), which every shipped call site does.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from neuroimagedisttraining_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    normalize,
+    register,
+)
+from neuroimagedisttraining_tpu.analysis.trace_safety import collect_traced
+
+#: clock reads by canonical dotted name — one trace-time value baked in
+CLOCK_DOTTED = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.process_time", "time.process_time_ns",
+    "time.thread_time", "time.thread_time_ns",
+}
+
+#: unambiguous registry-mutation method names (Counter.inc /
+#: Histogram.observe); Gauge.set is excluded — see the module docstring
+MUTATION_METHODS = {"inc", "observe"}
+
+#: any call into the obs package is telemetry (metrics, flight ring,
+#: span tracer) and has no business inside a traced body
+OBS_PREFIX = "neuroimagedisttraining_tpu.obs"
+
+
+@register
+class ObsDisciplineRule(Rule):
+    rule_ids = ("obs-clock-in-trace", "obs-metrics-in-trace")
+    description = (
+        "no wall/monotonic clock reads (obs-clock-in-trace) or metrics-"
+        "registry/flight/span mutation (obs-metrics-in-trace) lexically "
+        "inside functions handed to jit/vmap/shard_map/lax combinators")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        seen: set[int] = set()
+        for root in collect_traced(mod):
+            for node in ast.walk(root):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                seen.add(id(node))
+                yield from self._check_call(mod, node)
+
+    def _check_call(self, mod: ModuleInfo,
+                    node: ast.Call) -> Iterator[Finding]:
+        name = normalize(dotted_name(node.func), mod.aliases)
+        if name in CLOCK_DOTTED:
+            yield Finding(
+                mod.path, node.lineno, "obs-clock-in-trace",
+                f"{name} inside a traced function bakes ONE trace-time "
+                "clock value into the compiled executable — time at "
+                "host boundaries only (obs/trace.py)")
+            return
+        if name is not None and (name == OBS_PREFIX
+                                 or name.startswith(OBS_PREFIX + ".")):
+            yield Finding(
+                mod.path, node.lineno, "obs-metrics-in-trace",
+                f"{name} inside a traced function runs ONCE at trace "
+                "time (a frozen counter / phantom flight event / "
+                "tracing-time span) — instrument at host boundaries "
+                "only")
+            return
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATION_METHODS):
+            yield Finding(
+                mod.path, node.lineno, "obs-metrics-in-trace",
+                f".{node.func.attr}() (metrics-registry mutation) "
+                "inside a traced function runs once at trace time and "
+                "never again — publish at host boundaries only")
+
+
+#: the analysis package imports this module for registration
+__all__ = ["ObsDisciplineRule", "CLOCK_DOTTED", "MUTATION_METHODS"]

@@ -53,6 +53,7 @@ from neuroimagedisttraining_tpu.distributed.message import (
     Message,
     frame_bytes,
 )
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
 
@@ -107,6 +108,27 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
         self._conns: dict[socket.socket, _Conn] = {}
         self._by_rank: dict[int, _Conn] = {}
         self.peak_connections = 0
+        # obs plane (ISSUE 9): the selector loop's own health, published
+        # from the loop thread at tick granularity (throttled to one
+        # gauge sweep per _OBS_TICK_S — never per event, the loop is the
+        # one thread every socket shares) plus a counter senders bump
+        # when the bounded write queue blocks them (backpressure stalls
+        # are the signal that a reader is slow, the thing the p99
+        # version-advance number degrades on first)
+        lab = dict(rank=str(rank))
+        self._obs_conns = obs_metrics.gauge(
+            "nidt_selector_connections",
+            "live connections registered with the selector loop",
+            labelnames=("rank",)).labels(**lab)
+        self._obs_wq_frames = obs_metrics.gauge(
+            "nidt_selector_write_queue_frames",
+            "frames pending across every persistent write queue",
+            labelnames=("rank",)).labels(**lab)
+        self._obs_stalls = obs_metrics.counter(
+            "nidt_backpressure_stalls_total",
+            "sends that blocked on a full per-connection write queue",
+            labelnames=("rank",)).labels(**lab)
+        self._obs_last_tick = 0.0
         self._running = True
         self._sel = selectors.DefaultSelector()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -126,12 +148,29 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
     # ---- event loop (the only thread that touches the selector or
     # writes on persistent sockets) ----
 
+    _OBS_TICK_S = 0.25  # gauge-sweep throttle for the loop thread
+
+    def _obs_tick(self) -> None:
+        """Loop-thread tick: refresh the selector-health gauges at most
+        every ``_OBS_TICK_S`` — one monotonic read per select wakeup,
+        one short ``_send_lock`` hold per tick."""
+        now = time.monotonic()
+        if now - self._obs_last_tick < self._OBS_TICK_S:
+            return
+        self._obs_last_tick = now
+        with self._send_lock:
+            n_conns = len(self._conns)
+            wq = sum(c.wq_frames for c in self._conns.values())
+        self._obs_conns.set(n_conns)
+        self._obs_wq_frames.set(wq)
+
     def _loop(self) -> None:
         while self._running:
             try:
                 events = self._sel.select(timeout=0.5)
             except OSError:
                 return  # selector closed during shutdown
+            self._obs_tick()
             for key, mask in events:
                 if key.data == "accept":
                     self._accept_ready()
@@ -310,6 +349,9 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
                    and conn.wq_frames >= self.max_pending_frames):
                 if deadline is None:
                     deadline = time.monotonic() + self.send_timeout
+                    # counted ONCE per stalled send, on entry — the
+                    # wait loop below may spin many times per stall
+                    self._obs_stalls.inc()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise ConnectionError(

@@ -79,6 +79,8 @@ from neuroimagedisttraining_tpu.distributed.cross_silo import (
     survivor_weighted_mean,
     tree_all_finite,
 )
+from neuroimagedisttraining_tpu.obs import flight as obs_flight
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
 
@@ -274,6 +276,36 @@ class BufferedFedAvgServer(FedAvgServer):
             # sender per aggregation — see _accept_async)
             "superseded_in_buffer": 0,
         }
+        # ---- obs plane (ISSUE 9): the registry mirror of upload_stats
+        # (every bump goes through _stat, so counter == dict entry by
+        # construction — the no-double-counting pin), plus the
+        # distributions ROADMAP item 3 needs to SEE: the staleness
+        # spectrum the (1+tau)^-alpha weighting actually met, and the
+        # buffer occupancy between aggregations. All on the dispatch
+        # thread under _rlock — never inside a jitted program.
+        self._obs_uploads = obs_metrics.counter(
+            "nidt_async_uploads_total",
+            "async-server upload verdicts (mirrors upload_stats)",
+            labelnames=("outcome",))
+        self._obs_staleness = obs_metrics.histogram(
+            "nidt_async_staleness",
+            "staleness tau (versions) of accepted uploads",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64))
+        self._obs_buffer = obs_metrics.gauge(
+            "nidt_async_buffer_occupancy",
+            "uploads currently buffered toward the next aggregation")
+        self._obs_k_eff = obs_metrics.gauge(
+            "nidt_async_buffer_k_eff",
+            "effective aggregation trigger threshold (buffer_k shrunk "
+            "by known-gone clients)")
+        self._obs_k_eff.set(self._k_eff())
+
+    def _stat(self, key: str, n: int = 1) -> None:
+        """Under ``_rlock``: bump one ``upload_stats`` counter AND its
+        registry mirror in lockstep (the single bump point that keeps
+        ``upload_audit`` and a ``/metrics`` scrape equal)."""
+        self.upload_stats[key] += n
+        self._obs_uploads.inc(n, outcome=key)
 
     # the async server must NEVER crash its dispatch thread because one
     # of thousands of clients vanished mid-reply: always send tolerantly
@@ -321,9 +353,9 @@ class BufferedFedAvgServer(FedAvgServer):
 
     def _on_model(self, msg: M.Message) -> None:
         with self._rlock:
-            self.upload_stats["received"] += 1
+            self._stat("received")
             if self._done.is_set():
-                self.upload_stats["dropped_after_done"] += 1
+                self._stat("dropped_after_done")
                 return
             c = msg.sender_id
             self._last_beat[c] = time.monotonic()
@@ -335,12 +367,15 @@ class BufferedFedAvgServer(FedAvgServer):
                 # from a version-skewed client) is a dropped upload, not
                 # a dead dispatch thread — the same contract the decode
                 # guard keeps for broken BODIES
-                self.upload_stats["dropped_malformed"] += 1
+                self._stat("dropped_malformed")
+                obs_flight.record("drop_malformed", client=c,
+                                  version=self.round_idx,
+                                  error=f"{type(e).__name__}: {e}")
                 log.warning("server: dropping malformed upload from %d "
                             "(%s: %s)", c, type(e).__name__, e)
                 ok = False
             if ok:
-                self.upload_stats["accepted"] += 1
+                self._stat("accepted")
                 if len(self._buffer) >= self._k_eff():
                     self._aggregate_buffer()
             if not self._done.is_set():
@@ -358,13 +393,17 @@ class BufferedFedAvgServer(FedAvgServer):
         v = self.round_idx if tag is None else int(tag)
         tau = self.round_idx - v
         if tau < 0:
-            self.upload_stats["dropped_future"] += 1
+            self._stat("dropped_future")
+            obs_flight.record("drop_future", client=c, tagged=v,
+                              version=self.round_idx)
             log.warning("server: dropping upload from %d tagged with "
                         "FUTURE version %d (current %d)", c, v,
                         self.round_idx)
             return False
         if tau > self.max_staleness:
-            self.upload_stats["dropped_stale"] += 1
+            self._stat("dropped_stale")
+            obs_flight.record("drop_stale", client=c, tagged=v,
+                              tau=tau, version=self.round_idx)
             log.warning("server: dropping ancient upload from %d "
                         "(base version %d, current %d, staleness %d > "
                         "max_staleness %d)", c, v, self.round_idx, tau,
@@ -373,7 +412,9 @@ class BufferedFedAvgServer(FedAvgServer):
         seq = msg.get(M.ARG_UPLOAD_SEQ)
         if seq is not None:
             if int(seq) <= self._seq_seen.get(c, -1):
-                self.upload_stats["dropped_duplicate"] += 1
+                self._stat("dropped_duplicate")
+                obs_flight.record("drop_duplicate", client=c,
+                                  seq=int(seq), version=self.round_idx)
                 log.warning("server: dropping re-delivered upload from "
                             "%d (seq %s <= watermark %d)", c, seq,
                             self._seq_seen[c])
@@ -386,13 +427,17 @@ class BufferedFedAvgServer(FedAvgServer):
             # sender twice and could quarantine an honest silo
             self._seq_seen[c] = int(seq)
         elif v in self._contributed.get(c, ()):
-            self.upload_stats["dropped_duplicate"] += 1
+            self._stat("dropped_duplicate")
+            obs_flight.record("drop_duplicate", client=c, base_version=v,
+                              version=self.round_idx)
             log.warning("server: dropping duplicate upload from %d for "
                         "base version %d (sender ships no upload_seq)",
                         c, v)
             return False
         if c in self._quarantined_now():
-            self.upload_stats["dropped_quarantined"] += 1
+            self._stat("dropped_quarantined")
+            obs_flight.record("drop_quarantined", client=c,
+                              version=self.round_idx)
             log.warning("server: dropping upload from QUARANTINED silo "
                         "%d (version %d; window ends at version %d)",
                         c, self.round_idx, self._quarantine_until[c])
@@ -413,7 +458,9 @@ class BufferedFedAvgServer(FedAvgServer):
                         "frame leaf structure differs from the model "
                         "(version skew)")
             except (ValueError, KeyError, TypeError) as e:
-                self.upload_stats["dropped_undecodable"] += 1
+                self._stat("dropped_undecodable")
+                obs_flight.record("drop_undecodable", client=c,
+                                  base_version=v, error=str(e))
                 log.warning("server: dropping invalid secure-quant frame "
                             "from %d (base version %d): %s", c, v, e)
                 return False
@@ -443,12 +490,16 @@ class BufferedFedAvgServer(FedAvgServer):
         except Exception as e:  # noqa: BLE001 — an undecodable frame is
             # a dropped upload, never a dead dispatch thread (same
             # contract as the synchronous server's _on_model)
-            self.upload_stats["dropped_undecodable"] += 1
+            self._stat("dropped_undecodable")
+            obs_flight.record("drop_undecodable", client=c,
+                              base_version=v, error=str(e))
             log.warning("server: dropping undecodable upload from %d "
                         "(base version %d): %s", c, v, e)
             return False
         if not tree_all_finite(decoded):
-            self.upload_stats["dropped_nonfinite"] += 1
+            self._stat("dropped_nonfinite")
+            obs_flight.record("reject_nonfinite", client=c,
+                              base_version=v)
             self.byz_stats["nonfinite_rejected"] += 1
             log.warning("server: REJECTING non-finite upload from silo "
                         "%d (base version %d)", c, v)
@@ -503,7 +554,10 @@ class BufferedFedAvgServer(FedAvgServer):
         for i, e in enumerate(self._buffer):
             if e["client"] == c:
                 del self._buffer[i]
-                self.upload_stats["superseded_in_buffer"] += 1
+                self._stat("superseded_in_buffer")
+                obs_flight.record("superseded_in_buffer", client=c,
+                                  tau_old=int(e["tau"]), tau_new=int(tau),
+                                  version=self.round_idx)
                 log.info("server: upload from %d supersedes its own "
                          "buffered entry (tau %d -> %d)", c,
                          e["tau"], tau)
@@ -512,6 +566,13 @@ class BufferedFedAvgServer(FedAvgServer):
             "client": c, "n": n, "tau": tau,
             "weight": staleness_weight(n, tau, self.staleness_alpha),
             **payload})
+        # accepted-upload observability: the staleness spectrum the
+        # (1+tau)^-alpha weighting actually meets, live buffer depth,
+        # and the accept decision in the flight ring
+        self._obs_staleness.observe(int(tau))
+        self._obs_buffer.set(len(self._buffer))
+        obs_flight.record("accept", client=c, tau=int(tau),
+                          version=self.round_idx)
 
     # ---- aggregation ----
 
@@ -538,8 +599,8 @@ class BufferedFedAvgServer(FedAvgServer):
         q = self._quarantined_now()
         if q & set(senders):
             kept = [e for e in entries if e["client"] not in q]
-            self.upload_stats["quarantine_discarded"] += (len(entries)
-                                                          - len(kept))
+            self._stat("quarantine_discarded",
+                       len(entries) - len(kept))
             entries = kept
         if not entries:
             # every buffered upload came from silos quarantined by this
@@ -547,7 +608,10 @@ class BufferedFedAvgServer(FedAvgServer):
             # keep the model, refill the buffer
             log.warning("server: buffer emptied by quarantine at "
                         "version %d - skipping aggregation", self.round_idx)
+            obs_flight.record("buffer_emptied_by_quarantine",
+                              version=self.round_idx)
             self._buffer = []
+            self._obs_buffer.set(0)
             return
         trees = [e["tree"] for e in entries]
         ws = [e["weight"] for e in entries]
@@ -613,8 +677,13 @@ class BufferedFedAvgServer(FedAvgServer):
                       "failed (%s: %s) - discarding the %d-upload "
                       "buffer, model unchanged", self.round_idx,
                       type(e).__name__, e, len(entries))
-            self.upload_stats["aggregation_discarded"] += len(entries)
+            self._stat("aggregation_discarded", len(entries))
+            obs_flight.record("aggregation_discarded",
+                              version=self.round_idx,
+                              uploads=len(entries),
+                              error=f"{type(e).__name__}: {e}")
             self._buffer = []
+            self._obs_buffer.set(0)
             return
         self.params = new_params
         extra = {"secure_quant": True,
@@ -631,6 +700,12 @@ class BufferedFedAvgServer(FedAvgServer):
         version++, ring/dedup maintenance, history, finish."""
         self._buffer = []
         self.round_idx += 1
+        obs_flight.record("aggregate", version=self.round_idx,
+                          clients=len(senders),
+                          taus=[int(e["tau"]) for e in entries])
+        self._obs_buffer.set(0)
+        self._obs_round_gauge.set(self.round_idx)
+        self._obs_k_eff.set(self._k_eff())
         self._ring[self.round_idx] = self.params
         floor = self.round_idx - self.max_staleness
         for old in [k for k in self._ring if k < floor]:
@@ -699,7 +774,7 @@ class BufferedFedAvgServer(FedAvgServer):
                           if k.startswith("dropped_"))
             aggregated = sum(h["clients"] for h in self.history
                              if "version" in h)
-            return {
+            audit = {
                 **s,
                 "aggregated": aggregated,
                 "buffered": len(self._buffer),
@@ -711,3 +786,27 @@ class BufferedFedAvgServer(FedAvgServer):
                                       + s["aggregation_discarded"]
                                       + s["superseded_in_buffer"]),
             }
+        if not (audit["received_accounted"]
+                and audit["accepted_accounted"]):
+            # a red accounting audit IS the post-mortem trigger (ISSUE
+            # 9): the frames the audit cannot reconcile are exactly the
+            # decisions the flight ring recorded — dump it while the
+            # evidence is fresh (outside _rlock; record/dump take only
+            # the recorder's own lock)
+            obs_flight.record("audit_failure", version=self.round_idx,
+                              audit={k: v for k, v in audit.items()
+                                     if isinstance(v, (int, bool))})
+            out = obs_flight.dump(reason="upload_audit failure")
+            if out:
+                log.error("server: upload audit FAILED (%s) - flight "
+                          "recorder dumped to %s", audit, out)
+            else:
+                # no dump path configured (--flight_out unset): the
+                # post-mortem must not vanish — put the tail of the
+                # ring in the log instead
+                evs = obs_flight.events()
+                log.error("server: upload audit FAILED (%s) - no "
+                          "flight dump path configured; last %d of %d "
+                          "flight events: %s", audit, min(20, len(evs)),
+                          len(evs), evs[-20:])
+        return audit

@@ -232,6 +232,12 @@ class ExperimentConfig:
     checkpoint_dir: str = ""
     checkpoint_every: int = 0          # rounds; 0 disables
     log_dir: str = "LOG"
+    # Observability (obs/, ISSUE 9). All off-by-default-cheap; none of
+    # these may ever add a host sync or clock read inside a jitted body
+    # (the obs-discipline lint family enforces it).
+    trace_out: str = ""            # Chrome trace-event JSON path; ""=off
+    metrics_port: int = 0          # /metrics + /healthz port; 0 = off
+    flight_events: int = 256       # flight-recorder ring capacity
     # streaming mode: clients per host-fetched chunk for streamed eval /
     # phase-1 scoring / chunked DisPFL rounds; 0 = auto (mesh size or 4)
     stream_chunk_clients: int = 0

@@ -80,6 +80,8 @@ class QueueDispatchMixin:
     _STOP = object()
 
     def _init_dispatch(self) -> None:
+        from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+
         self._observers: list[Observer] = []
         self._q: queue.Queue = queue.Queue()
         self._stats_lock = threading.Lock()
@@ -87,16 +89,42 @@ class QueueDispatchMixin:
         self.bytes_recv = 0
         self.frames_sent = 0
         self.frames_recv = 0
+        # obs mirror (ISSUE 9): the SAME on-the-wire sizes publish into
+        # the process-global metrics registry, labeled by this
+        # transport's rank, so one /metrics scrape carries what
+        # byte_stats() reports (equality pinned in tests/test_obs.py —
+        # counters here and attributes above increment in lockstep, no
+        # second measurement, no double counting)
+        rank = str(getattr(self, "rank", getattr(self, "client_id", "?")))
+        lab = dict(rank=rank)
+        self._obs_bytes_sent = obs_metrics.counter(
+            "nidt_comm_bytes_sent_total",
+            "bytes put on the wire by this transport (frame incl. "
+            "length prefix)", labelnames=("rank",)).labels(**lab)
+        self._obs_bytes_recv = obs_metrics.counter(
+            "nidt_comm_bytes_recv_total",
+            "bytes received off the wire by this transport",
+            labelnames=("rank",)).labels(**lab)
+        self._obs_frames_sent = obs_metrics.counter(
+            "nidt_comm_frames_sent_total", "frames sent",
+            labelnames=("rank",)).labels(**lab)
+        self._obs_frames_recv = obs_metrics.counter(
+            "nidt_comm_frames_recv_total", "frames received",
+            labelnames=("rank",)).labels(**lab)
 
     def _count_sent(self, n: int) -> None:
         with self._stats_lock:
             self.bytes_sent += int(n)
             self.frames_sent += 1
+        self._obs_bytes_sent.inc(int(n))
+        self._obs_frames_sent.inc()
 
     def _count_recv(self, n: int) -> None:
         with self._stats_lock:
             self.bytes_recv += int(n)
             self.frames_recv += 1
+        self._obs_bytes_recv.inc(int(n))
+        self._obs_frames_recv.inc()
 
     def byte_stats(self) -> dict[str, int]:
         with self._stats_lock:

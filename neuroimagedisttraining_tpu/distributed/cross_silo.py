@@ -36,6 +36,8 @@ from neuroimagedisttraining_tpu.distributed import message as M
 from neuroimagedisttraining_tpu.distributed.managers import (
     ClientManager, ServerManager,
 )
+from neuroimagedisttraining_tpu.obs import flight as obs_flight
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
 
 log = logging.getLogger("neuroimagedisttraining_tpu.cross_silo")
@@ -311,6 +313,40 @@ class FedAvgServer(ServerManager):
         #: become a no-op — round_idx alone cannot distinguish the
         #: secure A->B transition within one round
         self._deadline_gen = 0
+        # ---- obs plane (ISSUE 9): every metric below publishes from
+        # the server's existing accept/aggregate handlers (dispatch and
+        # timer threads, under _rlock) — control-plane host code only,
+        # never a trace. The flight recorder gets every control-plane
+        # DECISION (drop/strike/quarantine/deadline/rejoin/ef-reset);
+        # the registry gets the numbers a scrape wants live.
+        self._obs_uploads = obs_metrics.counter(
+            "nidt_sync_uploads_total",
+            "sync-server upload admission verdicts",
+            labelnames=("outcome",))
+        self._obs_round_wall = obs_metrics.histogram(
+            "nidt_sync_round_wall_seconds",
+            "wall time from a round's sync broadcast to its completion",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0, 300.0))
+        self._obs_quorum_wait = obs_metrics.histogram(
+            "nidt_sync_quorum_wait_seconds",
+            "wall time from a round's FIRST accepted upload to its "
+            "aggregation (how long the earliest silo waited on the "
+            "barrier)",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0))
+        self._obs_round_gauge = obs_metrics.gauge(
+            "nidt_server_round", "current server round/version index")
+        self._obs_suspects = obs_metrics.gauge(
+            "nidt_server_suspects", "clients currently marked suspect")
+        self._obs_strikes = obs_metrics.counter(
+            "nidt_byz_strikes_total", "value-anomaly strikes issued")
+        self._obs_quarantines = obs_metrics.counter(
+            "nidt_byz_quarantines_total", "silo quarantines entered")
+        #: wall anchors for round_wall / quorum_wait (monotonic; None
+        #: until the first broadcast / first upload of the round)
+        self._round_t0: float | None = None
+        self._first_upload_t: float | None = None
 
     @property
     def fault_tolerant(self) -> bool:
@@ -339,6 +375,10 @@ class FedAvgServer(ServerManager):
         let a clever attacker starve the federation of honest silos)."""
         self._strikes[c] = self._strikes.get(c, 0) + 1
         self.byz_stats["outlier_flags"] += 1
+        self._obs_strikes.inc()
+        obs_flight.record("strike", client=c, count=self._strikes[c],
+                          threshold=self.outlier_threshold, why=why,
+                          round=self.round_idx)
         log.warning("server: value-anomaly strike %d/%d against silo %d "
                     "(%s)", self._strikes[c], self.outlier_threshold, c,
                     why)
@@ -353,6 +393,10 @@ class FedAvgServer(ServerManager):
         self._quarantine_until[c] = until
         self._strikes[c] = 0
         self._ef_reset_pending.add(c)
+        self._obs_quarantines.inc()
+        obs_flight.record("quarantine", client=c,
+                          from_round=self.round_idx + 1,
+                          until_round=until)
         self.byz_stats["quarantines"].append(
             {"client": c, "from_round": self.round_idx + 1,
              "until_round": until})
@@ -399,10 +443,15 @@ class FedAvgServer(ServerManager):
             return None
         step = acct.rdp_gaussian(1.0, z)
         eps = {}
+        eps_gauge = obs_metrics.gauge(
+            "nidt_dp_epsilon_silo",
+            "running weak_dp epsilon per silo (server RDP ledger, "
+            "privacy/accountant.py)", labelnames=("silo",))
         for c in senders:
             self._dp_rdp[c] = self._dp_rdp.get(c, 0.0) + step
             eps[c] = acct.rdp_to_epsilon(self._dp_rdp[c],
                                          delta=self.dp_delta)[0]
+            eps_gauge.labels(silo=c).set(float(eps[c]))
         return {"norm_bound": self.norm_bound, "stddev": self.stddev,
                 "noise_multiplier": round(z, 6), "delta": self.dp_delta,
                 "epsilon_per_silo": {c: round(e, 4)
@@ -466,6 +515,8 @@ class FedAvgServer(ServerManager):
             else:
                 # late rejoin: ship the CURRENT round state directly so a
                 # restarted silo re-enters without waiting a full round
+                obs_flight.record("rejoin", client=c,
+                                  round=self.round_idx)
                 log.info("server: client %d re-registered; shipping "
                          "round %d state", c, self.round_idx)
                 self._send_sync_to(M.MSG_TYPE_S2C_SYNC_MODEL, c)
@@ -481,15 +532,24 @@ class FedAvgServer(ServerManager):
         first. Stale rounds and re-delivered frames never double-count."""
         r = msg.get(M.ARG_ROUND_IDX)
         if r is not None and int(r) != self.round_idx:
+            self._obs_uploads.inc(outcome="stale")
+            obs_flight.record("drop_stale", client=msg.sender_id,
+                              tagged_round=int(r), round=self.round_idx)
             log.warning("server: dropping stale upload from %d "
                         "(round %s, current %d)", msg.sender_id, r,
                         self.round_idx)
             return False
         if msg.sender_id in self._updates:
+            self._obs_uploads.inc(outcome="duplicate")
+            obs_flight.record("drop_duplicate", client=msg.sender_id,
+                              round=self.round_idx)
             log.warning("server: dropping duplicate upload from %d "
                         "(round %d)", msg.sender_id, self.round_idx)
             return False
         if msg.sender_id in self._quarantined_now():
+            self._obs_uploads.inc(outcome="quarantined")
+            obs_flight.record("drop_quarantined", client=msg.sender_id,
+                              round=self.round_idx)
             log.warning("server: dropping upload from QUARANTINED silo "
                         "%d (round %d; window ends at round %d)",
                         msg.sender_id, self.round_idx,
@@ -519,6 +579,10 @@ class FedAvgServer(ServerManager):
                 # sender like any other straggler. Narrow catches here
                 # would let a malformed body kill server.run() (the
                 # dispatch loop has no guard of its own).
+                self._obs_uploads.inc(outcome="undecodable")
+                obs_flight.record("drop_undecodable",
+                                  client=msg.sender_id,
+                                  round=self.round_idx, error=str(e))
                 log.warning("server: dropping undecodable upload from %d "
                             "(round %d): %s", msg.sender_id,
                             self.round_idx, e)
@@ -530,6 +594,10 @@ class FedAvgServer(ServerManager):
             # silo shipping NaNs every round earns its quarantine.
             if not tree_all_finite(decoded):
                 self.byz_stats["nonfinite_rejected"] += 1
+                self._obs_uploads.inc(outcome="nonfinite")
+                obs_flight.record("reject_nonfinite",
+                                  client=msg.sender_id,
+                                  round=self.round_idx)
                 log.warning("server: REJECTING non-finite (NaN/Inf) "
                             "upload from silo %d (round %d; %d rejected "
                             "so far)", msg.sender_id, self.round_idx,
@@ -542,8 +610,11 @@ class FedAvgServer(ServerManager):
                 self._rejected_round.add(msg.sender_id)
                 self._maybe_complete()
                 return
+            if not self._updates:
+                self._first_upload_t = time.monotonic()
             self._updates[msg.sender_id] = (
                 decoded, float(msg.get(M.ARG_NUM_SAMPLES)))
+            self._obs_uploads.inc(outcome="accepted")
             self._last_beat[msg.sender_id] = time.monotonic()
             self._suspect.discard(msg.sender_id)
             self._maybe_complete()
@@ -668,6 +739,10 @@ class FedAvgServer(ServerManager):
                 log.warning("server: marking client %d suspect "
                             "(missed round %d deadline)", c, self.round_idx)
                 self._suspect.add(c)
+                obs_flight.record("suspect", client=c,
+                                  round=self.round_idx,
+                                  why="missed deadline")
+        self._obs_suspects.set(len(self._suspect))
 
     def _beat_stale(self, c: int) -> bool:
         if self.heartbeat_timeout <= 0:
@@ -680,6 +755,9 @@ class FedAvgServer(ServerManager):
         with self._rlock:
             if self._deadline_stale(round_for, gen):
                 return
+            obs_flight.record("deadline", round=round_for,
+                              have=len(self._updates),
+                              quorum=min(self.quorum, self.num_clients))
             if self._updates and len(self._updates) >= min(
                     self.quorum, self.num_clients):
                 self._mark_missing_suspect(set(self._updates))
@@ -704,6 +782,10 @@ class FedAvgServer(ServerManager):
                                     "stale (%.2fs) - marking suspect",
                                     c, now - last)
                         self._suspect.add(c)
+                        obs_flight.record(
+                            "suspect", client=c, round=self.round_idx,
+                            why=f"heartbeat stale {now - last:.2f}s")
+                        self._obs_suspects.set(len(self._suspect))
                 if self._started:
                     # a new suspect may have been the only missing
                     # uploader — the round can complete right now
@@ -714,6 +796,15 @@ class FedAvgServer(ServerManager):
         """Shared end-of-round transition: record history, advance, then
         either finish the federation or broadcast the next sync."""
         entry = {"round": self.round_idx, "clients": n_clients}
+        now = time.monotonic()
+        if self._round_t0 is not None:
+            self._obs_round_wall.observe(now - self._round_t0)
+        if self._first_upload_t is not None:
+            self._obs_quorum_wait.observe(now - self._first_upload_t)
+        self._first_upload_t = None
+        obs_flight.record("round_complete", round=self.round_idx,
+                          clients=n_clients,
+                          survivors=list(survivors or []))
         if survivors is not None:
             entry["survivors"] = list(survivors)
         if self._dp_round_info is not None:
@@ -728,6 +819,8 @@ class FedAvgServer(ServerManager):
             entry["quarantined"] = sorted(q)
         self.history.append(entry)
         self.round_idx += 1
+        self._obs_round_gauge.set(self.round_idx)
+        self._obs_suspects.set(len(self._suspect))
         if self.round_idx >= self.comm_round:
             if self._timer is not None:
                 self._timer.cancel()
@@ -779,6 +872,7 @@ class FedAvgServer(ServerManager):
             # into honest post-window uploads
             msg.add(M.ARG_EF_RESET, True)
             self._ef_reset_pending.discard(c)
+            obs_flight.record("ef_reset", client=c, round=self.round_idx)
             log.info("server: silo %d quarantine window over - sync "
                      "carries ef_reset", c)
         self._send_tolerant(msg)
@@ -786,6 +880,7 @@ class FedAvgServer(ServerManager):
     def _broadcast_sync(self, msg_type: str) -> None:
         for c in range(1, self.num_clients + 1):
             self._send_sync_to(msg_type, c)
+        self._round_t0 = time.monotonic()  # round-wall anchor (obs)
         self._arm_deadline()
 
     def _broadcast_finish(self) -> None:

@@ -487,6 +487,33 @@ def main(argv=None) -> int:
                          "the cross-silo control plane synchronizes with "
                          "every silo each round, so rounds always "
                          "dispatch one at a time here")
+    # observability (obs/, ISSUE 9)
+    ap.add_argument("--metrics_port", type=int, default=0,
+                    help="serve /metrics (Prometheus text) + /healthz "
+                         "on this port for the server rank's metrics "
+                         "registry (obs/http.py); 0 = off. NOTE: the "
+                         "endpoint is unauthenticated and the metrics "
+                         "include control-plane state (per-silo DP "
+                         "epsilon, upload verdicts) — bind scope via "
+                         "--metrics_host")
+    ap.add_argument("--metrics_host", type=str, default="0.0.0.0",
+                    help="interface the metrics endpoint binds "
+                         "(default all interfaces, the Prometheus-"
+                         "exporter convention; pass 127.0.0.1 on "
+                         "shared hosts)")
+    ap.add_argument("--trace_out", type=str, default="",
+                    help="write this process's host-span timeline as "
+                         "Chrome trace-event JSON (obs/trace.py, "
+                         "Perfetto-loadable) at exit; give each rank "
+                         "its own path")
+    ap.add_argument("--flight_events", type=int, default=256,
+                    help="flight-recorder ring capacity (obs/flight.py) "
+                         "— the last N control-plane decisions kept for "
+                         "the post-mortem dump")
+    ap.add_argument("--flight_out", type=str, default="",
+                    help="flight-recorder dump path: written at end of "
+                         "run on the server rank and on any fatal "
+                         "failure (failure_context); empty = dumps off")
     ap.add_argument("--client_mesh", type=int, default=0,
                     help="accepted for config parity with the main CLI; "
                          "each cross-silo rank trains only its own silo, "
@@ -664,6 +691,22 @@ def main(argv=None) -> int:
         enable_compile_cache,
     )
     enable_compile_cache(args.compile_cache)
+    # observability plane (obs/, ISSUE 9): flight ring + span tracer are
+    # per-process; the /metrics endpoint starts on the server rank below
+    from neuroimagedisttraining_tpu.obs import flight as obs_flight
+    from neuroimagedisttraining_tpu.obs import trace as obs_trace
+
+    # the dump PATH arms on the server rank only: silo ranks record into
+    # their own rings (on a fatal failure failure_context logs the
+    # ring's tail when no dump path is set), but a crashing silo
+    # sharing one --flight_out arg list must never clobber the server's
+    # post-mortem file
+    obs_flight.configure(capacity=args.flight_events,
+                         path=args.flight_out
+                         if args.role == "server" else "")
+    if args.trace_out:
+        obs_trace.arm(args.trace_out,
+                      tags={"role": args.role, "rank": args.rank})
     host_map = _parse_hosts(args.hosts)
     if args.force_cpu:
         from neuroimagedisttraining_tpu.parallel.mesh import (
@@ -758,7 +801,55 @@ def main(argv=None) -> int:
             print(f"[server] {args.transport} control plane on port "
                   f"{args.broker_port or args.base_port}; waiting for "
                   f"{args.num_clients} silos", flush=True)
-        server.run()
+        from neuroimagedisttraining_tpu.obs.http import (
+            start_metrics_server,
+        )
+        from neuroimagedisttraining_tpu.utils.profiling import (
+            failure_context,
+        )
+
+        def _health() -> dict:
+            # scrape-thread probe with a BOUNDED lock wait: _rlock is
+            # held across whole aggregations (first-round XLA compile
+            # included), and a k8s-style liveness probe with a 1-2s
+            # timeout must never conclude "dead" because the server is
+            # busy doing its job — a timed-out acquire reports busy,
+            # which IS a liveness signal
+            if not server._rlock.acquire(timeout=0.2):
+                return {"busy": True}
+            try:
+                h = {"round": int(server.round_idx),
+                     "registered": len(server._registered),
+                     "suspects": len(server._suspect)}
+                if args.async_server:
+                    h["buffered"] = len(server._buffer)
+            finally:
+                server._rlock.release()
+            return h
+
+        msrv = start_metrics_server(args.metrics_port,
+                                    health_probe=_health,
+                                    host=args.metrics_host)
+        if msrv is not None:
+            print(f"[server] obs: /metrics + /healthz on port "
+                  f"{msrv.port}", flush=True)
+        clean_exit = False
+        try:
+            # failure_context dumps the flight ring before re-raising —
+            # a chaos run that dies leaves its post-mortem
+            with failure_context(name="cross-silo server"):
+                server.run()
+            clean_exit = True
+        finally:
+            if args.flight_out and clean_exit:
+                # on failure the failure_context dump IS the artifact —
+                # re-dumping here would relabel the crash post-mortem
+                # as a normal end of run
+                obs_flight.dump(reason="end of run")
+            if args.trace_out:
+                obs_trace.dump()
+            if msrv is not None:
+                msrv.close()
         if broker is not None:
             broker.stop()
         norm = float(np.sqrt(sum(
@@ -827,7 +918,14 @@ def main(argv=None) -> int:
                  base_port=args.base_port, host_map=host_map, comm=comm,
                  heartbeat_interval=args.heartbeat_interval, **kw)
     print(f"[silo {args.rank}] joining server", flush=True)
-    client.run()
+    from neuroimagedisttraining_tpu.utils.profiling import failure_context
+
+    try:
+        with failure_context(name=f"silo {args.rank}"):
+            client.run()
+    finally:
+        if args.trace_out:
+            obs_trace.dump()
     return 0
 
 

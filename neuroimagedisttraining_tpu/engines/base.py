@@ -30,6 +30,8 @@ from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.faults.schedule import (
     FaultSchedule, parse_fault_spec,
 )
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.utils import checkpoint as ckpt
 from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger, get_logger
@@ -395,8 +397,12 @@ class FederatedEngine:
         n = getattr(self.data, f"n_{split}")
         if self.cfg.fed.ci:  # CI escape hatch: client 0 only
             X, y, n = X[:1], y[:1], n[:1]
-        out = self._eval_global_jit(params, bstats, X, y, n)
-        return self._summarize(*out, n=n if not self.cfg.fed.ci else n[:1])
+        # eval is a host boundary (the _summarize numpy reads block on
+        # the device), so it is also a span: dispatch + sync wall time
+        with obs_trace.span("eval_global", split=split):
+            out = self._eval_global_jit(params, bstats, X, y, n)
+            return self._summarize(*out,
+                                   n=n if not self.cfg.fed.ci else n[:1])
 
     def eval_personalized(self, states: ClientState, split: str = "test"
                           ) -> dict[str, float]:
@@ -409,8 +415,9 @@ class FederatedEngine:
             X, y, n = X[:1], y[:1], n[:1]
             params = pt.tree_stack_index(params, slice(0, 1))
             bstats = pt.tree_stack_index(bstats, slice(0, 1))
-        out = self._eval_personal_jit(params, bstats, X, y, n)
-        return self._summarize(*out, n=n)
+        with obs_trace.span("eval_personalized", split=split):
+            out = self._eval_personal_jit(params, bstats, X, y, n)
+            return self._summarize(*out, n=n)
 
     # ---------- checkpoint / resume (SURVEY §5.4 rebuild requirement) ----------
 
@@ -857,21 +864,53 @@ class FederatedEngine:
         Doubles as the privacy-ledger boundary: every driver that can
         arm weak_dp already calls this at exactly the host-sync points
         where per-round accounting should publish, so the accountant
-        records here instead of asking each engine for a second hook."""
+        records here instead of asking each engine for a second hook —
+        and as the OBS boundary (ISSUE 9): the stat_info accumulators
+        publish into the metrics registry here, where the driver already
+        blocks on device results, never from inside a dispatch."""
         self.record_privacy(round_idx)
-        if not self._nonfinite_pending:
-            return
-        counts = jax.device_get(self._nonfinite_pending)
-        self._nonfinite_pending.clear()
-        total = int(sum(np.sum(np.asarray(c)) for c in counts))
-        if total:
-            self.stat_info["nonfinite_uploads"] += total
-            self.log.warning(
-                "rounds <= %d: rejected %d non-finite (NaN/Inf) client "
-                "upload(s) before aggregation — the offending clients "
-                "were zero-weighted for their rounds (%d rejected so "
-                "far this run)", round_idx, total,
-                int(self.stat_info["nonfinite_uploads"]))
+        if self._nonfinite_pending:
+            with obs_trace.span("flush_nonfinite", round=round_idx):
+                counts = jax.device_get(self._nonfinite_pending)
+            self._nonfinite_pending.clear()
+            total = int(sum(np.sum(np.asarray(c)) for c in counts))
+            if total:
+                self.stat_info["nonfinite_uploads"] += total
+                self.log.warning(
+                    "rounds <= %d: rejected %d non-finite (NaN/Inf) "
+                    "client upload(s) before aggregation — the "
+                    "offending clients were zero-weighted for their "
+                    "rounds (%d rejected so far this run)", round_idx,
+                    total, int(self.stat_info["nonfinite_uploads"]))
+        self.publish_stat_info(round_idx)
+
+    def publish_stat_info(self, round_idx: int) -> None:
+        """Publish the scalar ``stat_info`` accumulators (and the armed
+        privacy ledger's running epsilon) into the obs metrics registry
+        — gauge semantics, value == the legacy dict entry by
+        construction (the no-double-counting pin in tests/test_obs.py).
+        Host-boundary only: the callers are ``_flush_nonfinite`` and
+        run-end paths, both already synced."""
+        g = obs_metrics.gauge(
+            "nidt_stat", "engine stat_info accumulators "
+            "(engines/base.py), one series per key",
+            labelnames=("key",))
+        for k, v in self.stat_info.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                g.labels(key=k).set(float(v))
+        for src in ("weak_dp", "dp"):
+            d = self.stat_info.get(src)
+            if isinstance(d, dict) and d.get("epsilon_per_round"):
+                obs_metrics.gauge(
+                    "nidt_dp_epsilon",
+                    "running (epsilon, delta) privacy cost of the armed "
+                    "noise path (privacy/accountant.py)",
+                    labelnames=("source",)).labels(source=src).set(
+                    float(d["epsilon"]))
+        obs_metrics.gauge(
+            "nidt_engine_round",
+            "last round index flushed at an engine host boundary",
+        ).set(int(round_idx))
 
     # ---------- helpers ----------
 

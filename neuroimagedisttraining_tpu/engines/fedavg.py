@@ -25,6 +25,7 @@ from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.faults import adversary
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.utils import pytree as pt
 
@@ -270,10 +271,18 @@ class FedAvgEngine(FederatedEngine):
         untouched). Returns ``(params, bstats, last_round_loss,
         k_actual)`` — ``k_actual`` may shrink when the fault schedule
         varies the cohort size."""
-        (_, idx, rngs, lrs, byz, k,
-         n_real) = self._window_host_inputs(round_idx, k)
-        params, bstats, losses, bads = self._fused_round_jit(k, n_real)(
-            params, bstats, self.data, idx, rngs, lrs, byz)
+        # the window IS a host boundary pair (ISSUE 9): the prologue and
+        # the dispatch are separate host spans — "dispatch" measures the
+        # enqueue only (async dispatch races ahead; the sync lands at
+        # the next eval/flush boundary, never here)
+        with obs_trace.span("window", round=round_idx, k=k):
+            with obs_trace.span("window_host_prologue", round=round_idx):
+                (_, idx, rngs, lrs, byz, k,
+                 n_real) = self._window_host_inputs(round_idx, k)
+            with obs_trace.span("dispatch", round=round_idx, k=k):
+                params, bstats, losses, bads = self._fused_round_jit(
+                    k, n_real)(params, bstats, self.data, idx, rngs,
+                               lrs, byz)
         self._note_nonfinite(bads)
         return params, bstats, losses[-1], k
 
@@ -378,10 +387,12 @@ class FedAvgEngine(FederatedEngine):
                     efs = (pt.tree_stack_index(self._wire_ef,
                                                np.asarray(sampled))
                            if self.wire_spec.needs_ef else None)
-                    (params, bstats, loss, n_bad, new_efs,
-                     u0) = round_prog(
-                        params, bstats, self.data, jnp.asarray(ids),
-                        rngs, self.round_lr(round_idx), efs, byz)
+                    with obs_trace.span("round", round=round_idx,
+                                        codec=True):
+                        (params, bstats, loss, n_bad, new_efs,
+                         u0) = round_prog(
+                            params, bstats, self.data, jnp.asarray(ids),
+                            rngs, self.round_lr(round_idx), efs, byz)
                     if new_efs is not None:
                         real = jnp.asarray(self._n_train_host[sampled] > 0)
                         self._wire_ef = self.scatter_sampled_rows(
@@ -393,18 +404,20 @@ class FedAvgEngine(FederatedEngine):
                     # byz plans only reach engines whose round accepts
                     # them (supports_byz_faults gates at startup); efs
                     # rides its default None
-                    params, bstats, loss, n_bad = round_prog(
-                        params, bstats, self.data, jnp.asarray(ids),
-                        rngs, self.round_lr(round_idx), None, byz)
+                    with obs_trace.span("round", round=round_idx):
+                        params, bstats, loss, n_bad = round_prog(
+                            params, bstats, self.data, jnp.asarray(ids),
+                            rngs, self.round_lr(round_idx), None, byz)
                 else:
                     # efs/byz stay default-bound (None): subclasses
                     # override _round_jit with efs-free signatures
                     # (turboaggregate), and an argument filled from its
                     # default is never donated, so no explicit None is
                     # needed here
-                    params, bstats, loss, n_bad = round_prog(
-                        params, bstats, self.data, jnp.asarray(ids),
-                        rngs, self.round_lr(round_idx))
+                    with obs_trace.span("round", round=round_idx):
+                        params, bstats, loss, n_bad = round_prog(
+                            params, bstats, self.data, jnp.asarray(ids),
+                            rngs, self.round_lr(round_idx))
                 self._note_nonfinite(n_bad)
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:

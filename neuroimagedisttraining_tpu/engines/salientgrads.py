@@ -33,6 +33,7 @@ from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.faults import adversary
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import snip as snip_ops
@@ -377,12 +378,18 @@ class SalientGradsEngine(FederatedEngine):
         new state, per-round sampled sets (for the host-side stat
         accounting), the boundary round's loss, and the actual window
         length."""
-        (sampled, idx, rngs, lrs, byz, k,
-         n_real) = self._window_host_inputs(round_idx, k)
-        (params, bstats, per_params, per_bstats, losses,
-         bads) = self._fused_round_jit(k, n_real)(
-            params, bstats, per_params, per_bstats, self.data, masks,
-            idx, rngs, lrs, byz)
+        # window edges are host boundaries (obs/, ISSUE 9): the same
+        # window ⊃ {prologue, dispatch} span structure as the fedavg
+        # driver, so flagship masked traces read identically
+        with obs_trace.span("window", round=round_idx, k=k):
+            with obs_trace.span("window_host_prologue", round=round_idx):
+                (sampled, idx, rngs, lrs, byz, k,
+                 n_real) = self._window_host_inputs(round_idx, k)
+            with obs_trace.span("dispatch", round=round_idx, k=k):
+                (params, bstats, per_params, per_bstats, losses,
+                 bads) = self._fused_round_jit(k, n_real)(
+                    params, bstats, per_params, per_bstats, self.data,
+                    masks, idx, rngs, lrs, byz)
         self._note_nonfinite(bads)
         return (params, bstats, per_params, per_bstats, sampled,
                 losses[-1], k)
@@ -509,11 +516,13 @@ class SalientGradsEngine(FederatedEngine):
                     ref_host = jax.tree.map(
                         np.asarray, {"params": params,
                                      "batch_stats": bstats})
-                    (params, bstats, per_params, per_bstats, loss, n_bad,
-                     u0) = round_prog(
-                        params, bstats, per_params, per_bstats, self.data,
-                        masks, jnp.asarray(ids), rngs,
-                        self.round_lr(round_idx), byz)
+                    with obs_trace.span("round", round=round_idx,
+                                        codec=True):
+                        (params, bstats, per_params, per_bstats, loss,
+                         n_bad, u0) = round_prog(
+                            params, bstats, per_params, per_bstats,
+                            self.data, masks, jnp.asarray(ids), rngs,
+                            self.round_lr(round_idx), byz)
                     masks_host = {
                         "params": jax.tree.map(np.asarray, masks),
                         "batch_stats": jax.tree.map(
@@ -522,11 +531,12 @@ class SalientGradsEngine(FederatedEngine):
                         jax.tree.map(np.asarray, u0), ref_host,
                         masks_host=masks_host, n_uploads=len(sampled))
                 else:
-                    (params, bstats, per_params, per_bstats, loss,
-                     n_bad) = round_prog(
-                        params, bstats, per_params, per_bstats, self.data,
-                        masks, jnp.asarray(ids), rngs,
-                        self.round_lr(round_idx), byz)
+                    with obs_trace.span("round", round=round_idx):
+                        (params, bstats, per_params, per_bstats, loss,
+                         n_bad) = round_prog(
+                            params, bstats, per_params, per_bstats,
+                            self.data, masks, jnp.asarray(ids), rngs,
+                            self.round_lr(round_idx), byz)
             self._note_nonfinite(n_bad)
             n_samples = float(np.sum(self._n_train_host[sampled]))
             self.stat_info["sum_training_flops"] += (

@@ -1,0 +1,55 @@
+"""``obs/`` — the unified telemetry plane (ISSUE 9).
+
+Three host-side instruments, one import surface:
+
+- :mod:`obs.trace` — a dependency-free, thread-safe span tracer emitting
+  Chrome trace-event JSON (Perfetto-loadable), with an adapter that opens
+  a matching ``jax.profiler.TraceAnnotation`` per span so the host
+  timeline lines up with the XLA timeline under ``--profile_dir``.
+- :mod:`obs.metrics` — a registry of labeled Counters / Gauges /
+  Histograms with ``snapshot()``, Prometheus text exposition, and a
+  JSONL sink. The single home that ``stat_info``, comm ``byte_stats``,
+  async buffer occupancy / staleness, sync round wall, strikes /
+  quarantines, and per-silo DP epsilon publish into.
+- :mod:`obs.flight` — a bounded ring flight recorder of structured
+  control-plane events, dumped to JSON by ``failure_context`` and on
+  audit failure (the chaos post-mortem).
+- :mod:`obs.http` — a stdlib-only ``/metrics`` + ``/healthz`` endpoint
+  (``--metrics_port``).
+
+THE HOST-BOUNDARY RULE: none of this may run inside a jitted/vmapped/
+shard_mapped body. Clocks (``time.monotonic``/``perf_counter``) and
+registry mutation inside a traced function either bake one Python value
+into the compiled executable or force a host sync mid-dispatch;
+instrumentation sits only at the existing host boundaries
+(``_flush_nonfinite``, fused-window edges, server accept/aggregate
+paths, selector-loop ticks). nidtlint's ``obs-discipline`` family
+(analysis/obs_discipline.py) machine-checks this.
+
+Everything is off-by-default cheap: the tracer disarmed returns a
+shared no-op context manager (no allocation), the flight ring is one
+bounded deque append, and the registry can be disarmed wholesale
+(``metrics.disable()``) for A/B overhead measurement
+(bench.py ``obs_overhead`` cell).
+"""
+
+from neuroimagedisttraining_tpu.obs import flight, metrics, trace  # noqa: F401
+from neuroimagedisttraining_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
+from neuroimagedisttraining_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    MetricsRegistry,
+)
+from neuroimagedisttraining_tpu.obs.trace import TRACER, SpanTracer, span  # noqa: F401
+
+__all__ = [
+    "FLIGHT",
+    "FlightRecorder",
+    "REGISTRY",
+    "MetricsRegistry",
+    "TRACER",
+    "SpanTracer",
+    "span",
+    "flight",
+    "metrics",
+    "trace",
+]

@@ -1,0 +1,243 @@
+"""Span tracer: host-side timeline, Chrome trace-event JSON out.
+
+Frostig et al. 2018 (PAPERS.md, JAX/SysML): under asynchronous dispatch
+the host thread races ahead of the accelerator, so host observability is
+only meaningful at the host<->XLA seams the dispatch model defines — a
+span here measures HOST time between dispatch boundaries (enqueue a
+fused window, block on an eval result), never device time, and must
+never ADD a sync to read a clock. The complementary device timeline is
+``jax.profiler`` (``--profile_dir``); the adapter below opens a matching
+``jax.profiler.TraceAnnotation`` per span so the two line up in one
+XProf/Perfetto view.
+
+Design constraints (ISSUE 9):
+
+- dependency-free: stdlib only; jax is imported lazily and only when the
+  caller armed the annotation adapter.
+- thread-safe: every server handler thread / selector loop / engine
+  driver appends to one per-process buffer under a lock; events carry
+  the OS thread id so Perfetto lays threads out as separate tracks.
+- nestable: spans are ordinary context managers; Chrome "X" (complete)
+  events nest by time containment per thread, so no explicit parent
+  bookkeeping is needed (``tests/test_obs.py`` pins containment).
+- off-by-default cheap: disarmed, ``span()`` returns a shared no-op
+  context manager — no allocation, no clock read, one attribute test.
+
+Output: ``{"traceEvents": [...], "displayTimeUnit": "ms"}`` with "X"
+events ``{name, ph, ts, dur, pid, tid, args}`` (ts/dur in microseconds
+since arm time, monotonic clock) — the Chrome trace-event format
+Perfetto and ``chrome://tracing`` load directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+__all__ = ["SpanTracer", "TRACER", "span", "instant", "arm", "disarm",
+           "dump"]
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disarmed fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    """One live span: records a Chrome "X" event on exit; optionally
+    holds a matching ``jax.profiler.TraceAnnotation`` open for its
+    lifetime (the host<->XLA alignment adapter)."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0", "_ann")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._t0 = 0
+        self._ann = None
+
+    def __enter__(self):
+        t = self._tracer
+        if t._annotate:
+            try:
+                import jax
+
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:  # noqa: BLE001 — tracing must never be
+                # the thing that kills a run (no jax, profiler torn down)
+                self._ann = None
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:  # noqa: BLE001 — see __enter__
+                pass
+        self._tracer._record(self.name, self._t0, t1, self.args)
+        return False
+
+
+class SpanTracer:
+    """Per-process span buffer. Arm with an output path; every
+    ``span()`` between arm and ``dump()`` lands in the trace. Tracer-
+    level ``tags`` (rank, role, ...) merge into every event's args —
+    the per-process key the multi-silo timeline is joined on."""
+
+    #: event-buffer cap (~80 MB of dicts at ~300 B/event): a multi-hour
+    #: armed run must not grow host memory without bound — events past
+    #: the cap are DROPPED and counted (bounded-buffer honesty, the
+    #: flight ring's rule), keeping the PREFIX of the run, which is
+    #: what a Perfetto session of a long run gets opened on anyway
+    DEFAULT_MAX_EVENTS = 1 << 18
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._armed = False
+        self._annotate = False
+        self._path: str | None = None
+        self._tags: dict[str, Any] = {}
+        self._epoch_ns = time.perf_counter_ns()
+        self._max_events = self.DEFAULT_MAX_EVENTS
+        self._dropped = 0
+
+    # ---- lifecycle ----
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def arm(self, path: str | None = None, *, annotate: bool = False,
+            tags: dict | None = None,
+            max_events: int | None = None) -> None:
+        """Start recording. ``annotate=True`` additionally opens a
+        ``jax.profiler.TraceAnnotation`` per span (use with
+        ``--profile_dir`` so host spans appear on the XLA timeline);
+        ``tags`` ride in every event's args; ``max_events`` caps the
+        buffer (default ``DEFAULT_MAX_EVENTS``; excess events are
+        dropped and counted in the dump's ``nidtDroppedEvents``)."""
+        with self._lock:
+            self._path = path
+            self._annotate = bool(annotate)
+            self._tags = dict(tags or {})
+            self._epoch_ns = time.perf_counter_ns()
+            self._events.clear()
+            self._max_events = (self.DEFAULT_MAX_EVENTS
+                                if max_events is None
+                                else int(max_events))
+            self._dropped = 0
+            self._armed = True
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+            self._annotate = False
+
+    # ---- recording ----
+
+    def span(self, name: str, **args: Any):
+        """Context manager for one host span. Disarmed: a shared no-op
+        (no allocation, no clock read)."""
+        if not self._armed:
+            return _NULL
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """Zero-duration marker (Chrome "i" instant event)."""
+        if not self._armed:
+            return
+        ts = (time.perf_counter_ns() - self._epoch_ns) / 1e3
+        with self._lock:
+            if not self._armed:
+                return
+            if len(self._events) >= self._max_events:
+                self._dropped += 1
+                return
+            self._events.append({
+                "name": name, "ph": "i", "s": "t",
+                "ts": ts, "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": {**self._tags, **args}})
+
+    def _record(self, name: str, t0_ns: int, t1_ns: int,
+                args: dict) -> None:
+        ev = {
+            "name": name, "ph": "X",
+            "ts": (t0_ns - self._epoch_ns) / 1e3,
+            "dur": (t1_ns - t0_ns) / 1e3,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": {**self._tags, **args},
+        }
+        with self._lock:
+            if not self._armed:
+                return
+            if len(self._events) >= self._max_events:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    # ---- output ----
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def dump(self, path: str | None = None) -> str | None:
+        """Write the Chrome trace JSON; returns the path written (None
+        when no path was armed or given, OR when the write failed —
+        every caller dumps from a ``finally``, and an unwritable
+        ``--trace_out`` must neither mask the run's real exception nor
+        fail a successful run at exit; flight.dump keeps the same
+        contract). Safe to call repeatedly — the buffer is kept, so a
+        mid-run dump is a prefix of the final."""
+        with self._lock:
+            out = path or self._path
+            if not out:
+                return None
+            doc = {"traceEvents": list(self._events),
+                   "displayTimeUnit": "ms"}
+            if self._dropped:
+                # Perfetto ignores unknown top-level keys; the count
+                # keeps a truncated long run honest
+                doc["nidtDroppedEvents"] = self._dropped
+        try:
+            d = os.path.dirname(out)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(out, "w") as f:
+                json.dump(doc, f)
+        except OSError:
+            return None
+        return out
+
+
+#: the process-global tracer every instrumentation site records into
+TRACER = SpanTracer()
+
+#: module-level conveniences (the instrumentation-site spelling:
+#: ``from neuroimagedisttraining_tpu.obs import trace`` then
+#: ``with trace.span("eval", round=r): ...``)
+span = TRACER.span
+instant = TRACER.instant
+arm = TRACER.arm
+disarm = TRACER.disarm
+dump = TRACER.dump

@@ -43,6 +43,15 @@ class ExperimentLogger:
         self._log = logging.getLogger(f"nidt.exp.{identity}")
         self._log.setLevel(logging.INFO)
         self._log.propagate = False
+        # logging.getLogger CACHES by name: constructing a second
+        # ExperimentLogger with the same identity (benches, re-built
+        # engines, tests) used to STACK another FileHandler/StreamHandler
+        # on the cached logger, duplicating every subsequent line once
+        # per construction — drop any handlers a previous instance left
+        # before adding ours (regression-pinned in tests/test_obs.py)
+        for h in list(self._log.handlers):
+            h.close()
+            self._log.removeHandler(h)
         fh = logging.FileHandler(self.log_path)
         fh.setFormatter(logging.Formatter("%(message)s"))  # message-only parity
         self._log.addHandler(fh)
@@ -59,15 +68,46 @@ class ExperimentLogger:
         self._log.warning(msg, *args)
 
     def metrics(self, round_idx: int, **values: Any) -> None:
-        """Append one structured metrics record for a round."""
+        """Append one structured metrics record for a round — and
+        publish every numeric scalar into the obs metrics registry
+        (obs/metrics.py, ISSUE 9), so a live ``/metrics`` scrape sees
+        the same train_loss/acc/auc series the JSONL file records."""
         rec: dict[str, Any] = {"round": int(round_idx),
                                "t": round(time.monotonic() - self._t0, 3)}
         for k, v in values.items():
             rec[k] = _jsonable(v)
         with open(self.jsonl_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
+        self._publish_registry(round_idx, rec)
         self._log.info("round %d metrics: %s", round_idx,
                        {k: rec[k] for k in values})
+
+    def _publish_registry(self, round_idx: int, rec: Mapping[str, Any]
+                          ) -> None:
+        """Gauge semantics (last value wins) keyed by metric name — one
+        flat namespace, nested dicts flattened with ``_`` (the same
+        flattening the JSONL reader would do)."""
+        from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+
+        g = obs_metrics.gauge(
+            "nidt_exp_metric",
+            "per-round experiment metrics (ExperimentLogger.metrics)",
+            labelnames=("key",))
+        obs_metrics.gauge(
+            "nidt_exp_round",
+            "last round index ExperimentLogger.metrics recorded",
+        ).set(int(round_idx))
+
+        def put(prefix: str, v: Any) -> None:
+            if isinstance(v, Mapping):
+                for k2, v2 in v.items():
+                    put(f"{prefix}_{k2}", v2)
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                g.labels(key=prefix).set(float(v))
+
+        for k, v in rec.items():
+            if k not in ("round", "t"):
+                put(k, v)
 
     def close(self) -> None:
         for h in list(self._log.handlers):

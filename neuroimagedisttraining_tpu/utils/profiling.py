@@ -52,8 +52,29 @@ def failure_context(logger: logging.Logger | None = None,
     log = logger or logging.getLogger("neuroimagedisttraining_tpu")
     try:
         yield
-    except Exception:
+    except Exception as exc:
         log.error("FATAL in %s:\n%s", name, traceback.format_exc())
+        # flight-recorder post-mortem (obs/flight.py, ISSUE 9): the last
+        # N control-plane decisions, dumped BEFORE teardown can destroy
+        # more state; dumping must never mask the original exception
+        try:
+            from neuroimagedisttraining_tpu.obs import flight
+
+            flight.record("failure", name=name,
+                          error=f"{type(exc).__name__}: {exc}")
+            out = flight.dump(reason=f"failure_context: {name}")
+            if out:
+                log.error("flight recorder dumped to %s", out)
+            else:
+                # no dump path configured (e.g. a silo rank): the
+                # recorded decisions must not vanish — log the tail
+                evs = flight.events()
+                if evs:
+                    log.error("no flight dump path configured; last "
+                              "%d of %d flight events: %s",
+                              min(20, len(evs)), len(evs), evs[-20:])
+        except Exception:  # noqa: BLE001 — best-effort post-mortem
+            pass
         if teardown is not None:
             try:
                 teardown()

@@ -14,6 +14,12 @@
 #     round barrier — every aggregation must land, the model stay
 #     finite, and BOTH accounting audits (received == accepted +
 #     dropped; accepted == aggregated + buffered) come back green;
+#     the cell also exercises the obs plane (ISSUE 9): a background
+#     scraper hits the live /metrics endpoint MID-chaos (Prometheus
+#     text must parse and carry the staleness histogram + buffer
+#     occupancy), and after the kill-k run the server's flight-recorder
+#     dump (--flight_out) must exist and parse with the control-plane
+#     decisions in it;
 #   - secure_quant + kill-k (ISSUE 8): client 3 crashes at round 1
 #     under secure QUANTIZED aggregation (privacy/secure_quant.py) —
 #     the two-phase Bonawitz discard drops the corpse's frame whole,
@@ -98,6 +104,10 @@ run_async() {
 import free_port_block; print(free_port_block(16))")
     # NOTE: no --round_deadline/--quorum — the buffered server has no
     # round barrier and rejects them at startup by design
+    local metrics_port=$((port + 8))
+    local flight_out="/tmp/chaos_smoke_async_flight.json"
+    local scrape_out="/tmp/chaos_smoke_async_metrics.txt"
+    rm -f "$flight_out" "$scrape_out"
     local common=(--num_clients "$CLIENTS" --comm_round "$ROUNDS"
                   --model 3dcnn_tiny --dataset synthetic
                   --synthetic_num_subjects 24
@@ -108,11 +118,36 @@ import free_port_block; print(free_port_block(16))")
                   --defense trimmed_mean --byz_f 1
                   --heartbeat_interval 0.5 --heartbeat_timeout 5)
     echo "== chaos smoke (asyncfl buffered server, port $port): kill" \
-         "client 3 at version 1, buffer_k=3, trimmed_mean armed =="
+         "client 3 at version 1, buffer_k=3, trimmed_mean armed," \
+         "/metrics on $metrics_port =="
     local out="/tmp/chaos_smoke_async.log"
     $PY -m neuroimagedisttraining_tpu.distributed.run \
-        --role server "${common[@]}" > "$out" 2>&1 &
+        --role server "${common[@]}" \
+        --metrics_port "$metrics_port" --flight_out "$flight_out" \
+        --flight_events 512 > "$out" 2>&1 &
     local server_pid=$!
+    # obs cell (ISSUE 9): scrape the LIVE /metrics endpoint mid-chaos —
+    # the scrape must be valid Prometheus text carrying the staleness
+    # histogram and an accepted-uploads sample before the run ends
+    $PY - "$metrics_port" "$scrape_out" <<'PYEOF' &
+import sys, time, urllib.request
+port, out = int(sys.argv[1]), sys.argv[2]
+deadline = time.time() + 240
+while time.time() < deadline:
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2).read().decode()
+        if ('nidt_async_uploads_total{outcome="accepted"}' in body
+                and "nidt_async_staleness_bucket" in body
+                and "nidt_async_buffer_occupancy" in body):
+            open(out, "w").write(body)
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit(1)
+PYEOF
+    local scraper_pid=$!
     local pids=()
     for r in $(seq 1 "$CLIENTS"); do
         $PY -m neuroimagedisttraining_tpu.distributed.run \
@@ -122,14 +157,20 @@ import free_port_block; print(free_port_block(16))")
     done
     if ! wait "$server_pid"; then
         echo "FAIL(async): server exited non-zero"
+        kill "$scraper_pid" 2>/dev/null
         cat "$out"; return 1
     fi
     for p in "${pids[@]}"; do wait "$p" 2>/dev/null || true; done
+    if ! wait "$scraper_pid"; then
+        echo "FAIL(async/obs): mid-chaos /metrics scrape never saw the "\
+"staleness histogram + buffer occupancy"
+        return 1
+    fi
     local json
     json=$(grep -a -o '^{.*}' "$out" | tail -1)
     echo "$json"
-    $PY - "$json" <<EOF
-import json, math, sys
+    $PY - "$json" "$scrape_out" "$flight_out" <<EOF
+import json, math, re, sys
 res = json.loads(sys.argv[1])
 assert res["async_server"] is True, res
 assert res["rounds_completed"] == $ROUNDS, res
@@ -141,10 +182,26 @@ assert audit["received_accounted"], audit
 # audit 2: every accepted upload aggregated or still buffered
 assert audit["accepted_accounted"], audit
 assert res["frames_recv"] > 0 and res["bytes_recv"] > 0, res
+# obs cell (ISSUE 9): the mid-chaos scrape is valid Prometheus text
+# with the async distributions present
+sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+                    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+scrape = open(sys.argv[2]).read()
+for line in scrape.strip().splitlines():
+    assert line.startswith("#") or sample.match(line), line
+assert "nidt_async_staleness_bucket" in scrape
+assert "nidt_async_buffer_occupancy" in scrape
+assert 'nidt_async_uploads_total{outcome="accepted"}' in scrape
+# and the kill-k run left a parseable flight-recorder post-mortem
+flight = json.load(open(sys.argv[3]))
+kinds = [e["kind"] for e in flight["events"]]
+assert "accept" in kinds and "aggregate" in kinds, kinds[:20]
 print(f"OK(async): {res['rounds_completed']} aggregations, "
       f"{audit['accepted']} uploads accepted "
       f"(taus={res['staleness_taus']}), audits green, "
-      f"|params|={res['final_param_norm']:.3f}")
+      f"|params|={res['final_param_norm']:.3f}; obs: /metrics scraped "
+      f"mid-chaos ({len(scrape.splitlines())} lines), flight dump "
+      f"{len(flight['events'])} events")
 EOF
 }
 

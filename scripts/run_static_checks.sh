@@ -32,7 +32,11 @@ fi
 # calls (time.sleep, socket recv/accept, bare queue.get) lexically
 # inside async def bodies — one blocking call silently serializes the
 # whole simulated-client fleet; lock-discipline extends to asyncfl/ too
-echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline) =="
+# the obs-discipline family (ISSUE 9) rides the trace-safety resolver:
+# no clock reads (time.time/monotonic/perf_counter) and no metrics-
+# registry/flight/span mutation lexically inside functions handed to
+# jit/vmap/shard_map/lax combinators — telemetry at host boundaries only
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
 
