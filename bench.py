@@ -408,7 +408,167 @@ def obs_overhead_cell() -> dict:
     }
 
 
+def precision_cell() -> dict:
+    """Precision/fused-update bench cell (ISSUE 10): the SAME shipped
+    FedAvg round program timed under three train-step configurations —
+    ``fp32`` (the legacy tree bitwise), ``bf16_mixed`` (bf16 compute +
+    activations, f32 master weights — core/optim.py), and ``bf16_mixed``
+    with the fused mask/clip/momentum/update tail
+    (``--fused_update``, ops/fused_update.py) — plus a compile-time
+    peak-memory estimate per leg (XLA's ``memory_analysis`` temp/argument
+    bytes: the activation working set the remat policy trades against)
+    and the parity numbers the tolerance pins state (bf16-vs-fp32 loss
+    delta; fused-vs-unfused bitwise flag on this backend).
+
+    Env: BENCH_PRECISION=1 arms this cell (main() prints ONLY it);
+    BENCH_BATCH / BENCH_LOCAL / BENCH_SHAPE / BENCH_MODEL / BENCH_REMAT /
+    BENCH_REPS size it. On the CPU harness the WALL numbers are smoke —
+    the honest caveat rides the payload; the real fp32-vs-bf16 step
+    ratio and the fused kernel's on-chip win are next-TPU-session
+    measurements (scripts/run_precision_bench.sh is the entry point)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.optim import compute_dtype
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import FederatedData
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+    n_local = int(os.environ.get("BENCH_LOCAL", 16))
+    n_clients = int(os.environ.get("BENCH_CLIENTS", 2))
+    reps = max(1, int(os.environ.get("BENCH_REPS", 3)))
+    shape = tuple(int(s) for s in
+                  os.environ.get("BENCH_SHAPE", "12,14,12").split(","))
+    model_name = os.environ.get("BENCH_MODEL", "3dcnn_tiny")
+    remat_env = os.environ.get("BENCH_REMAT", "0")
+    remat: bool | str | None = {"0": False, "1": True}.get(remat_env,
+                                                           remat_env)
+    steps = -(-n_local // batch)
+
+    kx, ky = jax.random.split(jax.random.key(11))
+    X = jax.random.randint(kx, (n_clients, n_local) + shape, 0, 255,
+                           dtype=jnp.int32).astype(jnp.uint8)
+    y = jax.random.randint(ky, (n_clients, n_local), 0, 2, dtype=jnp.int32)
+    n = jnp.full((n_clients,), n_local, jnp.int32)
+    fed = FederatedData(X_train=X, y_train=y, n_train=n,
+                        X_test=X[:, :4], y_test=y[:, :4],
+                        n_test=jnp.full((n_clients,), 4, jnp.int32))
+    log = ExperimentLogger("/tmp/nidt_bench", "synthetic", "precision_cell",
+                           console=False)
+
+    LEGS = (("fp32", "fp32", False),
+            ("bf16_mixed", "bf16_mixed", False),
+            ("bf16_mixed_fused", "bf16_mixed", True),
+            ("fp32_fused", "fp32", True))
+
+    legs: dict[str, dict] = {}
+    end_params: dict[str, object] = {}
+    end_loss: dict[str, float] = {}
+    for leg_name, precision, fused in LEGS:
+        optim = OptimConfig(lr=1e-3, batch_size=batch, epochs=1,
+                            precision=precision, fused_update=fused)
+        cfg = ExperimentConfig(
+            model=model_name, num_classes=1, algorithm="fedavg",
+            data=DataConfig(dataset="synthetic"), optim=optim,
+            fed=FedConfig(client_num_in_total=n_clients, comm_round=1,
+                          frequency_of_the_test=10 ** 9),
+            log_dir="/tmp/nidt_bench", tag=f"prec-{leg_name}")
+        trainer = LocalTrainer(
+            create_model(model_name, num_classes=1,
+                         dtype=compute_dtype(precision), remat=remat),
+            optim, num_classes=1)
+        eng = create_engine("fedavg", cfg, fed, trainer, logger=log)
+        eng._donate = False  # legs replay one state through the program
+        gs = eng.init_global_state()
+        sampled = jnp.asarray(eng.client_sampling(0))
+        rngs = eng.per_client_rngs(0, np.arange(n_clients))
+        lr = eng.round_lr(0)
+
+        def run(e=eng, g=gs, s=sampled, r=rngs, lr=lr):
+            out = e._round_jit(g.params, g.batch_stats, e.data, s, r, lr)
+            jax.block_until_ready(out[0])
+            return out
+
+        out = run()  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = run()
+            best = min(best, time.perf_counter() - t0)
+        end_params[leg_name] = out[0]
+        end_loss[leg_name] = float(out[2])
+
+        # compile-time peak-memory estimate: XLA's own accounting of the
+        # program's temp (activation working set) + argument bytes — the
+        # number the remat policy trades against; device memory_stats()
+        # replaces it with a MEASURED peak on TPU sessions
+        mem = None
+        try:
+            compiled = eng._round_jit.lower(
+                gs.params, gs.batch_stats, eng.data, sampled, rngs,
+                lr).compile()
+            ma = compiled.memory_analysis()
+            mem = {
+                "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+                "argument_bytes": int(
+                    getattr(ma, "argument_size_in_bytes", 0)),
+                "output_bytes": int(
+                    getattr(ma, "output_size_in_bytes", 0)),
+            }
+        except Exception:  # memory_analysis is backend-best-effort
+            mem = None
+        samples = n_clients * steps * batch
+        legs[leg_name] = {
+            "round_s": round(best, 4),
+            "samples_per_sec": round(samples / best, 2),
+            "memory_analysis": mem,
+        }
+
+    bitwise = lambda a, b: bool(all(
+        np.array_equal(np.asarray(x), np.asarray(yv))
+        for x, yv in zip(jax.tree.leaves(a), jax.tree.leaves(b))))
+    max_delta = lambda a, b: float(max(
+        float(jnp.max(jnp.abs(x - yv)))
+        for x, yv in zip(jax.tree.leaves(a), jax.tree.leaves(b))))
+    return {
+        "metric": "precision_bench",
+        "model": model_name, "shape": "x".join(map(str, shape)),
+        "batch": batch, "clients": n_clients, "n_local": n_local,
+        "remat": str(remat),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "legs": legs,
+        "parity": {
+            "fp32_fused_bitwise_equals_fp32": bitwise(
+                end_params["fp32"], end_params["fp32_fused"]),
+            "bf16_fused_bitwise_equals_bf16": bitwise(
+                end_params["bf16_mixed"], end_params["bf16_mixed_fused"]),
+            "bf16_vs_fp32_loss_abs_delta": round(
+                abs(end_loss["bf16_mixed"] - end_loss["fp32"]), 6),
+            "bf16_vs_fp32_param_max_abs_delta": round(max_delta(
+                end_params["fp32"], end_params["bf16_mixed"]), 8),
+        },
+        "timing": f"best of {reps} repeats, one shipped FedAvg round",
+        "caveat": ("CPU-harness smoke numbers when run off-TPU: the "
+                   "parity columns and the memory_analysis estimates are "
+                   "the stable claims; the fp32-vs-bf16 step ratio, the "
+                   "fused kernel's HBM win, and the measured peak-HBM "
+                   "are TPU-session measurements "
+                   "(scripts/run_precision_bench.sh)"),
+    }
+
+
 def main() -> None:
+    if os.environ.get("BENCH_PRECISION", "0") == "1":
+        # standalone cell (ISSUE 10): one JSON line, no flagship phases
+        print(json.dumps(precision_cell()))
+        return
     if os.environ.get("BENCH_OBS_OVERHEAD", "0") == "1":
         # standalone cell (ISSUE 9): one JSON line, no flagship phases
         print(json.dumps(obs_overhead_cell()))
@@ -477,8 +637,25 @@ def main() -> None:
 
     remat_env = os.environ.get("BENCH_REMAT", "0")
     remat: bool | str = {"0": False, "1": True}.get(remat_env, remat_env)
+    # BENCH_DTYPE: the flagship cell's historical default is bf16 compute
+    # (the TPU-native posture since round 1); fp32 makes the cell the
+    # precision bench's control leg. Recorded in the payload alongside
+    # remat/fused_update so artifacts from different precision configs
+    # are no longer indistinguishable (ISSUE 10 satellite).
+    bench_dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    fused_env = os.environ.get("BENCH_FUSED", "0") == "1"
+    if fused_env:
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, optim=_dc.replace(cfg.optim,
+                                                 fused_update=True))
+    _dtypes = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+    if bench_dtype not in _dtypes:
+        raise SystemExit(f"BENCH_DTYPE={bench_dtype!r}: choose one of "
+                         f"{sorted(_dtypes)}")
     model = create_model(os.environ.get("BENCH_MODEL", "3DCNN"),
-                         num_classes=1, dtype=jnp.bfloat16, remat=remat)
+                         num_classes=1, dtype=_dtypes[bench_dtype],
+                         remat=remat)
     trainer = LocalTrainer(model, cfg.optim, num_classes=1)
     log = ExperimentLogger("/tmp/nidt_bench", "synthetic", cfg.identity(),
                            console=False)
@@ -840,6 +1017,11 @@ def main() -> None:
                               round(sps / V100_BASELINE_LOW, 3)],
         "gflops_per_sample": round(flops_per_sample / 1e9, 2),
         "sustained_tflops": round(sustained / 1e12, 2),
+        # precision provenance (ISSUE 10 satellite): artifacts from
+        # different precision configs must be distinguishable
+        "dtype": bench_dtype,
+        "remat": str(remat),
+        "fused_update": fused_env,
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
         "peak_tflops_assumed": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,

@@ -234,6 +234,29 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     # 3D-model rematerialization policy (PROFILE.md)
     parser.add_argument("--remat", type=str, default="auto",
                         help="auto | none | stem | all")
+    # mixed-precision train step (ISSUE 10, core/optim.py)
+    parser.add_argument("--precision", type=str, default="fp32",
+                        choices=("fp32", "bf16_mixed"),
+                        help="train-step compute dtype: fp32 (bitwise-"
+                             "identical to the legacy tree) | bf16_mixed "
+                             "(bf16 compute/activations, fp32 MASTER "
+                             "weights + momentum + loss; checkpoints and "
+                             "every aggregation/codec/secure plane see "
+                             "only the fp32 master weights)")
+    parser.add_argument("--loss_scale", type=float, default=1.0,
+                        help="fixed loss-scale constant for bf16_mixed "
+                             "(static scaling: loss * S before grad, "
+                             "f32 grads / S after); 1.0 = off — the "
+                             "pinned default, since bf16 keeps f32's "
+                             "exponent range. Rejected under fp32")
+    parser.add_argument("--fused_update", action="store_true",
+                        help="fuse the SGD tail (global-norm clip + "
+                             "weight decay + momentum + lr update + "
+                             "sparse-mask re-apply) into one Pallas "
+                             "pass over the params "
+                             "(ops/fused_update.py; XLA fallback off-"
+                             "TPU, bit-parity with the unfused chain "
+                             "pinned). SGD only")
     # synthetic data knobs (tests / demos without the private cohort)
     parser.add_argument("--synthetic_num_subjects", type=int, default=256)
     parser.add_argument("--synthetic_shape", type=int, nargs=3,
@@ -349,7 +372,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             client_optimizer=args.client_optimizer, lr=args.lr,
             lr_decay=args.lr_decay, wd=args.wd, momentum=args.momentum,
             batch_size=args.batch_size, epochs=args.epochs,
-            batch_order=args.batch_order),
+            batch_order=args.batch_order,
+            precision=args.precision, loss_scale=args.loss_scale,
+            fused_update=args.fused_update),
         fed=FedConfig(
             client_num_in_total=args.client_num_in_total, frac=args.frac,
             comm_round=args.comm_round, cs=args.cs, active=args.active,
@@ -502,7 +527,14 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
         remat = False if per_dev * cfg.optim.batch_size <= 128 else "stem"
     else:
         remat = {"none": False, "stem": "stem", "all": True}[cfg.remat]
-    model = create_model(cfg.model, num_classes=cfg.num_classes, remat=remat)
+    # precision contract (ISSUE 10): the model's flax dtype IS the
+    # compute precision; master weights stay f32 (flax param_dtype
+    # default), so every plane outside the jitted step — aggregation,
+    # codec, secure, checkpoints — sees float32 regardless
+    from neuroimagedisttraining_tpu.core.optim import compute_dtype
+
+    model = create_model(cfg.model, num_classes=cfg.num_classes, remat=remat,
+                         dtype=compute_dtype(cfg.optim.precision))
     trainer = LocalTrainer(model, cfg.optim, num_classes=cfg.num_classes)
     return create_engine(cfg.algorithm, cfg, fed, trainer, mesh=mesh,
                          logger=log, stream=stream)
@@ -537,6 +569,19 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--dp_sigma needs --dp_clip > 0 (the clip bound is "
                      "the sensitivity the noise multiplier is stated "
                      "against)")
+    # precision-contract conflicts die AT ARGPARSE with the resolution
+    # named (core/optim.validate_precision re-checks at trainer build)
+    if args.loss_scale != 1.0 and args.precision != "bf16_mixed":
+        parser.error(
+            f"--loss_scale {args.loss_scale} needs --precision "
+            "bf16_mixed: under fp32 the scale/unscale pair would only "
+            "perturb rounding and break the bitwise-f32 contract")
+    if args.fused_update and args.client_optimizer != "sgd":
+        parser.error(
+            "--fused_update fuses the SGD clip/momentum/update tail "
+            f"(ops/fused_update.py); --client_optimizer "
+            f"{args.client_optimizer} has no fused kernel and would "
+            "silently train un-fused")
     if args.dp_sigma > 0 or args.dp_clip > 0:
         # one source of truth: the same supports_dp attribute the
         # engine ctor gates on (an engine gaining the transform later

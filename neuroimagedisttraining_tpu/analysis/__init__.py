@@ -36,6 +36,7 @@ from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
     lock_discipline,
     mesh_discipline,
     obs_discipline,
+    precision_discipline,
     privacy_discipline,
     trace_safety,
 )

@@ -1,0 +1,111 @@
+"""precision-discipline rules: no stray f32 upcasts in the train step.
+
+The mixed-precision contract (ISSUE 10, core/optim.py) is load-bearing
+arithmetic, not a style choice: under ``precision=bf16_mixed`` the train
+step's compute and activations are bfloat16 while the master weights,
+momentum, and loss stay float32. A bare ``.astype(jnp.float32)`` (or
+``jnp.asarray(x, jnp.float32)`` / ``jnp.float32(x)``) inside a TRACED
+train-step body silently re-widens an activation mid-step: the bf16
+model quietly pays f32 HBM traffic for that tensor on every step, the
+bench's precision cells stop measuring what they claim, and nothing
+fails — the classic mixed-precision regression.
+
+The rule rides the trace-safety resolver (``collect_traced``: decorated
+jits, functions handed to tracers, lambdas, self-methods, transitive
+call closure) and fires for files under the train-step planes —
+``core/``, ``ops/``, ``models/`` — where the contract lives. The
+engines' aggregation tails are deliberately OUT of scope: they operate
+on f32 master weights by contract, so their ``astype(jnp.float32)``
+weight/summary vectors are the blessed representation, not an upcast.
+
+Blessed sites inside the scope (the input-quantization raw cast, loss
+weights, f32 histogram bins) carry ``# nidt: allow[precision-upcast] --
+reason`` pragmas — the escape hatch the contract names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from neuroimagedisttraining_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    normalize,
+    register,
+)
+from neuroimagedisttraining_tpu.analysis.trace_safety import collect_traced
+
+#: canonical dotted names that denote the float32 dtype
+F32_DOTTED = {"jax.numpy.float32", "numpy.float32"}
+
+#: cast-shaped callables whose dtype argument we inspect
+CAST_DOTTED = {"jax.numpy.asarray", "jax.numpy.array", "numpy.asarray",
+               "numpy.array"}
+
+
+def _is_f32(node: ast.AST | None, aliases: dict[str, str]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Constant):
+        return node.value == "float32"
+    return normalize(dotted_name(node), aliases) in F32_DOTTED
+
+
+@register
+class PrecisionDisciplineRule(Rule):
+    rule_ids = ("precision-upcast",)
+    description = (
+        "no bare float32 upcasts (.astype(jnp.float32), jnp.asarray(x, "
+        "jnp.float32), jnp.float32(x)) inside traced train-step bodies "
+        "under core/, ops/, models/ — the bf16_mixed contract keeps "
+        "compute in the model dtype; blessed master-weight/loss sites "
+        "carry a precision-upcast pragma")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not {"core", "ops", "models"} & set(mod.path_parts):
+            return
+        seen: set[int] = set()
+        for root in collect_traced(mod):
+            for node in ast.walk(root):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                seen.add(id(node))
+                yield from self._check_call(mod, node)
+
+    def _check_call(self, mod: ModuleInfo,
+                    node: ast.Call) -> Iterator[Finding]:
+        aliases = mod.aliases
+        func = node.func
+        # x.astype(jnp.float32) / x.astype("float32")
+        if (isinstance(func, ast.Attribute) and func.attr == "astype"
+                and node.args and _is_f32(node.args[0], aliases)):
+            yield Finding(
+                mod.path, node.lineno, "precision-upcast",
+                ".astype(float32) inside a traced train-step body "
+                "re-widens a tensor regardless of the precision policy "
+                "— use the model/compute dtype, or pragma a blessed "
+                "master-weight/loss site")
+            return
+        name = normalize(dotted_name(func), aliases)
+        # jnp.float32(x) — scalar/array construction pinned to f32
+        if name in F32_DOTTED and node.args:
+            yield Finding(
+                mod.path, node.lineno, "precision-upcast",
+                f"{name}(...) inside a traced train-step body pins the "
+                "value to float32 regardless of the precision policy")
+            return
+        # jnp.asarray(x, jnp.float32) / dtype=jnp.float32
+        if name in CAST_DOTTED:
+            dtype_arg = node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dtype_arg = kw.value
+            if _is_f32(dtype_arg, aliases):
+                yield Finding(
+                    mod.path, node.lineno, "precision-upcast",
+                    f"{name}(..., float32) inside a traced train-step "
+                    "body is an unconditional f32 cast — thread the "
+                    "compute dtype, or pragma a blessed site")

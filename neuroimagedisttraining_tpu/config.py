@@ -35,6 +35,25 @@ class OptimConfig:
     # (reference DataLoader semantics, my_model_trainer.py:213);
     # "replacement": i.i.d. uniform draws per step (rounds 1-3 behavior)
     batch_order: str = "shuffle"
+    # Mixed-precision train-step contract (ISSUE 10, core/optim.py):
+    # "fp32" — everything float32, bitwise-identical to the pre-ISSUE-10
+    # tree; "bf16_mixed" — bf16 compute + activations (the model's
+    # flax ``dtype``), fp32 MASTER weights / momentum / loss (flax
+    # ``param_dtype`` stays float32, models cast logits back to f32).
+    # The FedAvg/codec/secure/checkpoint planes only ever see the fp32
+    # master weights — bf16 exists strictly inside the jitted step.
+    precision: str = "fp32"       # fp32 | bf16_mixed
+    # Fixed loss-scale constant for bf16_mixed (Frostig et al.'s static
+    # scaling; bf16's f32-sized exponent rarely needs it, so 1.0 is the
+    # pinned default — scale S is mathematically a no-op: loss * S
+    # before grad, grads / S after, both in fp32). Must be 1.0 under
+    # fp32 (any other value would break the bitwise-unchanged pin).
+    loss_scale: float = 1.0
+    # Fused mask-apply + clip + momentum + SGD-update tail
+    # (ops/fused_update.py): one Pallas pass over params instead of the
+    # unfused chain's per-stage HBM round-trips; XLA fallback off-TPU,
+    # bit-parity with the optax chain pinned. SGD only.
+    fused_update: bool = False
 
 
 @dataclass(frozen=True)
@@ -222,10 +241,11 @@ class ExperimentConfig:
     optim: OptimConfig = field(default_factory=OptimConfig)
     fed: FedConfig = field(default_factory=FedConfig)
     sparsity: SparsityConfig = field(default_factory=SparsityConfig)
-    # TPU execution
+    # TPU execution. Compute dtype is ``optim.precision`` (the old
+    # param_dtype/compute_dtype strings were dead config — nothing
+    # consumed them; the precision contract in core/optim.py replaces
+    # them with a single validated knob).
     mesh_shape: tuple[int, ...] = ()   # () => all visible devices on one "clients" axis
-    param_dtype: str = "float32"
-    compute_dtype: str = "bfloat16"
     remat: str = "auto"            # auto | none | stem | all — 3D-model
     # rematerialization policy (PROFILE.md); auto picks from samples
     # in flight per device (build_experiment)

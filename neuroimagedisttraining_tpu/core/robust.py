@@ -77,7 +77,7 @@ def norm_diff_clip(local_params, global_params, norm_bound):
     (robust_aggregation.py:38-49)."""
     diff = pt.tree_sub(local_params, global_params)
     norm = pt.tree_norm(diff)
-    scale = jnp.maximum(1.0, norm / jnp.float32(norm_bound))
+    scale = jnp.maximum(1.0, norm / jnp.float32(norm_bound))  # nidt: allow[precision-upcast] -- defense math runs on f32 master weights by contract (ARCHITECTURE.md Precision & memory)
     return pt.tree_add(global_params, pt.tree_scale(diff, 1.0 / scale))
 
 
@@ -87,7 +87,7 @@ def add_weak_dp_noise(params, rng, stddev):
     keys = jax.random.split(rng, len(leaves))
     noised = [
         (x + jax.random.normal(k, x.shape, jnp.float32)
-         * jnp.float32(stddev)).astype(x.dtype)
+         * jnp.float32(stddev)).astype(x.dtype)  # nidt: allow[precision-upcast] -- weak-DP noise is drawn in f32 against f32 master weights (reference parity, robust_aggregation.py:51-55)
         for x, k in zip(leaves, keys)
     ]
     return jax.tree.unflatten(treedef, noised)
@@ -129,7 +129,7 @@ def defend_stacked(stacked_params, global_params, *, defense: str,
 def finite_per_client(stacked) -> jax.Array:
     """[C] bool: client c's row is finite in EVERY leaf."""
     def per_client(tree):
-        flags = [jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+        flags = [jnp.all(jnp.isfinite(x.astype(jnp.float32)))  # nidt: allow[precision-upcast] -- finiteness guard must see exact f32 view of every upload leaf (int leaves included)
                  for x in jax.tree.leaves(tree)]
         return jnp.stack(flags).all() if flags else jnp.bool_(True)
 

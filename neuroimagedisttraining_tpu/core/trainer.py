@@ -32,7 +32,9 @@ import jax.numpy as jnp
 
 from neuroimagedisttraining_tpu.config import OptimConfig
 from neuroimagedisttraining_tpu.core.losses import make_loss, predictions
-from neuroimagedisttraining_tpu.core.optim import make_local_optimizer
+from neuroimagedisttraining_tpu.core.optim import (
+    make_local_optimizer, validate_precision,
+)
 from neuroimagedisttraining_tpu.models import primary_logits
 
 PyTree = Any
@@ -78,7 +80,7 @@ def shuffle_batch_indices(perms: jax.Array, t, steps_per_epoch: int,
     e = t // steps_per_epoch
     pos = (t % steps_per_epoch) * batch_size + jnp.arange(batch_size)
     idx = perms[e][pos % jnp.maximum(n_valid, 1)]
-    w = (pos < n_valid).astype(jnp.float32)
+    w = (pos < n_valid).astype(jnp.float32)  # nidt: allow[precision-upcast] -- loss weights are a blessed f32 loss site (the loss itself is f32 by contract)
     return idx, w
 
 
@@ -100,6 +102,16 @@ class LocalTrainer:
         self.optim_cfg = optim
         self.num_classes = num_classes
         self.loss = make_loss(num_classes)
+        # precision contract (ISSUE 10, core/optim.py): validated here so
+        # a bad precision/loss_scale/fused_update combination dies at
+        # trainer build, not at first trace. The model's compute dtype is
+        # chosen where the model is built (build_experiment passes
+        # compute_dtype(optim.precision)); the trainer owns the fixed
+        # loss-scale constant — a static multiply of the f32 loss before
+        # grad and an f32 divide of the grads after, skipped entirely at
+        # scale 1.0 so the default path stays bitwise-unchanged.
+        validate_precision(optim)
+        self._loss_scale = float(optim.loss_scale)
         self.opt = make_local_optimizer(optim)
         # Full input ndim (batch + spatial + channel) the model expects;
         # drives channel-dim completion in _prep. Declared per model family
@@ -123,7 +135,7 @@ class LocalTrainer:
         is exactly one rank short of the model's declared ``input_rank``
         (reference ``unsqueeze(1)``, my_model_trainer.py:216 — ours is
         channels-last)."""
-        x = x.astype(jnp.float32)
+        x = x.astype(jnp.float32)  # nidt: allow[precision-upcast] -- reference raw-cast parity (my_model_trainer.py:197-198): the uint8 input-quantization boundary, models re-cast to compute dtype
         if self._input_rank is not None and x.ndim == self._input_rank - 1:
             x = x[..., None]  # e.g. [B,D,H,W] -> [B,D,H,W,1]
         return x
@@ -143,6 +155,19 @@ class LocalTrainer:
 
     # ---------- training ----------
 
+    def _scaled(self, loss):
+        """Loss-scale multiply inside the grad function (bf16_mixed
+        static scaling); a literal no-op at the pinned scale 1.0."""
+        return loss * self._loss_scale if self._loss_scale != 1.0 else loss
+
+    def _unscaled(self, loss, grads):
+        """Invert the loss scale on the f32 loss/grads outside the grad
+        function; a literal no-op at scale 1.0 (bitwise-f32 contract)."""
+        if self._loss_scale == 1.0:
+            return loss, grads
+        inv = self._loss_scale
+        return loss / inv, jax.tree.map(lambda g: g / inv, grads)
+
     def loss_and_grad(self, cs: ClientState, x, y):
         """One batch's (loss, grads, new batch_stats); used directly by SNIP
         scoring and gradient probes as well as by ``local_train``."""
@@ -151,9 +176,10 @@ class LocalTrainer:
         def f(params):
             out, bstats = self._apply(params, cs.batch_stats, self._prep(x),
                                       train=True, dropout_rng=drng)
-            return self.loss(primary_logits(out), y), bstats
+            return self._scaled(self.loss(primary_logits(out), y)), bstats
 
         (loss, bstats), grads = jax.value_and_grad(f, has_aux=True)(cs.params)
+        loss, grads = self._unscaled(loss, grads)
         return loss, grads, bstats, rng
 
     def local_train(self, cs: ClientState, X, y, n_valid, lr, epochs: int,
@@ -224,15 +250,23 @@ class LocalTrainer:
                 out, bstats = self._apply(params, state.batch_stats,
                                           self._prep(xb), train=True,
                                           dropout_rng=drng)
-                return self.loss(primary_logits(out), yb, weights=wb), bstats
+                return self._scaled(
+                    self.loss(primary_logits(out), yb, weights=wb)), bstats
 
             (loss, bstats), grads = jax.value_and_grad(f, has_aux=True)(
                 state.params)
-            updates, opt_state = self.opt.update(grads, state.opt_state,
-                                                 state.params, lr)
-            params = jax.tree.map(jnp.add, state.params, updates)
-            if mask is not None:
-                params = jax.tree.map(jnp.multiply, params, mask)
+            loss, grads = self._unscaled(loss, grads)
+            if self.opt.fused_apply is not None:
+                # fused clip+wd+momentum+update+mask tail in one pass
+                # (ops/fused_update.py; bit-parity with the chain below)
+                params, opt_state = self.opt.fused_apply(
+                    grads, state.opt_state, state.params, lr, mask)
+            else:
+                updates, opt_state = self.opt.update(grads, state.opt_state,
+                                                     state.params, lr)
+                params = jax.tree.map(jnp.add, state.params, updates)
+                if mask is not None:
+                    params = jax.tree.map(jnp.multiply, params, mask)
             if prox_lamda is not None:
                 params = jax.tree.map(
                     lambda w, ref: w - lr * prox_lamda * (w - ref),
@@ -261,9 +295,12 @@ class LocalTrainer:
         (DisPFL/my_model_trainer.py:165-188, model.eval() + one batch)."""
         def f(p):
             out, _ = self._apply(p, batch_stats, self._prep(x), train=False)
-            return self.loss(primary_logits(out), y)
+            return self._scaled(self.loss(primary_logits(out), y))
 
-        return jax.grad(f)(params)
+        grads = jax.grad(f)(params)
+        if self._loss_scale != 1.0:
+            grads = jax.tree.map(lambda g: g / self._loss_scale, grads)
+        return grads
 
     # ---------- evaluation ----------
 

@@ -131,6 +131,34 @@ def _build_shard(args, rank: int):
     return X, y, len(idx)
 
 
+def _optim_from_args(args):
+    """One OptimConfig for every silo-side trainer in this process —
+    including the mixed-precision train-step contract (ISSUE 10), so a
+    cross-silo silo trains at the same precision the simulated engines
+    would (fp32 master weights on the wire either way)."""
+    from neuroimagedisttraining_tpu.config import OptimConfig
+
+    return OptimConfig(lr=args.lr, lr_decay=args.lr_decay,
+                       batch_size=args.batch_size, epochs=args.epochs,
+                       precision=args.precision,
+                       loss_scale=args.loss_scale,
+                       fused_update=args.fused_update)
+
+
+def _create_model_from_args(args):
+    """Model build honoring the precision contract (compute dtype from
+    --precision; master weights stay f32) and the --remat policy ("auto"
+    defers to the model family's default — the single-silo runner has no
+    federation shape to pick from)."""
+    from neuroimagedisttraining_tpu.core.optim import compute_dtype
+    from neuroimagedisttraining_tpu.models import create_model
+
+    remat = {"auto": None, "none": False, "stem": "stem",
+             "all": True}[args.remat]
+    return create_model(args.model, num_classes=args.num_classes,
+                        dtype=compute_dtype(args.precision), remat=remat)
+
+
 def _seed_init_state(args):
     """``(trainer, init ClientState)`` — every rank derives the identical
     model from ``--seed``, so init broadcast, delta references, and wire
@@ -138,13 +166,11 @@ def _seed_init_state(args):
     import jax
     import jax.numpy as jnp
 
-    from neuroimagedisttraining_tpu.config import OptimConfig
     from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
-    from neuroimagedisttraining_tpu.models import create_model
 
     trainer = LocalTrainer(
-        create_model(args.model, num_classes=args.num_classes),
-        OptimConfig(), num_classes=args.num_classes)
+        _create_model_from_args(args),
+        _optim_from_args(args), num_classes=args.num_classes)
     if args.dataset == "synthetic":
         shape = (1,) + tuple(args.synthetic_shape)
     else:
@@ -193,15 +219,11 @@ def _make_train_fn(args):
     import jax
     import jax.numpy as jnp
 
-    from neuroimagedisttraining_tpu.config import OptimConfig
     from neuroimagedisttraining_tpu.core.trainer import ClientState, LocalTrainer
-    from neuroimagedisttraining_tpu.models import create_model
 
     X, y, n = _build_shard(args, args.rank)
-    optim = OptimConfig(lr=args.lr, lr_decay=args.lr_decay,
-                        batch_size=args.batch_size, epochs=args.epochs)
-    trainer = LocalTrainer(create_model(args.model,
-                                        num_classes=args.num_classes),
+    optim = _optim_from_args(args)
+    trainer = LocalTrainer(_create_model_from_args(args),
                            optim, num_classes=args.num_classes)
     wire_masks = None
     if args.wire_mask_density > 0:
@@ -470,6 +492,23 @@ def main(argv=None) -> int:
     ap.add_argument("--epochs", type=int, default=1)
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--lr_decay", type=float, default=0.998)
+    # mixed-precision train step (ISSUE 10) — mirrors the simulated
+    # CLI's contract; the wire always carries fp32 master weights
+    ap.add_argument("--precision", type=str, default="fp32",
+                    choices=("fp32", "bf16_mixed"),
+                    help="silo train-step compute dtype; master weights "
+                         "(what the wire/codec/secure planes ship) stay "
+                         "float32 either way (core/optim.py)")
+    ap.add_argument("--loss_scale", type=float, default=1.0,
+                    help="fixed loss-scale constant (bf16_mixed only; "
+                         "1.0 = off)")
+    ap.add_argument("--fused_update", action="store_true",
+                    help="fused SGD clip/momentum/update/mask tail "
+                         "(ops/fused_update.py; XLA fallback off-TPU)")
+    ap.add_argument("--remat", type=str, default="auto",
+                    choices=("auto", "none", "stem", "all"),
+                    help="3D-model rematerialization policy (auto = "
+                         "model-family default; PROFILE.md)")
     ap.add_argument("--seed", type=int, default=1024)
     ap.add_argument("--force_cpu", action="store_true",
                     help="pin JAX to the CPU backend (e.g. several silo "

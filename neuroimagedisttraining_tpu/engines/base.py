@@ -72,6 +72,14 @@ class FederatedEngine:
     #: others must reject the flags loudly instead of silently training
     #: without the noise the accountant would be charging for
     supports_dp = False
+    #: engines whose STREAMING driver can run fused K-round windows
+    #: (ISSUE 10): the window's shards are prefetched as one [K, S, ...]
+    #: stack (data/stream.py prefetch_window) and the scanned round body
+    #: consumes one round per step — window k+1's host read + device_put
+    #: ride behind window k's scan. Others keep the round-granular
+    #: streamed feed and collapse to K=1 with the logged streaming
+    #: reason.
+    supports_fused_streaming = False
 
     def __init__(self, cfg: ExperimentConfig, fed_data: FederatedData | None,
                  trainer: LocalTrainer, mesh=None,
@@ -540,9 +548,13 @@ class FederatedEngine:
 
     def _resident_fallback_reason(self) -> str | None:
         """The fallback conditions shared by every engine that HAS a
-        fused round body (FedAvg-shaped overrides delegate here):
-        streaming and the wire codec both cross the host every round."""
-        if self.stream is not None:
+        fused round body (FedAvg-shaped overrides delegate here): the
+        wire codec crosses the host every round, and streaming does too
+        UNLESS the engine's streamed driver fuses at window granularity
+        (``supports_fused_streaming``, ISSUE 10 — the window's shards
+        prefetch as one stack, so the host crossing moves to the window
+        boundary the hooks already own)."""
+        if self.stream is not None and not self.supports_fused_streaming:
             return "streaming rounds cross the host for data every round"
         if self.wire_spec is not None:
             return ("--wire_codec accounts encoded bytes on the host "
@@ -582,6 +594,37 @@ class FederatedEngine:
             byz = tuple(jnp.stack([p[i] for p in plans])
                         for i in range(4))
         return sampled, idx, rngs, lrs, byz, k, n_real
+
+    def _window_stream_inputs(self, round_idx: int, k: int):
+        """Host prologue of a fused STREAMED window (ISSUE 10): the
+        per-round cohorts (``_window_sampling`` — may shrink ``k`` to an
+        equal-size prefix), each round's mesh-tiling padded id set
+        (``stream_sampling`` — pads train as zero-weight no-ops exactly
+        like the round-granular feed), the stacked per-round rngs/lrs
+        over the PADDED ids (what the streamed round body consumes), and
+        the [K, P]-stacked byz plan over the padded ids (the streamed
+        per-round driver's contract). Returns
+        ``(ids_per_round, rngs, lrs, byz, k, n_real)``."""
+        sampled, k = self._window_sampling(round_idx, k)
+        padded = [self.stream_sampling(round_idx + off, sampled=s)
+                  for off, s in enumerate(sampled)]
+        ids_per_round = [p[0] for p in padded]
+        n_real = padded[0][1]
+        for off, s in enumerate(sampled):
+            self.log.info("################ round %d (stream): clients %s "
+                          "(fused window of %d)", round_idx + off,
+                          s.tolist(), k)
+        rngs = jnp.stack([self.per_client_rngs(round_idx + off, ids)
+                          for off, ids in enumerate(ids_per_round)])
+        lrs = jnp.asarray([self.round_lr(round_idx + off)
+                           for off in range(k)], jnp.float32)
+        byz = None
+        if self._byz_on():
+            plans = [self._byz_round_plan(round_idx + off, ids)
+                     for off, ids in enumerate(ids_per_round)]
+            byz = tuple(jnp.stack([p[i] for p in plans])
+                        for i in range(4))
+        return ids_per_round, rngs, lrs, byz, k, n_real
 
     # ---------- cohort sharding (--client_mesh, ISSUE 6) ----------
 

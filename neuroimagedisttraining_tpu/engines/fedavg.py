@@ -38,6 +38,8 @@ class FedAvgEngine(FederatedEngine):
     # adversary transform when the schedule carries byz: value faults
     supports_cohort_sharding = True  # _round_body's local-train stage
     # runs under the --client_mesh shard_map (ISSUE 6)
+    supports_fused_streaming = True  # the streamed driver fuses K-round
+    # windows over one prefetched [K, S, ...] shard stack (ISSUE 10)
     supported_defenses = robust.DEFENSES
 
     def _prox_kwargs(self, global_params) -> dict:
@@ -286,6 +288,84 @@ class FedAvgEngine(FederatedEngine):
         self._note_nonfinite(bads)
         return params, bstats, losses[-1], k
 
+    def _fused_round_stream_jit(self, k: int):
+        """K STREAMED rounds as one dispatched program (ISSUE 10): a
+        ``lax.scan`` over the exact streamed per-round body, consuming
+        the window's prefetched ``[K, S, nmax, ...]`` shard stacks one
+        round per step — the window-granular analog of
+        ``_fused_round_jit`` for cohorts that live on the host. The
+        carried {params, bstats} are donated like every round program's;
+        the uint8/int32 shard stacks are NOT — no output shares their
+        dtype/shape, so the donation would be unusable (XLA warns and
+        ignores it) and the buffers die at end of dispatch anyway."""
+        def build():
+            def fused_stream_fn(params, bstats, Xs, ys, ns, rngs, lrs,
+                                byz=None):
+                def one_round(carry, xs):
+                    p, b = carry
+                    if byz is None:
+                        (X, y, n, rg, lr), bz = xs, None
+                    else:
+                        X, y, n, rg, lr, bz = xs
+                    p, b, loss, bad = self._round_body(p, b, X, y, n, rg,
+                                                       lr, byz=bz)
+                    return (p, b), (loss, bad)
+
+                xs = ((Xs, ys, ns, rngs, lrs) if byz is None
+                      else (Xs, ys, ns, rngs, lrs, byz))
+                (params, bstats), (losses, bads) = jax.lax.scan(
+                    one_round, (params, bstats), xs)
+                return params, bstats, losses, bads
+
+            return jax.jit(fused_stream_fn,
+                           donate_argnums=self._donate_argnums(0, 1))
+
+        return self._plan_cached("_fused_round_stream_jit_cache", k, build)
+
+    def _stream_prefetch_for(self, round_idx: int) -> None:
+        """Kick off the streamed feed for whatever the driver will
+        dispatch AT ``round_idx``: the whole fused window's shard stack
+        when the fused streamed driver is armed and the window planner
+        gives more than one round, the single round's shards otherwise.
+        The key-matching get (``get_window``/``get_train``) re-derives
+        the identical ids — sampling is deterministic in the round
+        index — so a planner disagreement degrades to a fresh fetch,
+        never a stale serve."""
+        if round_idx >= self.cfg.fed.comm_round:
+            return
+        fuse = (self.cfg.fed.rounds_per_dispatch > 1
+                and self.fused_fallback_reason() is None)
+        if fuse:
+            k = self._dispatch_window(round_idx)
+            if k > 1:
+                sampled, k = self._window_sampling(round_idx, k)
+                pads = [self.stream_sampling(round_idx + off, sampled=s)
+                        for off, s in enumerate(sampled)]
+                self.stream.prefetch_window([p[0] for p in pads],
+                                            pads[0][1])
+                return
+        self.stream.prefetch_train(*self.stream_sampling(round_idx))
+
+    def _run_fused_stream_window(self, params, bstats, round_idx: int,
+                                 k: int):
+        """Dispatch streamed rounds ``[round_idx, round_idx + k)`` as one
+        scan over the prefetched window stack, then immediately queue the
+        NEXT window's host read + device transfer behind this window's
+        compute (the dispatch returns asynchronously; the boundary hooks
+        block later). Returns ``(params, bstats, last_round_loss,
+        k_actual)``."""
+        with obs_trace.span("window", round=round_idx, k=k, stream=True):
+            with obs_trace.span("window_host_prologue", round=round_idx):
+                (ids_per_round, rngs, lrs, byz, k,
+                 n_real) = self._window_stream_inputs(round_idx, k)
+                Xs, ys, ns = self.stream.get_window(ids_per_round, n_real)
+                self._stream_prefetch_for(round_idx + k)
+            with obs_trace.span("dispatch", round=round_idx, k=k):
+                params, bstats, losses, bads = self._fused_round_stream_jit(
+                    k)(params, bstats, Xs, ys, ns, rngs, lrs, byz)
+        self._note_nonfinite(bads)
+        return params, bstats, losses[-1], k
+
     def _finetune_body(self, params, bstats, X, y, n, rngs, lr):
         """Per-client fine-tune from the aggregated model over a block of
         clients (fedavg_api.py:79-88) — produces personalized models."""
@@ -461,27 +541,41 @@ class FedAvgEngine(FederatedEngine):
             gs = self.init_global_state()
             params, bstats = gs.params, gs.batch_stats
             history = []
-        self.stream.prefetch_train(*self.stream_sampling(start))
-        for round_idx in range(start, cfg.fed.comm_round):
-            fed_ids, n_real = self.stream_sampling(round_idx)
-            self.log.info("################ round %d (stream): clients %s",
-                          round_idx, fed_ids[:n_real].tolist())
-            Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
-            if round_idx + 1 < cfg.fed.comm_round:
-                # overlap next round's host read with this round's compute
-                self.stream.prefetch_train(
-                    *self.stream_sampling(round_idx + 1))
-            rngs = self.per_client_rngs(round_idx, fed_ids)
-            byz = self._byz_round_plan(round_idx, fed_ids)
-            if byz is not None:
-                params, bstats, loss, n_bad = self._round_stream_jit(
-                    params, bstats, Xs, ys, ns, rngs,
-                    self.round_lr(round_idx), None, byz)
+        # fused streamed windows (ISSUE 10): when the window planner can
+        # fuse, whole K-round shard stacks are prefetched behind the
+        # previous window's scan; hook rounds land on window boundaries
+        # exactly as in the resident fused driver, so observable
+        # behavior matches the round-granular loop
+        fuse = (cfg.fed.rounds_per_dispatch > 1
+                and self.fused_fallback_reason() is None)
+        self._stream_prefetch_for(start)
+        round_idx = start
+        while round_idx < cfg.fed.comm_round:
+            k = self._dispatch_window(round_idx) if fuse else 1
+            if k > 1:
+                params, bstats, loss, k = self._run_fused_stream_window(
+                    params, bstats, round_idx, k)
+                round_idx += k - 1  # hooks below fire for the boundary
             else:
-                params, bstats, loss, n_bad = self._round_stream_jit(
-                    params, bstats, Xs, ys, ns, rngs,
-                    self.round_lr(round_idx))
-            self._note_nonfinite(n_bad)
+                fed_ids, n_real = self.stream_sampling(round_idx)
+                self.log.info("################ round %d (stream): "
+                              "clients %s", round_idx,
+                              fed_ids[:n_real].tolist())
+                Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
+                # overlap the next dispatch's host read (single round or
+                # whole window) with this round's compute
+                self._stream_prefetch_for(round_idx + 1)
+                rngs = self.per_client_rngs(round_idx, fed_ids)
+                byz = self._byz_round_plan(round_idx, fed_ids)
+                if byz is not None:
+                    params, bstats, loss, n_bad = self._round_stream_jit(
+                        params, bstats, Xs, ys, ns, rngs,
+                        self.round_lr(round_idx), None, byz)
+                else:
+                    params, bstats, loss, n_bad = self._round_stream_jit(
+                        params, bstats, Xs, ys, ns, rngs,
+                        self.round_lr(round_idx))
+                self._note_nonfinite(n_bad)
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 m = self.eval_global_stream(params, bstats)
@@ -492,6 +586,7 @@ class FedAvgEngine(FederatedEngine):
                                 "train_loss": float(loss), **m})
             self.maybe_checkpoint(round_idx, {
                 "params": params, "batch_stats": bstats, "history": history})
+            round_idx += 1
         self._flush_nonfinite(cfg.fed.comm_round - 1)
         # final fine-tune: chunked over client blocks; personalized models
         # are evaluated per block then discarded (they'd exceed HBM)

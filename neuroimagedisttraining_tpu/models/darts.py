@@ -776,7 +776,7 @@ class DartsTrainer:
         # the drop probability beyond the configured max (keep_prob -> 0
         # would NaN the activations)
         frac = jnp.minimum(
-            state["step"].astype(jnp.float32) / self.total_steps, 1.0)
+            state["step"].astype(jnp.float32) / self.total_steps, 1.0)  # nidt: allow[precision-upcast] -- int step counter to f32 schedule fraction, not an activation
         dpp = self.drop_path_prob * frac
 
         def loss_fn(params):

@@ -42,7 +42,7 @@ def _count_ge_kernel(x_ref, thr_ref, out_ref):
         sl = pl.dslice(j * _BIN_CHUNK, _BIN_CHUNK)
         thr_chunk = thr_ref[0, sl]                               # [C]
         cmp = x[:, :, None] >= thr_chunk[None, None, :]          # [R,128,C]
-        partial = jnp.sum(cmp.astype(jnp.float32), axis=(0, 1))  # [C]
+        partial = jnp.sum(cmp.astype(jnp.float32), axis=(0, 1))  # [C]  # nidt: allow[precision-upcast] -- histogram COUNTS accumulate in f32 on the VPU (exactness of the bracket, not an activation)
         out_ref[0, sl] = out_ref[0, sl] + partial
         return 0
 
@@ -74,7 +74,7 @@ def _count_ge_pallas(x2d: jax.Array, thresholds: jax.Array) -> jax.Array:
 def _count_ge_xla(x2d: jax.Array, thresholds: jax.Array) -> jax.Array:
     def chunk_counts(thr_chunk):
         return jnp.sum((x2d[None, :, :] >= thr_chunk[:, None, None])
-                       .astype(jnp.float32), axis=(1, 2))
+                       .astype(jnp.float32), axis=(1, 2))  # nidt: allow[precision-upcast] -- histogram counts in f32, XLA fallback mirrors the kernel bitwise
 
     chunks = thresholds.reshape(-1, _BIN_CHUNK // 2)
     return jax.lax.map(chunk_counts, chunks).reshape(-1)
@@ -86,7 +86,7 @@ def _pad_to_blocks(x: jax.Array) -> jax.Array:
     padded = ((n + per_block - 1) // per_block) * per_block
     fill = jnp.finfo(jnp.float32).min
     return jnp.concatenate(
-        [x.astype(jnp.float32),
+        [x.astype(jnp.float32),  # nidt: allow[precision-upcast] -- saliency scores compare in exact f32: the k-th-largest bracket is defined on the f32 value lattice
          jnp.full((padded - n,), fill, jnp.float32)]).reshape(-1, _LANES)
 
 
@@ -118,8 +118,8 @@ def kth_largest(x: jax.Array, k: int, rounds: int = 4, nbins: int = 512,
         use_pallas = jax.default_backend() == "tpu"
     count_ge = _count_ge_pallas if use_pallas else _count_ge_xla
     x2d = _pad_to_blocks(x)
-    lo = jnp.min(x).astype(jnp.float32)
-    hi = jnp.max(x).astype(jnp.float32)
+    lo = jnp.min(x).astype(jnp.float32)  # nidt: allow[precision-upcast] -- f32 bracket endpoints: the threshold IS an f32 value by contract
+    hi = jnp.max(x).astype(jnp.float32)  # nidt: allow[precision-upcast] -- f32 bracket endpoints: the threshold IS an f32 value by contract
 
     def round_fn(carry, _):
         lo, hi = carry
@@ -137,7 +137,7 @@ def kth_largest(x: jax.Array, k: int, rounds: int = 4, nbins: int = 512,
 
     (lo, hi), _ = jax.lax.scan(round_fn, (lo, hi), None, length=rounds)
     ok = jnp.all(jnp.isfinite(x))
-    return jnp.where(ok, lo, jnp.float32(jnp.nan))
+    return jnp.where(ok, lo, jnp.float32(jnp.nan))  # nidt: allow[precision-upcast] -- the NaN-poison sentinel is an f32 threshold by contract
 
 
 def topk_threshold_mask(x: jax.Array, k: int, **kw) -> tuple[jax.Array, jax.Array]:

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Precision / fused-update bench cell (ISSUE 10)
+#     -> bench_matrix/precision_bench.json
+#
+# Runs bench.py in its BENCH_PRECISION mode: the SAME shipped FedAvg
+# round program under fp32 / bf16_mixed / bf16_mixed+fused-update /
+# fp32+fused-update, with per-leg wall/step, XLA memory_analysis
+# temp-bytes (the activation working set the --remat policy trades
+# against), and the parity columns (fused-vs-unfused bitwise flags,
+# bf16-vs-fp32 loss/param deltas).
+#
+# On this CPU harness the WALL numbers are smoke — the parity columns and
+# memory estimates are the stable claims. NEXT TPU SESSION: this script
+# is the entry point for the real measurement (alongside --trace_out on a
+# training run, PROFILE.md round 9). On the chip run it at flagship
+# shape:
+#
+#   BENCH_MODEL=3DCNN BENCH_SHAPE=121,145,121 BENCH_BATCH=128 \
+#   BENCH_LOCAL=512 BENCH_CLIENTS=1 BENCH_REPS=3 \
+#   JAX_PLATFORMS='' scripts/run_precision_bench.sh
+#
+# and sweep BENCH_REMAT in {0, stem, 1} to read the remat-vs-batch
+# trade at bf16 (remat exists to buy batch > 128 on-chip — the bf16
+# activation halving moves that frontier).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_matrix
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    BENCH_PRECISION=1 \
+    BENCH_MODEL="${BENCH_MODEL:-3dcnn_tiny}" \
+    BENCH_SHAPE="${BENCH_SHAPE:-12,14,12}" \
+    BENCH_BATCH="${BENCH_BATCH:-8}" \
+    BENCH_LOCAL="${BENCH_LOCAL:-16}" \
+    BENCH_CLIENTS="${BENCH_CLIENTS:-2}" \
+    BENCH_REMAT="${BENCH_REMAT:-0}" \
+    BENCH_REPS="${BENCH_REPS:-3}" \
+    python bench.py | tee bench_matrix/precision_bench.json

@@ -36,7 +36,13 @@ fi
 # no clock reads (time.time/monotonic/perf_counter) and no metrics-
 # registry/flight/span mutation lexically inside functions handed to
 # jit/vmap/shard_map/lax combinators — telemetry at host boundaries only
-echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline) =="
+# the precision-discipline family (ISSUE 10) also rides the trace-safety
+# resolver: no bare float32 upcasts (.astype(jnp.float32) /
+# jnp.asarray(x, jnp.float32) / jnp.float32(x)) inside traced train-step
+# bodies under core/, ops/, models/ — the bf16_mixed contract keeps
+# compute in the model dtype; blessed master-weight/loss sites carry
+# justified precision-upcast pragmas
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline / precision-discipline) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
 

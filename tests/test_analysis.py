@@ -927,3 +927,86 @@ def test_obs_indexed_set_and_host_mutation_pass():
             obs_metrics.gauge("g").set(2)
         """, rules=["obs-metrics-in-trace"])
     assert fs == []
+
+
+# ---------------- precision-discipline (ISSUE 10) ----------------
+
+def test_precision_upcast_astype_in_traced_body_flagged():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x.astype(jnp.float32))
+        """, path="pkg/core/mod.py", rules=["precision-upcast"])
+    assert rules_of(fs) == ["precision-upcast"]
+    assert "re-widens" in fs[0].message
+
+
+def test_precision_upcast_asarray_and_constructor_flagged():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def f(xs):
+            return jax.vmap(lambda x: jnp.asarray(x, jnp.float32)
+                            + jnp.float32(2.0))(xs)
+        """, path="pkg/ops/mod.py", rules=["precision-upcast"])
+    assert sorted(rules_of(fs)) == ["precision-upcast", "precision-upcast"]
+
+
+def test_precision_upcast_transitive_callee_flagged():
+    """The rule rides the trace-safety resolver: an upcast in a helper
+    CALLED from a traced body is caught like a decorated one."""
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def widen(x):
+            return x.astype(jnp.float32)
+
+        @jax.jit
+        def step(x):
+            return widen(x) * 2
+        """, path="pkg/models/mod.py", rules=["precision-upcast"])
+    assert rules_of(fs) == ["precision-upcast"]
+
+
+def test_precision_upcast_out_of_scope_and_host_pass():
+    """engines/ aggregation tails (f32 master weights by contract) and
+    host-side code are out of the rule's reach; model-dtype casts pass."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def round_tail(w):
+            return w.astype(jnp.float32)
+
+        def host(x):
+            return x.astype(jnp.float32)
+        """
+    assert lint(src, path="pkg/engines/mod.py",
+                rules=["precision-upcast"]) == []
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, dtype):
+            return x.astype(dtype) + jnp.zeros((4,), jnp.float32)
+        """, path="pkg/core/mod.py", rules=["precision-upcast"])
+    assert fs == []  # threading a dtype / f32 zeros-construction are fine
+
+
+def test_precision_upcast_pragma_suppresses_with_reason():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return x.astype(jnp.float32)  # nidt: allow[precision-upcast] -- blessed loss site
+        """, path="pkg/core/mod.py", rules=["precision-upcast"])
+    assert fs == []

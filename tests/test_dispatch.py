@@ -281,8 +281,12 @@ def _log_text(eng) -> str:
 
 def test_streaming_falls_back_with_logged_reason(tmp_path,
                                                  synthetic_cohort):
-    eng = _engine(tmp_path, synthetic_cohort, "fedavg", K=4, comm_round=1,
-                  freq=1, stream=True, tag="stfall")
+    """Engines WITHOUT a fused streamed window body (ISSUE 10:
+    ``supports_fused_streaming`` — salientgrads here) still collapse to
+    K=1 under --streaming with the logged streaming reason; the fedavg
+    family now fuses streamed windows instead (pinned below)."""
+    eng = _engine(tmp_path, synthetic_cohort, "salientgrads", K=4,
+                  comm_round=1, freq=1, stream=True, tag="stfall")
     try:
         assert "dispatching one round at a time" in _log_text(eng)
         assert "streaming" in _log_text(eng)
@@ -290,6 +294,28 @@ def test_streaming_falls_back_with_logged_reason(tmp_path,
         assert np.isfinite(result["history"][-1]["train_loss"])
     finally:
         eng.stream.close()
+
+
+def test_streaming_fedavg_fused_window_bitwise(tmp_path, synthetic_cohort):
+    """The fused STREAMED driver (ISSUE 10): a K=4 streamed fedavg run —
+    whole-window shard stacks prefetched, one lax.scan dispatch per
+    window — equals the K=1 streamed loop bitwise in params,
+    batch_stats, and metrics history (frac=0.5 keeps the per-round
+    sampling contract load-bearing)."""
+    base = _engine(tmp_path, synthetic_cohort, "fedavg", K=1, comm_round=4,
+                   freq=4, frac=0.5, stream=True, tag="swk1")
+    fused = _engine(tmp_path, synthetic_cohort, "fedavg", K=4, comm_round=4,
+                    freq=4, frac=0.5, stream=True, tag="swk4")
+    try:
+        assert fused.fused_fallback_reason() is None
+        r1 = base.train()
+        r4 = fused.train()
+    finally:
+        base.stream.close()
+        fused.stream.close()
+    _assert_trees_bitwise(r1["params"], r4["params"])
+    _assert_trees_bitwise(r1["batch_stats"], r4["batch_stats"])
+    assert r1["history"] == r4["history"]
 
 
 def test_fedfomo_falls_back_with_logged_reason(tmp_path, synthetic_cohort):

@@ -405,6 +405,17 @@ def test_stream_transfer_stats_and_two_level_put(h5_cohort):
         assert stream.transfer_stats["fetches"] == 1
         assert stream.transfer_stats["host_gather_ms"] > 0
         assert stream.transfer_stats["device_put_ms"] > 0
+        assert stream.transfer_stats["bytes"] == (
+            np.asarray(Xs).nbytes + np.asarray(ys).nbytes
+            + np.asarray(ns).nbytes)
+        # obs gauge parity (ISSUE 10 satellite): every registry series
+        # equals the legacy dict entry, no double counting
+        from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+
+        snap = obs_metrics.snapshot()["nidt_stream_transfer"]["values"]
+        got = {v["labels"]["key"]: v["value"] for v in snap}
+        for k, v in stream.transfer_stats.items():
+            assert got[k] == float(v), (k, got[k], v)
         # sharded over all 4 mesh devices, one client per device,
         # silo-major placement = mesh device order
         assert len(Xs.sharding.device_set) == 4
@@ -544,6 +555,36 @@ def test_streaming_salientgrads_checkpoint_resume(h5_cohort, tmp_path):
     assert resumed["final_global"] == full["final_global"]
     assert resumed["final_personal"] == full["final_personal"]
     assert resumed["mask_density"] == full["mask_density"]
+
+
+def test_stream_window_feed_matches_per_round(h5_cohort):
+    """The window-granular feed (ISSUE 10): ``get_window``'s [K, S, ...]
+    stacks equal the per-round ``get_train`` buffers round for round,
+    a matching ``prefetch_window`` is served (fetches accounted one per
+    round), and a mismatched prefetch is fetched fresh, never stale."""
+    path, data = h5_cohort
+    lazy = load_abcd_hdf5(path, lazy=True)
+    train_map, test_map, _ = P.site_partition(lazy["site"], seed=42)
+    stream = StreamingFederation(lazy["X"], lazy["y"], train_map, test_map)
+    try:
+        ids = [np.array([0, 2]), np.array([1, 3]), np.array([0, 1])]
+        stream.prefetch_window(ids)
+        f0 = stream.transfer_stats["fetches"]
+        Xw, yw, nw = stream.get_window(ids)
+        assert stream.transfer_stats["fetches"] - f0 == len(ids)
+        assert Xw.shape[0] == len(ids)
+        for k, round_ids in enumerate(ids):
+            Xr, yr, nr = stream.get_train(round_ids)
+            np.testing.assert_array_equal(np.asarray(Xw)[k], np.asarray(Xr))
+            np.testing.assert_array_equal(np.asarray(yw)[k], np.asarray(yr))
+            np.testing.assert_array_equal(np.asarray(nw)[k], np.asarray(nr))
+        # mismatched window prefetch is ignored, not served stale
+        stream.prefetch_window([np.array([0, 1])])
+        X1, _, n1 = stream.get_window([np.array([2, 3])])
+        assert int(np.asarray(n1)[0, 0]) == len(train_map[2])
+    finally:
+        stream.close()
+        lazy["file"].close()
 
 
 def test_streaming_double_buffer_prefetch(h5_cohort):
