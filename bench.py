@@ -564,7 +564,130 @@ def precision_cell() -> dict:
     }
 
 
+def round_program_cell() -> dict:
+    """Round-program builder bench cell (ISSUE 11): per-engine dispatch
+    counts and per-round wall, fused (K=4 windows through
+    engines/program.py) vs the K=1 per-round loop — including the
+    engines the builder put on the fused path for the FIRST time (ditto,
+    dpsgd, subavg) and a fallback reference (fedfomo: per-dispatch count
+    unchanged, the logged + counted reason fires). The dispatch counts
+    are exact (program.dispatches / program.built); on this CPU harness
+    the WALL delta is dominated by host Python + dispatch overhead — the
+    per-dispatch latency a TPU tunnel multiplies (PROFILE.md round 2) —
+    so treat counts and the one-compiled-program-per-window pin as the
+    stable claims and the wall ratio as harness-local.
+
+    Env: BENCH_ROUND_PROGRAM=1 arms this cell (main() prints ONLY it);
+    BENCH_RP_ROUNDS (default 8), BENCH_RP_ENGINES, BENCH_BATCH /
+    BENCH_LOCAL / BENCH_SHAPE / BENCH_MODEL size it."""
+    import time
+
+    import jax
+
+    from neuroimagedisttraining_tpu.config import (
+        DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+    )
+    from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+    from neuroimagedisttraining_tpu.data.federate import federate_cohort
+    from neuroimagedisttraining_tpu.data.synthetic import (
+        generate_synthetic_abcd,
+    )
+    from neuroimagedisttraining_tpu.engines import create_engine
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+    from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+    n_local = int(os.environ.get("BENCH_LOCAL", 16))
+    rounds = int(os.environ.get("BENCH_RP_ROUNDS", 8))
+    shape = tuple(int(s) for s in
+                  os.environ.get("BENCH_SHAPE", "12,14,12").split(","))
+    model_name = os.environ.get("BENCH_MODEL", "3dcnn_tiny")
+    names = os.environ.get(
+        "BENCH_RP_ENGINES", "fedavg,ditto,dpsgd,subavg,fedfomo").split(",")
+
+    cohort = generate_synthetic_abcd(
+        num_subjects=4 * n_local, shape=shape, num_sites=4, seed=0)
+
+    def run(algorithm: str, K: int):
+        cfg = ExperimentConfig(
+            model=model_name, num_classes=1, algorithm=algorithm,
+            data=DataConfig(dataset="synthetic", partition_method="site",
+                            val_fraction=0.25 if algorithm == "fedfomo"
+                            else 0.0),
+            optim=OptimConfig(lr=1e-3, batch_size=batch, epochs=1),
+            fed=FedConfig(client_num_in_total=4, comm_round=rounds,
+                          frequency_of_the_test=10 ** 9,
+                          rounds_per_dispatch=K),
+            log_dir="/tmp/nidt_bench", tag=f"rp-{algorithm}-{K}")
+        mesh = make_mesh()
+        trainer = LocalTrainer(create_model(model_name, num_classes=1),
+                               cfg.optim, num_classes=1)
+        log = ExperimentLogger("/tmp/nidt_bench", "synthetic",
+                               cfg.identity(), console=False)
+        fed, _ = federate_cohort(
+            cohort, partition_method="site", mesh=mesh,
+            val_fraction=cfg.data.val_fraction)
+        eng = create_engine(algorithm, cfg, fed, trainer, mesh=mesh,
+                            logger=log)
+        t0 = time.perf_counter()
+        eng.train()
+        wall = time.perf_counter() - t0
+        prog = eng.program
+        return {
+            "wall_s": round(wall, 3),
+            "wall_per_round_ms": round(1e3 * wall / rounds, 2),
+            # engines without declared stages (fedfomo here) drive their
+            # own per-round jits — the builder counters don't see them,
+            # but their dispatch count IS one-per-round by construction
+            "dispatches": (prog.dispatches if prog.stages is not None
+                           else rounds),
+            "programs_built": (prog.built if prog.stages is not None
+                               else None),
+            "fused": eng.fused_fallback_reason() is None,
+            "fallback_reason": eng.fused_fallback_key(),
+        }
+
+    engines = {}
+    for algorithm in names:
+        k1 = run(algorithm, 1)
+        k4 = run(algorithm, 4)
+        engines[algorithm] = {
+            "k1": k1, "k4": k4,
+            "dispatch_reduction": (
+                round(k1["dispatches"] / k4["dispatches"], 2)
+                if k4["dispatches"] else None),
+            "wall_ratio_k1_over_k4": round(
+                k1["wall_s"] / max(k4["wall_s"], 1e-9), 3),
+        }
+    return {
+        "metric": "round_program",
+        "model": model_name, "shape": "x".join(map(str, shape)),
+        "batch": batch, "n_local": n_local, "rounds": rounds,
+        "device_kind": getattr(jax.devices()[0], "device_kind",
+                               "unknown"),
+        "engines": engines,
+        "notes": ("dispatches counts compiled-program invocations "
+                  "(engines/program.py RoundProgram.dispatches; train "
+                  "rounds only — eval/fine-tune jits are separate). "
+                  "K=4 windows collapse ~rounds dispatches toward "
+                  "rounds/4 + boundary singles for every engine whose "
+                  "stages are declared; fedfomo stays per-round with "
+                  "the counted fallback reason. CPU-harness wall "
+                  "numbers INCLUDE compile (the K=4 leg compiles one "
+                  "program per distinct window length, so it reads "
+                  "SLOWER here); the dispatch counts are the stable "
+                  "claim — the amortized wall win is per-dispatch "
+                  "latency x dispatches saved (TPU tunnel, PROFILE.md "
+                  "round 2)."),
+    }
+
+
 def main() -> None:
+    if os.environ.get("BENCH_ROUND_PROGRAM", "0") == "1":
+        # standalone cell (ISSUE 11): one JSON line, no flagship phases
+        print(json.dumps(round_program_cell()))
+        return
     if os.environ.get("BENCH_PRECISION", "0") == "1":
         # standalone cell (ISSUE 10): one JSON line, no flagship phases
         print(json.dumps(precision_cell()))

@@ -340,8 +340,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "stacks, bitwise-equal to the unsharded "
                              "round; non-tiling cohorts (21 sites on 8 "
                              "devices) pad with zero-weight rows. "
-                             "Engines/modes without a sharded round "
-                             "body fall back with a logged reason. "
+                             "Engines/modes without a declared sharded "
+                             "round body (engines/program.py) fall back "
+                             "with a logged + counted reason "
+                             "(nidt_fallback_total on /metrics). "
                              "Combine with --virtual_devices N to "
                              "simulate without TPU hardware")
     parser.add_argument("--rounds_per_dispatch", type=int, default=1,
@@ -349,9 +351,14 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "dispatch when the federation is resident "
                              "and host-free between rounds (sampling/rng/"
                              "lr precomputed per round; eval/checkpoint "
-                             "hooks fire at window boundaries); engines "
+                             "hooks fire at window boundaries). The "
+                             "round-program builder (engines/program.py) "
+                             "compiles the window for every engine with "
+                             "declared stages — fedavg/fedprox/"
+                             "salientgrads/ditto/dpsgd/subavg; engines "
                              "that cross the host each round fall back "
-                             "to 1 with a logged reason")
+                             "to 1 with a logged + counted reason "
+                             "(nidt_fallback_total)")
     return parser
 
 

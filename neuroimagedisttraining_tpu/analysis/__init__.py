@@ -38,6 +38,7 @@ from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
     obs_discipline,
     precision_discipline,
     privacy_discipline,
+    round_program,
     trace_safety,
 )
 

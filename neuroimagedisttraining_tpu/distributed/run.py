@@ -75,10 +75,11 @@ def dispatch_fallback_note(k: int) -> str | None:
     real sockets)."""
     if k <= 1:
         return None
-    return (f"rounds_per_dispatch={k} requested; the distributed "
-            "transport dispatches one round at a time (every round "
-            "crosses the control plane: broadcast/upload/aggregate over "
-            "sockets)")
+    from neuroimagedisttraining_tpu.engines import program as round_program
+
+    return (f"rounds_per_dispatch={k} requested; "
+            + round_program.report_fallback("distributed",
+                                            "distributed-control-plane"))
 
 
 def cohort_fallback_note(n: int) -> str | None:
@@ -89,10 +90,11 @@ def cohort_fallback_note(n: int) -> str | None:
     its own cohort — the client axis is the set of OS processes."""
     if n <= 0:
         return None
-    return (f"client_mesh={n} requested; the distributed transport has "
-            "no in-process client axis to shard (each rank trains its "
-            "own silo) — flag accepted for config parity with the main "
-            "CLI only")
+    from neuroimagedisttraining_tpu.engines import program as round_program
+
+    return (f"client_mesh={n} requested; "
+            + round_program.report_fallback(
+                "distributed", "distributed-no-client-axis"))
 
 
 def _parse_hosts(spec: str) -> dict[int, str] | None:

@@ -30,6 +30,7 @@ from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.faults.schedule import (
     FaultSchedule, parse_fault_spec,
 )
+from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
@@ -226,8 +227,8 @@ class FederatedEngine:
                     "--client_mesh / --mesh_shape / --virtual_devices "
                     "combination (the sampled-client axis shards over "
                     "EVERY mesh device)")
-            reason = self._cohort_fallback_reason()
-            if reason is None:
+            key = self.program.cohort_fallback_key()
+            if key is None:
                 self._cohort_on = True
                 self.log.info(
                     "client_mesh=%d: cohort sharding armed — the sampled-"
@@ -236,19 +237,24 @@ class FederatedEngine:
                     "on all-gathered stacks; parallel/cohort.py)",
                     cm, mesh.devices.size)
             else:
+                # announced ONCE, up front, AND counted: the structured
+                # nidt_fallback_total{plane,engine,reason} counter makes
+                # fast-path coverage scrapeable (engines/program.py)
                 self.log.info(
                     "client_mesh=%d requested; running the unsharded "
-                    "round program: %s", cm, reason)
+                    "round program: %s", cm,
+                    round_program.report_fallback(self.name, key))
         # fused multi-round dispatch (ISSUE 4): engines that cannot fuse
         # announce the collapse to K=1 ONCE, up front, so a config asking
         # for amortized dispatch never silently degrades
         if cfg.fed.rounds_per_dispatch > 1:
-            reason = self.fused_fallback_reason()
-            if reason is not None:
+            key = self.fused_fallback_key()
+            if key is not None:
                 self.log.info(
                     "rounds_per_dispatch=%d requested; dispatching one "
                     "round at a time: %s",
-                    cfg.fed.rounds_per_dispatch, reason)
+                    cfg.fed.rounds_per_dispatch,
+                    round_program.report_fallback(self.name, key))
 
     # ---------- state init ----------
 
@@ -495,177 +501,67 @@ class FederatedEngine:
         donation is disabled on this engine instance."""
         return tuple(nums) if self._donate else ()
 
-    # ---------- fused multi-round dispatch (ISSUE 4) ----------
+    # ---------- the declared round program (ISSUE 11) ----------
 
-    def fused_fallback_reason(self) -> str | None:
-        """Why this engine dispatches one round at a time even when
-        ``--rounds_per_dispatch K`` asks for fused windows — or None when
-        the engine supports the K-round ``lax.scan`` driver. The base
-        answer covers every engine whose driver crosses the host between
-        rounds (per-round topology/mask bookkeeping, pair lists, MPC
-        stages); FedAvg-shaped engines override."""
-        return ("engine has no fused round body (host-side state between "
-                "rounds)")
+    @functools.cached_property
+    def program(self) -> "round_program.RoundProgram":
+        """The engine's compiled round-program builder
+        (engines/program.py): every fused/sharded/donated dispatch
+        variant, window planning, and fallback reporting. Built from the
+        engine's :meth:`round_stages` declaration (None for engines that
+        keep hand-driven per-round loops — they still get the unified
+        fallback reporting)."""
+        return round_program.RoundProgram(self, self.round_stages())
 
-    def _dispatch_window(self, round_idx: int) -> int:
-        """Length of the fused window starting at ``round_idx``: grows up
-        to ``rounds_per_dispatch`` but stops so that any round with a
-        host-side hook — eval (``frequency_of_the_test``), checkpoint
-        (``checkpoint_every``), the final round — lands on the WINDOW
-        BOUNDARY, where the driver runs the hooks exactly as the
-        sequential loop would have. Interior rounds are hook-free by
-        construction, so fusing changes no observable behavior."""
-        f = self.cfg.fed
-        K = max(1, int(f.rounds_per_dispatch))
-
-        def hooked(r: int) -> bool:
-            return (r % f.frequency_of_the_test == 0
-                    or r == f.comm_round - 1
-                    or (self._ckpt_active()
-                        and (r + 1) % self.cfg.checkpoint_every == 0))
-
-        k = 1
-        while (k < K and round_idx + k < f.comm_round
-               and not hooked(round_idx + k - 1)):
-            k += 1
-        return k
-
-    def _window_sampling(self, round_idx: int, k: int
-                         ) -> tuple[list[np.ndarray], int]:
-        """Host-precomputed per-round cohorts for a fused window,
-        preserving the reference's ``np.random.seed(round_idx)`` sampling
-        contract round by round. The scan needs one static cohort size,
-        so when a fault schedule varies the survivor count mid-window the
-        window shrinks to the maximal equal-size prefix (still fused,
-        still bit-identical cohorts). Returns ``(sampled_per_round, k)``."""
-        sampled = [self.client_sampling(r)
-                   for r in range(round_idx, round_idx + k)]
-        keep = 1
-        while keep < len(sampled) and \
-                len(sampled[keep]) == len(sampled[0]):
-            keep += 1
-        return sampled[:keep], keep
-
-    def _resident_fallback_reason(self) -> str | None:
-        """The fallback conditions shared by every engine that HAS a
-        fused round body (FedAvg-shaped overrides delegate here): the
-        wire codec crosses the host every round, and streaming does too
-        UNLESS the engine's streamed driver fuses at window granularity
-        (``supports_fused_streaming``, ISSUE 10 — the window's shards
-        prefetch as one stack, so the host crossing moves to the window
-        boundary the hooks already own)."""
-        if self.stream is not None and not self.supports_fused_streaming:
-            return "streaming rounds cross the host for data every round"
-        if self.wire_spec is not None:
-            return ("--wire_codec accounts encoded bytes on the host "
-                    "every round")
+    def round_stages(self):
+        """The engine's declared round stages
+        (:class:`engines.program.RoundStages`), or None when the engine
+        has no declarable round body (host-side state between rounds).
+        Declaring stages is what puts an engine on the fused/sharded/
+        donated fast path — the builder owns the machinery."""
         return None
 
-    def _window_host_inputs(self, round_idx: int, k: int):
-        """Host prologue of a fused window: per-round cohorts (via
-        ``_window_sampling``, which may shrink ``k``), the per-round log
-        lines the sequential loop would have emitted, and the stacked
-        device inputs for the scan — including the [K, C]-stacked
-        Byzantine attack plan when the fault schedule carries value
-        faults (None otherwise). With cohort sharding armed, ``idx`` and
-        ``rngs`` cover the mesh-padded per-round sets ([K, P]) while the
-        byz plan stays on the REAL sampled sets (the sharded round body
-        slices pad rows off before the attack/defense tail); ``n_real``
-        is the static real cohort size (None when unsharded). Returns
-        ``(sampled, idx, rngs, lrs, byz, k, n_real)``."""
-        sampled, k = self._window_sampling(round_idx, k)
-        for off, s in enumerate(sampled):
-            self.log.info("################ round %d: clients %s (fused "
-                          "window of %d)", round_idx + off, s.tolist(), k)
-        if self._cohort_on:
-            ids = [self._cohort_pad(s)[0] for s in sampled]
-            n_real = len(sampled[0])
-        else:
-            ids, n_real = sampled, None
-        idx = jnp.asarray(np.stack(ids))
-        rngs = jnp.stack([self.per_client_rngs(round_idx + off, s)
-                          for off, s in enumerate(ids)])
-        lrs = jnp.asarray([self.round_lr(round_idx + off)
-                           for off in range(k)], jnp.float32)
-        byz = None
-        if self._byz_on():
-            plans = [self._byz_round_plan(round_idx + off, s)
-                     for off, s in enumerate(sampled)]
-            byz = tuple(jnp.stack([p[i] for p in plans])
-                        for i in range(4))
-        return sampled, idx, rngs, lrs, byz, k, n_real
+    # ---------- fused multi-round dispatch (ISSUE 4) ----------
 
-    def _window_stream_inputs(self, round_idx: int, k: int):
-        """Host prologue of a fused STREAMED window (ISSUE 10): the
-        per-round cohorts (``_window_sampling`` — may shrink ``k`` to an
-        equal-size prefix), each round's mesh-tiling padded id set
-        (``stream_sampling`` — pads train as zero-weight no-ops exactly
-        like the round-granular feed), the stacked per-round rngs/lrs
-        over the PADDED ids (what the streamed round body consumes), and
-        the [K, P]-stacked byz plan over the padded ids (the streamed
-        per-round driver's contract). Returns
-        ``(ids_per_round, rngs, lrs, byz, k, n_real)``."""
-        sampled, k = self._window_sampling(round_idx, k)
-        padded = [self.stream_sampling(round_idx + off, sampled=s)
-                  for off, s in enumerate(sampled)]
-        ids_per_round = [p[0] for p in padded]
-        n_real = padded[0][1]
-        for off, s in enumerate(sampled):
-            self.log.info("################ round %d (stream): clients %s "
-                          "(fused window of %d)", round_idx + off,
-                          s.tolist(), k)
-        rngs = jnp.stack([self.per_client_rngs(round_idx + off, ids)
-                          for off, ids in enumerate(ids_per_round)])
-        lrs = jnp.asarray([self.round_lr(round_idx + off)
-                           for off in range(k)], jnp.float32)
-        byz = None
-        if self._byz_on():
-            plans = [self._byz_round_plan(round_idx + off, ids)
-                     for off, ids in enumerate(ids_per_round)]
-            byz = tuple(jnp.stack([p[i] for p in plans])
-                        for i in range(4))
-        return ids_per_round, rngs, lrs, byz, k, n_real
+    def fused_fallback_key(self) -> str | None:
+        """REASONS key for why this engine dispatches one round at a
+        time even when ``--rounds_per_dispatch K`` asks for fused
+        windows — or None when the declared stages support the K-round
+        ``lax.scan`` driver. Engines with genuinely host-driven rounds
+        override with their table key (engines/program.py REASONS is the
+        single source of truth; ad-hoc reason strings are a lint
+        finding)."""
+        return self.program.fused_fallback_key()
+
+    def fused_fallback_reason(self) -> str | None:
+        """The logged message for :meth:`fused_fallback_key` (None when
+        the fused driver arms) — kept for drivers and tests that match
+        on the message text."""
+        key = self.fused_fallback_key()
+        return None if key is None else round_program.reason(key)
+
+    def _dispatch_window(self, round_idx: int) -> int:
+        """Window length starting at ``round_idx`` (delegates to the
+        program's planner — hooks land on window boundaries)."""
+        return self.program.dispatch_window(round_idx)
 
     # ---------- cohort sharding (--client_mesh, ISSUE 6) ----------
 
-    def cohort_fallback_reason(self) -> str | None:
-        """Why this engine runs the unsharded round even when
-        ``--client_mesh`` asks for the cohort-sharded client mesh — or
-        None when its round body supports the sharded local-training
-        stage (parallel/cohort.py). The base answer covers every engine
-        whose round crosses the host or exchanges per-client state in a
-        non-FedAvg shape; capable engines set
-        ``supports_cohort_sharding`` and delegate the mode checks to
-        ``_cohort_fallback_reason``."""
-        return ("engine has no cohort-sharded round body (its round "
-                "crosses the host or exchanges per-client state outside "
-                "the fedavg/salientgrads shape)")
+    def cohort_fallback_key(self) -> str | None:
+        """REASONS key for why this engine runs the unsharded round even
+        when ``--client_mesh`` asks for the cohort-sharded client mesh.
+        The base answer covers every engine without declared stages (or
+        whose stages cannot shard); engines with a structurally
+        different sharding story (dispfl/turbo) override with their
+        table key. Mode checks (mesh shape, streaming, batch order) live
+        in the program builder."""
+        return "no-sharded-body"
 
-    def _cohort_fallback_reason(self) -> str | None:
-        """Engine capability + mode checks, combined. Mirrors
-        ``fused_fallback_reason``'s contract: None means the sharded
-        path arms."""
-        if not self.supports_cohort_sharding:
-            return self.cohort_fallback_reason()
-        if self.mesh is not None and len(self.mesh.axis_names) != 1:
-            return ("two-level (silos, clients) mesh routes aggregation "
-                    "silo-first (parallel/hierarchical.py); cohort "
-                    "sharding arms on 1-D client meshes")
-        if self.mesh is not None and self.mesh.devices.size == 1:
-            return ("only one device visible — the unsharded round IS "
-                    "the single-device program")
-        if self.stream is not None:
-            return ("streaming rounds host-stage each round's shards; "
-                    "the streamed feed already device_puts them client-"
-                    "sharded over the mesh")
-        if self.cfg.optim.batch_order != "shuffle":
-            return ("batch_order=replacement draws per-step randint "
-                    "batches inside the shard_map partition, where the "
-                    "partitioned RNG+gather lowering miscompiles on this "
-                    "toolchain (measured, parallel/cohort.py); the "
-                    "shuffle path hoists its permutations out of the "
-                    "partition — i.i.d. per-step draws cannot be hoisted")
-        return None
+    def cohort_fallback_reason(self) -> str | None:
+        """The logged message for the program's cohort fallback key
+        (None when the sharded path arms)."""
+        key = self.program.cohort_fallback_key()
+        return None if key is None else round_program.reason(key)
 
     def _cohort_pad(self, sampled: np.ndarray) -> tuple[np.ndarray, int]:
         """``(padded_ids, n_real)`` for a cohort-sharded resident round:
@@ -673,38 +569,6 @@ class FederatedEngine:
         ``pad_cohort`` rule — zero-sample pool first, then repeat)."""
         return cohort.pad_cohort(np.asarray(sampled), self.real_clients,
                                  self.num_clients, self.mesh.devices.size)
-
-    def _cohort_perms(self, rngs, ns):
-        """Hoisted per-client epoch permutations for a sharded
-        local-train stage: what each client's ``local_train`` would
-        derive from its rng, computed OUTSIDE the shard_map and passed
-        in via ``perms=`` — the argsort-lowered permutation MISCOMPILES
-        inside a shard_map partition on this toolchain (jax 0.4.x CPU
-        SPMD; the consumed permutation silently differs from the
-        observable one — core/trainer.py documents the measurement).
-        None under ``batch_order=replacement`` (i.i.d. randint draws, no
-        permutation to hoist)."""
-        if self.cfg.optim.batch_order != "shuffle":
-            return None
-        from neuroimagedisttraining_tpu.core.trainer import epoch_perms_for
-
-        o = self.cfg.optim
-        ms = self._max_samples()
-        return jax.vmap(
-            lambda r, n: epoch_perms_for(r, o.epochs, ms, n))(rngs, ns)
-
-    def _cohort_local_stage(self, fn, cs, Xs, ys, ns):
-        """The sharded local-training stage as one call: hoist the epoch
-        permutations from ``cs.rng``, then run the per-client loop under
-        the client mesh. The hoist is non-optional here by construction
-        — cohort sharding only arms under ``batch_order=shuffle``
-        (``_cohort_fallback_reason``), so hoistable perms always exist;
-        reaching this point without them would put the argsort back
-        inside the partition, the exact miscompile the hoist prevents."""
-        perms = self._cohort_perms(cs.rng, ns)
-        assert perms is not None, \
-            "cohort sharding armed without hoistable epoch permutations"
-        return self._cohort_map(fn, cs, Xs, ys, ns, perms)
 
     def _cohort_round_prog(self, sampled: np.ndarray):
         """``(gather_ids, round_prog)`` for one resident round: the
@@ -767,52 +631,10 @@ class FederatedEngine:
         return (jnp.asarray(mult), jnp.asarray(std), jnp.asarray(nan),
                 keys)
 
-    def _sanitize_and_defend(self, upload, ref, w, losses, rngs=None):
-        """The shared tail of a defended round body (trace-safe; fedavg
-        and salientgrads call it inside their jitted round programs):
-
-        1. non-finite upload guard (runs with or without ``--defense``):
-           a single NaN/Inf client would poison ``tree_weighted_mean``,
-           so its row is swapped for the broadcast ``ref`` and
-           zero-weighted (the count comes back as ``n_bad``);
-        2. defense dispatch (core/robust.py): order-statistic defenses
-           consume the whole upload payload (a Byzantine silo poisons
-           its batch_stats too) and replace the weighted mean; the clip
-           family transforms params per client (batch_stats are never
-           clipped — structural parity with ``is_weight_param``,
-           robust_aggregation.py:28-29) then reduces with the engine's
-           silo-aware ``aggregate``. A cohort too small for the
-           configured aggregator (fault-schedule shrinkage) falls back
-           to the plain mean with a warning — resolved at trace time,
-           the cohort axis is static.
-
-        ``upload``/``ref`` are ``{"params", "batch_stats"}`` dicts
-        (stacked / unstacked); ``rngs`` are the per-client keys weak_dp
-        noise draws from. Returns
-        ``(new_params, new_bstats, mean_loss, n_bad)``."""
-        f = self.cfg.fed
-        finite = robust.finite_per_client(upload)
-        upload = robust.replace_nonfinite_clients(upload, ref, finite)
-        n_bad = jnp.sum(~finite).astype(jnp.int32)
-        w = w * finite.astype(jnp.float32)
-        C = int(jax.tree.leaves(upload)[0].shape[0])
-        defense = robust.effective_defense(f.defense_type, C, f.byz_f,
-                                           warn=self.log.warning)
-        if defense in robust.ROBUST_AGGREGATORS:
-            agg = robust.robust_aggregate(
-                upload, w, defense=defense, byz_f=f.byz_f,
-                geomed_iters=f.geomed_iters)
-            new_params, new_bstats = agg["params"], agg["batch_stats"]
-        else:
-            client_params = robust.defend_stacked(
-                upload["params"], ref["params"], defense=defense,
-                norm_bound=f.norm_bound, stddev=f.stddev, rngs=rngs)
-            new_params = self.aggregate(client_params, w)
-            new_bstats = self.aggregate(upload["batch_stats"], w)
-        safe_losses = jnp.where(jnp.isfinite(losses), losses, 0.0)
-        mean_loss = jnp.sum(safe_losses * w) / jnp.maximum(jnp.sum(w),
-                                                           1e-9)
-        return new_params, new_bstats, mean_loss, n_bad
+    # NOTE: the shared sanitize -> defend -> aggregate round tail lives
+    # in engines/program.py (``sanitize_defend_aggregate``) — it is a
+    # builder-owned stage, applied to every engine whose declared round
+    # has no custom aggregate stage (ISSUE 11).
 
     # ---------- privacy accounting (privacy/, ISSUE 8) ----------
 

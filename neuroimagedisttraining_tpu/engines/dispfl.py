@@ -74,14 +74,12 @@ class DisPFLEngine(FederatedEngine):
     #: codec mask handoff
     _masks_local = None
 
-    def cohort_fallback_reason(self) -> str | None:
+    def cohort_fallback_key(self) -> str | None:
         # --client_mesh (ISSUE 6) is redundant here, not unsupported:
         # the decentralized consensus ALREADY runs client-sharded on the
         # mesh (parallel/gossip.py lowers the per-round adjacency to
         # ppermute / routed all_to_all collectives over the client axis)
-        return ("dispfl's decentralized round already runs client-"
-                "sharded gossip collectives on the mesh "
-                "(parallel/gossip.py); --client_mesh adds nothing")
+        return "gossip-mesh-collectives"
 
     def wire_masks(self):
         """Mask handoff (codec/): the CURRENT per-client masks, stacked

@@ -22,8 +22,21 @@ k-regular draw every round) the consensus lowers to a routed, capped
 (parallel/gossip.py::sparse_plan) — per-device traffic O(D * m * model),
 m ~ B(k+1)/D rows, one compiled program per size bucket. Only when
 neither structure applies (dense patterns) does it fall back to the
-``einsum('cj,j...->c...')`` all-gather. Either way, consensus + vmapped
-local training is one jitted program per round.
+``einsum('cj,j...->c...')`` all-gather.
+
+The round is DECLARED through the round-program builder
+(engines/program.py, ISSUE 11): consensus + local training is the train
+stage (the mixing matrix and the sparse plan's routing arrays are
+``per_round`` operands; the hashable plan spec keys the compiled
+program), the all-real mean over trained stacks is a custom aggregate
+stage, and ``w_global`` is an epilogue — computed once per dispatch from
+the final stacks, which over a fused window is bitwise-identical to the
+last round's (same op on the same values). The builder supplies fused
+``--rounds_per_dispatch K`` windows (shrunk to the maximal equal-plan
+prefix when per-round gossip plans change shape) and ``--client_mesh``
+sharding of the local-train stage (the gossip consensus itself already
+runs mesh collectives); the every-100-rounds fine-tune pass is declared
+as an extra window-boundary hook.
 """
 
 from __future__ import annotations
@@ -36,6 +49,7 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.parallel.gossip import (
     SparseSpec, gossip_apply, gossip_apply_sparse, make_plan,
@@ -74,7 +88,7 @@ class DPSGDEngine(FederatedEngine):
     #: upload. When armed, each client's post-training delta vs its
     #: consensus point is clipped to dp_clip and noised with
     #: N(0, (dp_sigma * dp_clip)^2) INSIDE the jitted round, before
-    #: anything leaves the vmapped client row (neighbors, w_global, and
+    #: anything leaves the per-client row (neighbors, w_global, and
     #: eval all consume the noised models); the RDP accountant reports
     #: the running per-silo (epsilon, dp_delta) in stat_info
     #: (record_privacy: q = 1 full participation, z = dp_sigma).
@@ -103,13 +117,9 @@ class DPSGDEngine(FederatedEngine):
     # first and then local-trains client CHUNKS against host-fetched
     # shards.
     supports_streaming = True
-
-    def cohort_fallback_reason(self) -> str | None:
-        # same story as DisPFL: the gossip consensus already lowers to
-        # client-sharded mesh collectives (parallel/gossip.py)
-        return ("dpsgd's decentralized round already runs client-sharded "
-                "gossip collectives on the mesh (parallel/gossip.py); "
-                "--client_mesh adds nothing")
+    supports_cohort_sharding = True  # the local-train stage (every
+    # client, every round) shards over the --client_mesh; the consensus
+    # already runs mesh collectives (parallel/gossip.py)
 
     def _consensus(self, per_params, per_bstats, M, plan_arrays=None, *,
                    plan=None):
@@ -138,35 +148,55 @@ class DPSGDEngine(FederatedEngine):
         round."""
         return make_plan(M_np, self.mesh, self.num_clients)
 
-    def _local_block(self, mixed_p, mixed_b, rngs, X, y, n, lr):
-        trainer = self.trainer
+    # ---------- the declared round (engines/program.py) ----------
+
+    def round_stages(self):
+        return round_program.RoundStages(
+            carry=("per_params", "per_bstats"),
+            train=self._train_stage,
+            aggregate=self._aggregate_stage,
+            epilogue=self._epilogue_stage,
+            outputs=("loss",),
+            per_round=("M", "plan_arrays"),
+            gathers_cohort=False,
+            window_extras=self._window_extras,
+            extra_hooked=self._finetune_hooked,
+        )
+
+    def _finetune_hooked(self, r: int) -> bool:
+        """The every-100-rounds fine-tune-from-global evaluation pass is
+        a host-side hook — the window planner pins it to a boundary."""
+        return r % 100 == 99
+
+    def _train_stage(self, ctx) -> round_program.TrainOut:
+        """Consensus over last round's models (per-round mixing matrix /
+        routed plan arrays), then every client trains from its consensus
+        point — vmapped, or sharded over the client mesh (the full
+        cohort tiles it by construction: the data layer pads
+        num_clients; perms hoisted out of the partition)."""
         o = self.cfg.optim
-        f = self.cfg.fed
-        max_samples = self._max_samples()
-        dp_on = f.dp_sigma > 0 or f.dp_clip > 0
+        Xs, ys, ns = ctx.Xs, ctx.ys, ctx.ns
+        mixed_p, mixed_b = self._consensus(
+            ctx.carry["per_params"], ctx.carry["per_bstats"],
+            ctx.per_round["M"], ctx.per_round["plan_arrays"],
+            plan=ctx.static)
+        new_p, new_b, losses = ctx.client_map(
+            self._dp_local_fn(ctx.lr), mixed_p, mixed_b, ctx.rngs, Xs,
+            ys, ns,
+            hoisted=(lambda: ctx.local_perms(ctx.rngs, ns, o.epochs),))
+        return round_program.TrainOut(
+            losses=losses, extra={"new_p": new_p, "new_b": new_b})
 
-        def local(p, b, rng, Xc, yc, nc):
-            cs = ClientState(params=p, batch_stats=b,
-                             opt_state=trainer.opt.init(p), rng=rng)
-            cs, loss = trainer.local_train(
-                cs, Xc, yc, nc, lr, epochs=o.epochs,
-                batch_size=o.batch_size, max_samples=max_samples)
-            out_p = cs.params
-            if dp_on:
-                # DP boundary: clip the update delta vs THIS client's
-                # consensus point (its round input p — the model its
-                # neighbors already hold), then Gaussian noise at
-                # sigma = dp_sigma * dp_clip from the config-folded key.
-                # batch_stats are never clipped/noised (structural
-                # parity with the weak_dp is_weight_param exclusion).
-                out_p = robust.norm_diff_clip(out_p, p, f.dp_clip)
-                if f.dp_sigma > 0:
-                    out_p = robust.add_weak_dp_noise(
-                        out_p, jax.random.fold_in(rng, _DP_STREAM),
-                        f.dp_sigma * f.dp_clip)
-            return out_p, cs.batch_stats, loss
-
-        return jax.vmap(local)(mixed_p, mixed_b, rngs, X, y, n)
+    def _aggregate_stage(self, ctx, upload, w, tr):
+        """No server aggregation in a decentralized round: the trained
+        stacks ARE next round's carry; the round's scalar is the mean
+        loss over real clients."""
+        real = (ctx.ns > 0).astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(real), 1.0)
+        mean_loss = jnp.sum(tr.losses * real) / denom
+        return ({"per_params": tr.extra["new_p"],
+                 "per_bstats": tr.extra["new_b"]},
+                {"loss": mean_loss})
 
     @staticmethod
     def _global_mean(new_p, new_b, n_train):
@@ -178,31 +208,93 @@ class DPSGDEngine(FederatedEngine):
             ).astype(x.dtype), t)
         return gmean(new_p), gmean(new_b), real, denom
 
+    def _epilogue_stage(self, eng, carry, data) -> tuple:
+        """``w_global`` — the plain mean of all personal models
+        (dpsgd_api.py:161-167), computed once per dispatch from the
+        final stacks (bitwise the last round's: same op, same values)."""
+        wp, wb, _, _ = self._global_mean(carry["per_params"],
+                                         carry["per_bstats"],
+                                         data.n_train)
+        return (wp, wb)
+
+    def _window_extras(self, round_idx: int, k: int
+                       ) -> round_program.WindowInputs:
+        """Window prologue: per-round mixing matrices + gossip plans.
+        The scan needs ONE compiled consensus, so the window shrinks to
+        the maximal prefix whose plan spec (the program's static key)
+        and routing-array shapes match round 0's — ring/full topologies
+        are round-invariant (full windows), random topologies fuse while
+        their sparse bucketing stays shape-stable."""
+        Ms, plans, arrays = [], [], []
+        for off in range(k):
+            M_np = self.mixing_matrix(round_idx + off)
+            plan, pa = self.gossip_plan(M_np)
+            Ms.append(M_np)
+            plans.append(plan)
+            arrays.append(pa)
+
+        def compatible(i: int) -> bool:
+            if plans[i] != plans[0]:
+                return False
+            a0 = jax.tree.leaves(arrays[0])
+            ai = jax.tree.leaves(arrays[i])
+            return (jax.tree.structure(arrays[i])
+                    == jax.tree.structure(arrays[0])
+                    and all(np.shape(x) == np.shape(y)
+                            for x, y in zip(ai, a0)))
+
+        keep = 1
+        while keep < k and compatible(keep):
+            keep += 1
+        k = keep
+        for off in range(k):
+            self.log.info("################ round %d: decentralized "
+                          "cohort (fused window of %d)", round_idx + off,
+                          k)
+        C = self.num_clients
+        M = jnp.asarray(np.stack(Ms[:k]))
+        if jax.tree.leaves(arrays[0]):
+            pa = jax.tree.map(lambda *xs: jnp.stack(xs), *arrays[:k])
+        else:
+            pa = arrays[0]
+        rngs = jnp.stack([self.per_client_rngs(round_idx + off,
+                                               np.arange(C))
+                          for off in range(k)])
+        lrs = jnp.asarray([self.round_lr(round_idx + off)
+                           for off in range(k)], jnp.float32)
+        return round_program.WindowInputs(
+            sampled=None, idx=None, rngs=rngs, lrs=lrs, byz=None, k=k,
+            n_real=None, static_key=plans[0],
+            per_round={"M": M, "plan_arrays": pa})
+
+    # ---------- legacy-signature program adapters ----------
+
     def _round_jit_for(self, plan):
-        def build():
-            def round_fn(per_params, per_bstats, data, M, rngs, lr,
-                         plan_arrays):
-                mixed_p, mixed_b = self._consensus(per_params, per_bstats,
-                                                   M, plan_arrays,
-                                                   plan=plan)
-                new_p, new_b, losses = self._local_block(
-                    mixed_p, mixed_b, rngs, data.X_train, data.y_train,
-                    data.n_train, lr)
-                w_global_p, w_global_b, real, denom = self._global_mean(
-                    new_p, new_b, data.n_train)
-                mean_loss = jnp.sum(losses * real) / denom
-                return new_p, new_b, w_global_p, w_global_b, mean_loss
+        prog = self.program.round_jit(static_key=plan,
+                                      sharded=self._cohort_on)
 
-            # donation: last round's personal stacks are consumed by the
-            # consensus; their buffers back this round's stacks
-            return jax.jit(round_fn,
-                           donate_argnums=self._donate_argnums(0, 1))
+        def round_call(per_params, per_bstats, data, M, rngs, lr,
+                       plan_arrays):
+            return prog((per_params, per_bstats), data, (), None, rngs,
+                        lr, None, None, (M, plan_arrays))
 
-        return self._plan_cached("_round_jit_cache", plan, build)
+        def lower(per_params, per_bstats, data, M, rngs, lr,
+                  plan_arrays):
+            # legacy-signature .lower passthrough (compile-text pins,
+            # tests/test_gossip.py)
+            return prog.jit.lower((per_params, per_bstats), data, (),
+                                  None, rngs, lr, None, None,
+                                  (M, plan_arrays))
+
+        round_call.jit = prog.jit
+        round_call.lower = lower
+        return round_call
 
     @property
     def _round_jit(self):
         return self._round_jit_for(None)
+
+    # ---------- streaming round (chunked; outside the program) ----------
 
     def _consensus_jit_for(self, plan):
         # donation: the streamed round never rereads the pre-consensus
@@ -215,6 +307,45 @@ class DPSGDEngine(FederatedEngine):
     @property
     def _consensus_jit(self):
         return self._consensus_jit_for(None)
+
+    def _dp_local_fn(self, lr):
+        """The per-client train + DP-boundary closure shared by the
+        resident train stage and the streamed block — the DP transform
+        lives ONCE. Clip the update delta vs THIS client's consensus
+        point (its round input ``p`` — the model its neighbors already
+        hold), then Gaussian noise at sigma = dp_sigma * dp_clip from
+        the config-folded key. batch_stats are never clipped/noised
+        (structural parity with the weak_dp is_weight_param
+        exclusion)."""
+        trainer = self.trainer
+        o = self.cfg.optim
+        f = self.cfg.fed
+        max_samples = self._max_samples()
+        dp_on = f.dp_sigma > 0 or f.dp_clip > 0
+
+        def local(p, b, rng, Xc, yc, nc, perms_c=None):
+            cs = ClientState(params=p, batch_stats=b,
+                             opt_state=trainer.opt.init(p), rng=rng)
+            cs, loss = trainer.local_train(
+                cs, Xc, yc, nc, lr, epochs=o.epochs,
+                batch_size=o.batch_size, max_samples=max_samples,
+                perms=perms_c)
+            out_p = cs.params
+            if dp_on:
+                out_p = robust.norm_diff_clip(out_p, p, f.dp_clip)
+                if f.dp_sigma > 0:
+                    out_p = robust.add_weak_dp_noise(
+                        out_p, jax.random.fold_in(rng, _DP_STREAM),
+                        f.dp_sigma * f.dp_clip)
+            return out_p, cs.batch_stats, loss
+
+        return local
+
+    def _local_block(self, mixed_p, mixed_b, rngs, X, y, n, lr):
+        """The streamed per-chunk training block (the resident path's
+        local stage lives in ``_train_stage``)."""
+        return jax.vmap(self._dp_local_fn(lr))(mixed_p, mixed_b, rngs,
+                                               X, y, n)
 
     @functools.cached_property
     def _block_jit(self):
@@ -249,7 +380,6 @@ class DPSGDEngine(FederatedEngine):
         the fine-tuned models are evaluated then DISCARDED (w_per_tmp)."""
         trainer = self.trainer
         o = self.cfg.optim
-        C = self.num_clients
         max_samples = int(self.data.X_train.shape[1])
 
         def ft(params, bstats, data, rngs, lr):
@@ -284,23 +414,35 @@ class DPSGDEngine(FederatedEngine):
             g_params, g_bstats = (restored["g_params"],
                                   restored["g_bstats"])
             history = restored["history"]
-        for round_idx in range(start, cfg.fed.comm_round):
-            M_np = self.mixing_matrix(round_idx)
-            plan, plan_arrays = self.gossip_plan(M_np)
-            M = jnp.asarray(M_np)
-            rngs = self.per_client_rngs(round_idx,
-                                        np.arange(self.num_clients))
-            if self.stream is not None:
-                per_params, per_bstats, g_params, g_bstats, loss = \
-                    self._round_streaming(per_params, per_bstats, M, rngs,
-                                          self.round_lr(round_idx),
-                                          plan=plan,
-                                          plan_arrays=plan_arrays)
+        fuse = (cfg.fed.rounds_per_dispatch > 1
+                and self.fused_fallback_reason() is None)
+        round_idx = start
+        while round_idx < cfg.fed.comm_round:
+            k = self._dispatch_window(round_idx) if fuse else 1
+            if k > 1:
+                ((per_params, per_bstats), (g_params, g_bstats), outs,
+                 wi) = self.program.run_window(
+                    (per_params, per_bstats), round_idx, k)
+                loss, k = outs["loss"][-1], wi.k
+                round_idx += k - 1
             else:
-                per_params, per_bstats, g_params, g_bstats, loss = \
-                    self._round_jit_for(plan)(
-                        per_params, per_bstats, self.data, M, rngs,
-                        self.round_lr(round_idx), plan_arrays)
+                M_np = self.mixing_matrix(round_idx)
+                plan, plan_arrays = self.gossip_plan(M_np)
+                M = jnp.asarray(M_np)
+                rngs = self.per_client_rngs(round_idx,
+                                            np.arange(self.num_clients))
+                if self.stream is not None:
+                    per_params, per_bstats, g_params, g_bstats, loss = \
+                        self._round_streaming(per_params, per_bstats, M,
+                                              rngs,
+                                              self.round_lr(round_idx),
+                                              plan=plan,
+                                              plan_arrays=plan_arrays)
+                else:
+                    per_params, per_bstats, g_params, g_bstats, loss = \
+                        self._round_jit_for(plan)(
+                            per_params, per_bstats, self.data, M, rngs,
+                            self.round_lr(round_idx), plan_arrays)
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 self.record_privacy(round_idx)
@@ -326,7 +468,8 @@ class DPSGDEngine(FederatedEngine):
                 # this DIAGNOSTIC pass (the fine-tuned models are
                 # evaluated then discarded, dpsgd_api.py:101 w_per_tmp —
                 # no training state depends on it); the per-round metrics
-                # above stream fine.
+                # above stream fine. The window planner pins this round
+                # to a boundary (round_stages.extra_hooked).
                 ft_rngs = self.per_client_rngs(-1,
                                                np.arange(self.num_clients))
                 ft_p, ft_b = self._finetune_jit(g_params, g_bstats, self.data,
@@ -339,6 +482,7 @@ class DPSGDEngine(FederatedEngine):
                 "per_params": per_params, "per_bstats": per_bstats,
                 "g_params": g_params, "g_bstats": g_bstats,
                 "history": history})
+            round_idx += 1
         return {"personal_params": per_params, "global_params": g_params,
                 "history": history,
                 "final_global": self._eval_g(g_params, g_bstats)}

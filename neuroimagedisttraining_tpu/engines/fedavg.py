@@ -23,20 +23,20 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
-from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
-from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.utils import pytree as pt
 
 
 class FedAvgEngine(FederatedEngine):
     name = "fedavg"
     supports_streaming = True
-    supports_wire_codec = True  # _round_body runs the codec roundtrip
-    supports_byz_faults = True  # _round_body routes uploads through the
-    # adversary transform when the schedule carries byz: value faults
-    supports_cohort_sharding = True  # _round_body's local-train stage
+    supports_wire_codec = True  # the declared round runs the codec
+    # roundtrip (builder codec stage, engines/program.py)
+    supports_byz_faults = True  # uploads route through the builder's
+    # attack stage when the schedule carries byz: value faults
+    supports_cohort_sharding = True  # the declared local-train stage
     # runs under the --client_mesh shard_map (ISSUE 6)
     supports_fused_streaming = True  # the streamed driver fuses K-round
     # windows over one prefetched [K, S, ...] shard stack (ISSUE 10)
@@ -47,51 +47,36 @@ class FedAvgEngine(FederatedEngine):
         round's incoming global model; FedProx overrides."""
         return {}
 
-    def _round_body(self, params, bstats, Xs, ys, ns, rngs, lr, efs=None,
-                    byz=None, n_real=None):
-        """One FedAvg round over pre-gathered sampled-client shards; shared
-        by the device-resident, streaming, and cohort-sharded paths.
+    # ---------- the declared round (engines/program.py) ----------
 
-        ``n_real`` (static) marks the cohort-sharded program (ISSUE 6):
-        the incoming shards cover the MESH-PADDED sampled set (pad rows
-        zero-weighted by position — cohort.pad_row_weights, since a pad
-        may duplicate a real client id), the local-training stage runs
-        as unbatched per-client loops under the client-mesh shard_map,
-        and the trained stacks are statically sliced back to the real
-        ``n_real`` rows — the attack/codec/sanitize/defense/aggregation
-        tail below then executes the identical operations the sequential
-        C-loop program executes (losses bitwise from identical state,
-        state to ~1 ulp — the full contract in parallel/cohort.py,
-        pinned in tests/test_cohort.py). ``efs``/``byz`` are always
-        sized for the REAL sampled set.
+    def round_stages(self):
+        """FedAvg is the builder's simplest declaration: carry the
+        global model, train the sampled cohort, and let the builder run
+        the attack -> codec (with EF) -> sanitize -> defend -> aggregate
+        tail. The compiled programs are bitwise-equal to the pre-builder
+        hand-written paths (tests/test_dispatch.py, test_cohort.py)."""
+        return round_program.RoundStages(
+            carry=("params", "batch_stats"),
+            train=self._train_stage,
+            uses_ef=True,
+            supports_attack=True,
+        )
 
-        ``byz`` (faults/adversary.py plan ``(mult, std, nonfinite,
-        keys)``, [C] each) transforms the scheduled clients' uploads
-        into Byzantine values BEFORE the wire codec — the attacker
-        controls what its silo encodes, the server defends on what it
-        decodes. Every round then sanitizes: non-finite uploads are
-        swapped for the broadcast reference and zero-weighted (counted
-        in the ``n_bad`` output — the non-finite guard runs with or
-        without a defense), and ``--defense`` dispatches through
-        core/robust.py (clip family per client before the weighted mean;
-        trimmed_mean/median/krum/geometric_median replace the mean over
-        the whole upload payload, batch_stats included).
-
-        With ``--wire_codec`` set, every client's trained params pass
-        through the codec's jitted lossy roundtrip (delta vs the round's
-        broadcast ``params``, optional top-k with the ``efs``
-        error-feedback rows threaded per sampled client, int8/bf16
-        quantization) BEFORE defense + aggregation — the in-sim round
-        aggregates exactly what a cross-silo server would decode. The
-        extra outputs are (new_efs|None, u0 = client 0's decoded upload
-        for the host-side byte accounting)."""
+    def _train_stage(self, ctx) -> round_program.TrainOut:
+        """Local-train stage: broadcast the round's incoming global model
+        over the cohort and run each client's local SGD — vmapped, or as
+        unbatched per-client loops under the client mesh when the program
+        was built sharded (ctx.client_map; epoch permutations hoisted out
+        of the partition — parallel/cohort.py)."""
         trainer = self.trainer
         o = self.cfg.optim
+        params = ctx.carry["params"]
+        bstats = ctx.carry["batch_stats"]
+        Xs, ys, ns = ctx.Xs, ctx.ys, ctx.ns
+        lr = ctx.lr
         S = Xs.shape[0]
         max_samples = self._max_samples()
         prox = self._prox_kwargs(params)
-        if n_real is not None:
-            ns = cohort.pad_row_weights(ns, n_real)
         cs = ClientState(
             params=jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
@@ -100,7 +85,7 @@ class FedAvgEngine(FederatedEngine):
             opt_state=jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (S,) + x.shape),
                 trainer.opt.init(params)),
-            rng=rngs,
+            rng=ctx.rngs,
         )
 
         def local(cs_c, Xc, yc, nc, perms_c=None):
@@ -109,218 +94,76 @@ class FedAvgEngine(FederatedEngine):
                 batch_size=o.batch_size, max_samples=max_samples,
                 perms=perms_c, **prox)
 
-        if n_real is None:
-            cs, losses = jax.vmap(local)(cs, Xs, ys, ns)
-        else:
-            # hoisted-perms sharded loop (base._cohort_local_stage)
-            cs, losses = self._cohort_local_stage(local, cs, Xs, ys, ns)
-            if n_real < S:  # static slice: drop the mesh-pad rows
-                cs = jax.tree.map(lambda x: x[:n_real], cs)
-                losses = losses[:n_real]
-                ns = ns[:n_real]
-        w = ns.astype(jnp.float32)
-        client_params = cs.params
-        client_bstats = cs.batch_stats
-        if byz is not None:
-            # the attack hits the WHOLE upload payload (params + batch
-            # stats — what the wire ships) before any encoding; honest
-            # clients ride the plan's identity rows bitwise-untouched
-            mult, std, nonfinite, keys = byz
-            atk = adversary.apply_attack_stacked(
-                {"params": client_params, "batch_stats": client_bstats},
-                {"params": params, "batch_stats": bstats},
-                mult, std, nonfinite, keys)
-            client_params = atk["params"]
-            client_bstats = atk["batch_stats"]
-        new_efs = u0 = None
-        if self.wire_spec is not None:
-            from neuroimagedisttraining_tpu.codec import device as codec_dev
+        cs, losses = ctx.client_map(
+            local, cs, Xs, ys, ns,
+            hoisted=(lambda: ctx.local_perms(ctx.rngs, ns, o.epochs),))
+        return round_program.TrainOut(
+            losses=losses,
+            upload={"params": cs.params, "batch_stats": cs.batch_stats},
+            state=cs)
 
-            spec = self.wire_spec
-            # the WHOLE upload payload rides the codec — {params,
-            # batch_stats}, the exact tree FedAvgClientProc encodes
-            # (distributed/run.py), so with delta+sparse+quant the global
-            # top-k threshold sees BN running-stat residuals competing
-            # for the k slots just like the real wire, and the simulated
-            # aggregate matches the socket federation's decode
-            upload = {"params": client_params,
-                      "batch_stats": client_bstats}
-            ref = {"params": params, "batch_stats": bstats}
-            if spec.needs_ef:
-                dec, new_efs = jax.vmap(
-                    lambda u, e: codec_dev.lossy_roundtrip(
-                        spec, u, reference=ref, ef=e))(upload, efs)
-                # a non-finite upload row (byz nonfinite attack, diverged
-                # optimizer) would park NaN in the EF stack FOREVER —
-                # EF = u - decode(u) is NaN, and every later encode
-                # consumes it, so the guard would zero-weight the client
-                # for the rest of the run. Zero those rows so the value
-                # fault stays transient (the engine-side mirror of the
-                # server's post-quarantine ARG_EF_RESET invariant).
-                fin = robust.finite_per_client(upload)
-                new_efs = jax.tree.map(
-                    lambda e: jnp.where(
-                        fin.reshape((-1,) + (1,) * (e.ndim - 1)),
-                        e, jnp.zeros_like(e)), new_efs)
-            else:
-                dec, _ = jax.vmap(
-                    lambda u: codec_dev.lossy_roundtrip(
-                        spec, u, reference=ref))(upload)
-            client_params = dec["params"]
-            client_bstats = dec["batch_stats"]
-            u0 = jax.tree.map(lambda x: x[0], dec)
-        # non-finite guard + defense dispatch (base._sanitize_and_defend)
-        new_params, new_bstats, mean_loss, n_bad = self._sanitize_and_defend(
-            {"params": client_params, "batch_stats": client_bstats},
-            {"params": params, "batch_stats": bstats}, w, losses,
-            rngs=cs.rng)
-        if self.wire_spec is not None:
-            return new_params, new_bstats, mean_loss, n_bad, new_efs, u0
-        return new_params, new_bstats, mean_loss, n_bad
+    # ---------- legacy-signature program adapters ----------
+    # The builder's compiled programs take structured (carry, data,
+    # consts, ...) arguments; these adapters keep the historic per-engine
+    # call shapes the drivers and the bitwise-parity tests use.
 
     @functools.cached_property
     def _round_jit(self):
-        def round_fn(params, bstats, data, sampled_idx, rngs, lr,
-                     efs=None, byz=None):
-            Xs = jnp.take(data.X_train, sampled_idx, axis=0)
-            ys = jnp.take(data.y_train, sampled_idx, axis=0)
-            ns = jnp.take(data.n_train, sampled_idx, axis=0)
-            return self._round_body(params, bstats, Xs, ys, ns, rngs, lr,
-                                    efs, byz)
+        prog = self.program.round_jit()
 
-        # donation: the incoming global {params, bstats} and the sampled
-        # EF rows are consumed by the round — their buffers back the
-        # round's outputs; the driver snapshots (account_wire_bytes
-        # reference) BEFORE dispatch and never rereads donated args.
-        # The byz plan (arg 7) is tiny and never donated.
-        return jax.jit(round_fn,
-                       donate_argnums=self._donate_argnums(0, 1, 6))
+        def round_call(params, bstats, data, sampled_idx, rngs, lr,
+                       efs=None, byz=None):
+            return prog((params, bstats), data, (), sampled_idx, rngs,
+                        lr, efs, byz)
+
+        def lower(params, bstats, data, sampled_idx, rngs, lr,
+                  efs=None, byz=None):
+            # legacy-signature .lower passthrough (compile pins)
+            return prog.jit.lower((params, bstats), data, (),
+                                  sampled_idx, rngs, lr, efs, byz)
+
+        round_call.jit = prog.jit
+        round_call.lower = lower
+        return round_call
 
     def _sharded_round_jit(self, n_real: int):
         """The cohort-sharded round program (ISSUE 6): same signature and
         donation contract as ``_round_jit``, but ``sampled_idx``/``rngs``
-        cover the MESH-PADDED sampled set and the body shards the local-
-        training stage over the client mesh (``n_real`` static — fault-
-        schedule cohort shrinkage re-specializes via the plan cache)."""
-        def build():
-            def sharded_round_fn(params, bstats, data, sampled_idx, rngs,
-                                 lr, efs=None, byz=None):
-                Xs = jnp.take(data.X_train, sampled_idx, axis=0)
-                ys = jnp.take(data.y_train, sampled_idx, axis=0)
-                ns = jnp.take(data.n_train, sampled_idx, axis=0)
-                return self._round_body(params, bstats, Xs, ys, ns, rngs,
-                                        lr, efs, byz, n_real=n_real)
+        cover the MESH-PADDED sampled set and the builder shards the
+        local-training stage over the client mesh (``n_real`` static —
+        fault-schedule cohort shrinkage re-specializes via the plan
+        cache)."""
+        prog = self.program.round_jit(n_real=n_real)
 
-            return jax.jit(sharded_round_fn,
-                           donate_argnums=self._donate_argnums(0, 1, 6))
+        def sharded_round_call(params, bstats, data, sampled_idx, rngs,
+                               lr, efs=None, byz=None):
+            return prog((params, bstats), data, (), sampled_idx, rngs,
+                        lr, efs, byz)
 
-        return self._plan_cached("_sharded_round_jit_cache", n_real, build)
+        return sharded_round_call
 
     @functools.cached_property
     def _round_stream_jit(self):
-        return jax.jit(self._round_body,
-                       donate_argnums=self._donate_argnums(0, 1))
+        prog = self.program.stream_jit()
+
+        def stream_round_call(params, bstats, Xs, ys, ns, rngs, lr,
+                              efs=None, byz=None):
+            return prog((params, bstats), (), Xs, ys, ns, None, rngs,
+                        lr, efs, byz)
+
+        return stream_round_call
 
     # ---------- fused multi-round dispatch (ISSUE 4) ----------
 
-    def fused_fallback_reason(self) -> str | None:
-        return self._resident_fallback_reason()
-
-    def _fused_round_jit(self, k: int, n_real: int | None = None):
-        """K rounds as ONE dispatched program: a ``lax.scan`` over the
-        exact per-round body, consuming host-precomputed stacks of
-        sampling indices / per-client rngs / round lrs. Amortizes the
-        per-dispatch latency the sequential loop pays K times
-        (PROFILE.md round 2: a 16-step scan sustains 2.4x the
-        per-dispatch loop through the tunnel). ``n_real`` marks the
-        cohort-sharded variant (ISSUE 6): the scanned per-round body
-        shards its local-training stage over the client mesh, consuming
-        [K, P] mesh-padded index/rng stacks."""
-        def build():
-            def fused_round_fn(params, bstats, data, sampled_idx, rngs,
-                               lrs, byz=None):
-                def one_round(carry, xs):
-                    p, b = carry
-                    if byz is None:
-                        (si, rg, lr), bz = xs, None
-                    else:
-                        si, rg, lr, bz = xs
-                    Xs = jnp.take(data.X_train, si, axis=0)
-                    ys = jnp.take(data.y_train, si, axis=0)
-                    ns = jnp.take(data.n_train, si, axis=0)
-                    p, b, loss, bad = self._round_body(p, b, Xs, ys, ns,
-                                                       rg, lr, byz=bz,
-                                                       n_real=n_real)
-                    return (p, b), (loss, bad)
-
-                xs = ((sampled_idx, rngs, lrs) if byz is None
-                      else (sampled_idx, rngs, lrs, byz))
-                (params, bstats), (losses, bads) = jax.lax.scan(
-                    one_round, (params, bstats), xs)
-                return params, bstats, losses, bads
-
-            return jax.jit(fused_round_fn,
-                           donate_argnums=self._donate_argnums(0, 1))
-
-        return self._plan_cached("_fused_round_jit_cache", (k, n_real),
-                                 build)
-
     def _run_fused_window(self, params, bstats, round_idx: int, k: int):
-        """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan.
-        Sampling/rng/lr — and the Byzantine attack plan when the fault
-        schedule carries value faults — are precomputed on the host
-        round by round (the ``np.random.seed(round_idx)`` contract is
-        untouched). Returns ``(params, bstats, last_round_loss,
-        k_actual)`` — ``k_actual`` may shrink when the fault schedule
-        varies the cohort size."""
-        # the window IS a host boundary pair (ISSUE 9): the prologue and
-        # the dispatch are separate host spans — "dispatch" measures the
-        # enqueue only (async dispatch races ahead; the sync lands at
-        # the next eval/flush boundary, never here)
-        with obs_trace.span("window", round=round_idx, k=k):
-            with obs_trace.span("window_host_prologue", round=round_idx):
-                (_, idx, rngs, lrs, byz, k,
-                 n_real) = self._window_host_inputs(round_idx, k)
-            with obs_trace.span("dispatch", round=round_idx, k=k):
-                params, bstats, losses, bads = self._fused_round_jit(
-                    k, n_real)(params, bstats, self.data, idx, rngs,
-                               lrs, byz)
-        self._note_nonfinite(bads)
-        return params, bstats, losses[-1], k
-
-    def _fused_round_stream_jit(self, k: int):
-        """K STREAMED rounds as one dispatched program (ISSUE 10): a
-        ``lax.scan`` over the exact streamed per-round body, consuming
-        the window's prefetched ``[K, S, nmax, ...]`` shard stacks one
-        round per step — the window-granular analog of
-        ``_fused_round_jit`` for cohorts that live on the host. The
-        carried {params, bstats} are donated like every round program's;
-        the uint8/int32 shard stacks are NOT — no output shares their
-        dtype/shape, so the donation would be unusable (XLA warns and
-        ignores it) and the buffers die at end of dispatch anyway."""
-        def build():
-            def fused_stream_fn(params, bstats, Xs, ys, ns, rngs, lrs,
-                                byz=None):
-                def one_round(carry, xs):
-                    p, b = carry
-                    if byz is None:
-                        (X, y, n, rg, lr), bz = xs, None
-                    else:
-                        X, y, n, rg, lr, bz = xs
-                    p, b, loss, bad = self._round_body(p, b, X, y, n, rg,
-                                                       lr, byz=bz)
-                    return (p, b), (loss, bad)
-
-                xs = ((Xs, ys, ns, rngs, lrs) if byz is None
-                      else (Xs, ys, ns, rngs, lrs, byz))
-                (params, bstats), (losses, bads) = jax.lax.scan(
-                    one_round, (params, bstats), xs)
-                return params, bstats, losses, bads
-
-            return jax.jit(fused_stream_fn,
-                           donate_argnums=self._donate_argnums(0, 1))
-
-        return self._plan_cached("_fused_round_stream_jit_cache", k, build)
+        """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan
+        (program.run_window: host prologue + ONE compiled program).
+        Returns ``(params, bstats, last_round_loss, k_actual)`` —
+        ``k_actual`` may shrink when the fault schedule varies the
+        cohort size."""
+        (params, bstats), _, outs, wi = self.program.run_window(
+            (params, bstats), round_idx, k)
+        return params, bstats, outs["loss"][-1], wi.k
 
     def _stream_prefetch_for(self, round_idx: int) -> None:
         """Kick off the streamed feed for whatever the driver will
@@ -338,7 +181,7 @@ class FedAvgEngine(FederatedEngine):
         if fuse:
             k = self._dispatch_window(round_idx)
             if k > 1:
-                sampled, k = self._window_sampling(round_idx, k)
+                sampled, k = self.program.window_sampling(round_idx, k)
                 pads = [self.stream_sampling(round_idx + off, sampled=s)
                         for off, s in enumerate(sampled)]
                 self.stream.prefetch_window([p[0] for p in pads],
@@ -349,20 +192,20 @@ class FedAvgEngine(FederatedEngine):
     def _run_fused_stream_window(self, params, bstats, round_idx: int,
                                  k: int):
         """Dispatch streamed rounds ``[round_idx, round_idx + k)`` as one
-        scan over the prefetched window stack, then immediately queue the
-        NEXT window's host read + device transfer behind this window's
-        compute (the dispatch returns asynchronously; the boundary hooks
-        block later). Returns ``(params, bstats, last_round_loss,
-        k_actual)``."""
+        scan over the prefetched window stack (ISSUE 10), then
+        immediately queue the NEXT window's host read + device transfer
+        behind this window's compute (the dispatch returns
+        asynchronously; the boundary hooks block later). Returns
+        ``(params, bstats, last_round_loss, k_actual)``."""
         with obs_trace.span("window", round=round_idx, k=k, stream=True):
             with obs_trace.span("window_host_prologue", round=round_idx):
                 (ids_per_round, rngs, lrs, byz, k,
-                 n_real) = self._window_stream_inputs(round_idx, k)
+                 n_real) = self.program.stream_window_inputs(round_idx, k)
                 Xs, ys, ns = self.stream.get_window(ids_per_round, n_real)
                 self._stream_prefetch_for(round_idx + k)
             with obs_trace.span("dispatch", round=round_idx, k=k):
-                params, bstats, losses, bads = self._fused_round_stream_jit(
-                    k)(params, bstats, Xs, ys, ns, rngs, lrs, byz)
+                params, bstats, losses, bads = self.program.fused_stream_jit(
+                    k)((params, bstats), (), Xs, ys, ns, rngs, lrs, byz)
         self._note_nonfinite(bads)
         return params, bstats, losses[-1], k
 
@@ -395,9 +238,10 @@ class FedAvgEngine(FederatedEngine):
         # when armed (the full cohort already tiles the mesh: the data
         # layer pads num_clients to a device multiple; permutations
         # hoisted out of the shard_map like the round's —
-        # base._cohort_local_stage)
+        # program.cohort_local_stage)
         if self._cohort_on and C % self.mesh.devices.size == 0:
-            cs, _ = self._cohort_local_stage(local, cs, X, y, n)
+            cs, _ = round_program.cohort_local_stage(self, local, cs,
+                                                     X, y, n)
         else:
             cs, _ = jax.vmap(local)(cs, X, y, n)
         return cs

@@ -1,0 +1,951 @@
+"""Declarative round-program builder (ISSUE 11, ROADMAP item 1).
+
+Every federated round in this tree has the same skeleton:
+
+    sample -> [local-train] -> [attack] -> [codec] -> sanitize ->
+    defend -> aggregate -> [update persistent state] -> privacy-account
+
+but until this module each engine hand-rolled the skeleton into its own
+``_round_jit`` / ``_fused_round_jit`` / ``_sharded_round_jit`` bodies, so
+the fast-path machinery built over ISSUEs 4-10 — fused K-round
+``lax.scan`` dispatch, ``--client_mesh`` cohort sharding, buffer
+donation, Byzantine defenses, the wire codec — reached only the engines
+that had copied the machinery in (fedavg/fedprox/salientgrads), and
+every other engine collapsed to K=1 unfused sequential dispatch with a
+logged reason.
+
+This module inverts the ownership. An engine DECLARES its round as a
+:class:`RoundStages` value — which pytrees it carries between rounds,
+its local-training stage, optionally a custom aggregation and a
+persistent-state update stage — and :class:`RoundProgram` compiles the
+declaration into the exact jitted round bodies the hand-written paths
+produced, with the orthogonal knobs applied by the BUILDER:
+
+- buffer donation of the carried state (+ codec EF rows) on every
+  compiled program (ISSUE 4 contract, donation-discipline lint);
+- ``--rounds_per_dispatch K`` window planning and the fused
+  ``lax.scan`` driver, hooks pinned to window boundaries (ISSUE 4);
+- ``--client_mesh`` cohort sharding of the local-train stage with the
+  epoch-permutation hoist the toolchain requires (ISSUE 6,
+  parallel/cohort.py — in-partition argsort miscompiles);
+- the Byzantine attack plan + non-finite guard + ``--defense`` dispatch
+  (ISSUE 5) and the wire codec's lossy roundtrip with error feedback
+  (ISSUE 3) on engines whose stages opt in.
+
+fedavg/fedprox/salientgrads ride the builder with BITWISE parity against
+their pre-builder paths (the regression oracle: tests/test_dispatch.py,
+test_cohort.py, test_byzantine.py pins are unchanged); ditto, dpsgd and
+subavg are expressed as stage declarations and gain fused windows and
+cohort sharding for the first time (tests/test_program.py).
+
+Fallback reporting is unified here too: :data:`REASONS` is the single
+source of truth for every "falls back with a logged reason" site, and
+:func:`report_fallback` increments the structured
+``nidt_fallback_total{plane, engine, reason}`` counter in the obs
+registry alongside the log line — fast-path coverage is scrapeable, not
+grep-able.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.core import robust
+from neuroimagedisttraining_tpu.faults import adversary
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
+from neuroimagedisttraining_tpu.parallel import cohort
+
+PyTree = Any
+
+# ---------------------------------------------------------------------------
+# fallback reason table — the single source of truth (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+#: reason key -> (plane, message). Every "falls back with a logged
+#: reason" site in the tree resolves its message HERE; engines override
+#: ``*_fallback_key`` hooks with keys from this table, never ad-hoc
+#: strings (tests/test_program.py asserts no orphaned or unknown keys).
+REASONS: dict[str, tuple[str, str]] = {
+    # -- fused multi-round dispatch (plane "fused") --
+    "no-fused-body": ("fused", (
+        "engine has no fused round body (host-side state between "
+        "rounds)")),
+    "streaming-host-data": ("fused", (
+        "streaming rounds cross the host for data every round")),
+    "wire-codec-host-bytes": ("fused", (
+        "--wire_codec accounts encoded bytes on the host every round")),
+    "mpc-host-stage": ("fused", (
+        "the MPC aggregation stage is host-driven between rounds")),
+    # -- cohort sharding (plane "sharding") --
+    "no-sharded-body": ("sharding", (
+        "engine has no cohort-sharded round body (its round crosses the "
+        "host or exchanges per-client state outside the declared-stage "
+        "shape)")),
+    "two-level-mesh": ("sharding", (
+        "two-level (silos, clients) mesh routes aggregation silo-first "
+        "(parallel/hierarchical.py); cohort sharding arms on 1-D client "
+        "meshes")),
+    "one-device": ("sharding", (
+        "only one device visible — the unsharded round IS the "
+        "single-device program")),
+    "streaming-sharded-feed": ("sharding", (
+        "streaming rounds host-stage each round's shards; the streamed "
+        "feed already device_puts them client-sharded over the mesh")),
+    "batch-order-replacement": ("sharding", (
+        "batch_order=replacement draws per-step randint batches inside "
+        "the shard_map partition, where the partitioned RNG+gather "
+        "lowering miscompiles on this toolchain (measured, "
+        "parallel/cohort.py); the shuffle path hoists its permutations "
+        "out of the partition — i.i.d. per-step draws cannot be "
+        "hoisted")),
+    "gossip-mesh-collectives": ("sharding", (
+        "dispfl's decentralized round already runs client-sharded "
+        "gossip collectives on the mesh (parallel/gossip.py); "
+        "--client_mesh adds nothing")),
+    "mpc-host-boundary": ("sharding", (
+        "turboaggregate's round crosses the host at the MPC share "
+        "boundary every round (quantize/share/aggregate models the "
+        "client<->server link); no sharded round body")),
+    "cohort-not-tiling": ("sharding", (
+        "the full client axis does not tile the client mesh (the data "
+        "layer pads resident cohorts to a device multiple; this one is "
+        "not)")),
+    # -- the distributed transport (distributed/run.py startup notes) --
+    "distributed-control-plane": ("fused", (
+        "the distributed transport dispatches one round at a time "
+        "(every round crosses the control plane: broadcast/upload/"
+        "aggregate over sockets)")),
+    "distributed-no-client-axis": ("sharding", (
+        "the distributed transport has no in-process client axis to "
+        "shard (each rank trains its own silo) — flag accepted for "
+        "config parity with the main CLI only")),
+}
+
+
+def reason(key: str) -> str:
+    """The logged message for a fallback ``key`` (KeyError on unknown
+    keys — an engine naming a reason outside the table is a bug)."""
+    return REASONS[key][1]
+
+
+def report_fallback(engine_name: str, key: str) -> str:
+    """Count one structured fallback announcement and return its message.
+    The caller owns the log line (each site keeps its historic wording
+    around the message); the counter is the scrapeable half:
+    ``nidt_fallback_total{plane, engine, reason}``."""
+    plane, msg = REASONS[key]
+    obs_metrics.counter(
+        "nidt_fallback_total",
+        "fast-path fallback announcements by plane (fused dispatch / "
+        "cohort sharding / fused streaming), engine, and reason key "
+        "(engines/program.py REASONS)",
+        labelnames=("plane", "engine", "reason"),
+    ).labels(plane=plane, engine=engine_name, reason=key).inc()
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# stage declarations
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TrainOut:
+    """What an engine's local-train stage hands the downstream stages.
+
+    Every array field is CLIENT-STACKED along axis 0 over the round's
+    cohort; on the cohort-sharded path the builder statically slices the
+    mesh-pad rows off all of them before the attack/codec/defense tail.
+
+    - ``upload``: the ``{"params", "batch_stats"}`` payload the clients
+      would put on the wire (what attack/codec/sanitize/defend consume),
+      or None when the engine's custom aggregate stage consumes ``extra``
+      directly.
+    - ``losses``: per-client training losses ``[C]``.
+    - ``state``: the trained per-client state (``ClientState``) — its
+      ``rng`` leaves seed the weak_dp defense, and update stages scatter
+      from its params/batch_stats (the client's HONEST local result,
+      pre-attack/codec by design).
+    - ``extra``: engine-private client-stacked auxiliaries for the
+      aggregate/update stages.
+    """
+
+    losses: jax.Array
+    upload: dict | None = None
+    state: Any = None
+    extra: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class RoundStages:
+    """An engine's declared round: the builder compiles this (and only
+    this) into every dispatch variant — single-round, cohort-sharded,
+    fused K-round windows, streamed — with donation, window planning and
+    the attack/codec/defense stages applied by the builder.
+
+    ``carry``: names of the device pytrees carried round to round, in
+    program-argument (and return) order; all are donated.
+    ``consts``: loop-constant operands after the federation data (e.g.
+    salientgrads' phase-1 mask).
+    ``per_round``: per-round operand names beyond the builder-owned
+    sampling/rng/lr (e.g. dpsgd's mixing matrix) — stacked along K in
+    fused windows.
+    ``train``: the local-train stage, ``(ctx: RoundCtx) -> TrainOut``.
+    ``aggregate``: custom aggregation stage
+    ``(ctx, upload, w, tr) -> (new_carry: dict, outs: dict)``; None
+    routes through the builder's sanitize -> defend -> weighted-mean
+    tail (:func:`sanitize_defend_aggregate`).
+    ``update``: persistent per-client state stage
+    ``(ctx, tr, new_carry) -> dict`` of carry updates (scatters).
+    ``epilogue``: window-final outputs derived from the carry
+    ``(eng, carry: dict, data) -> tuple`` (e.g. dpsgd's ``w_global``) —
+    computed once per dispatch, after the scan.
+    ``outputs``: names of the per-round scalar outputs, stacked ``[K]``
+    over fused windows. ``"n_bad"`` wires into the engine's non-finite
+    accounting automatically.
+    ``gathers_cohort``: the builder gathers the sampled clients' shards
+    from the federation data by ``sampled_idx`` (False: the train stage
+    consumes the full data, dpsgd-style).
+    ``uses_ef``: the program takes (and donates) wire-codec
+    error-feedback rows and returns the updated rows + the
+    byte-accounting sample ``u0``.
+    ``supports_attack``: the program takes the [C]-planned Byzantine
+    attack and applies it to ``upload`` before codec/defense.
+    ``codec_masks``: ``(ctx) -> masks_full`` handed to the codec
+    roundtrip (salientgrads' phase-1 mask handoff), or None.
+    ``window_extras``: custom window prologue for engines whose rounds
+    consume ``per_round`` operands, ``(round_idx, k) -> WindowInputs``.
+    ``extra_hooked``: extra host-boundary predicate for the window
+    planner (e.g. dpsgd's every-100-rounds fine-tune pass).
+    """
+
+    carry: tuple[str, ...]
+    train: Callable
+    aggregate: Callable | None = None
+    update: Callable | None = None
+    epilogue: Callable | None = None
+    outputs: tuple[str, ...] = ("loss", "n_bad")
+    consts: tuple[str, ...] = ()
+    per_round: tuple[str, ...] = ()
+    gathers_cohort: bool = True
+    uses_ef: bool = False
+    supports_attack: bool = False
+    codec_masks: Callable | None = None
+    window_extras: Callable | None = None
+    extra_hooked: Callable | None = None
+
+
+@dataclasses.dataclass
+class WindowInputs:
+    """Host prologue of one fused window (see
+    :meth:`RoundProgram.window_inputs`)."""
+
+    sampled: list | None
+    idx: jax.Array | None
+    rngs: jax.Array
+    lrs: jax.Array
+    byz: tuple | None
+    k: int
+    n_real: int | None
+    static_key: Any = None
+    per_round: dict | None = None
+
+
+class RoundCtx:
+    """Everything a stage sees about the round being traced. Built by
+    the program body; stages read operands off it and use
+    :meth:`client_map` for their per-client loops so the builder decides
+    vmap vs the cohort-sharded mesh loop."""
+
+    def __init__(self, eng, stages: RoundStages, carry: dict, data,
+                 consts: dict, Xs, ys, ns, sampled_idx, rngs, lr,
+                 per_round: dict, static_key, n_real, sharded: bool):
+        self.eng = eng
+        self.stages = stages
+        self.carry = carry
+        self.data = data
+        self.consts = consts
+        self.Xs, self.ys, self.ns = Xs, ys, ns
+        self.sampled_idx = sampled_idx
+        self.rngs = rngs
+        self.lr = lr
+        self.per_round = per_round
+        self.static = static_key
+        self.n_real = n_real
+        self.sharded = sharded
+
+    # -- the local-train placement contract (ISSUE 6) --
+
+    def client_map(self, fn, *stacked, hoisted: tuple = ()):
+        """Run the unbatched per-client ``fn`` over the client-stacked
+        operands: plain ``vmap`` on the unsharded path (bitwise-identical
+        to the pre-builder engines), the cohort-sharded mesh loop
+        (``FederatedEngine._cohort_map`` -> parallel/cohort.py) when this
+        program was built sharded. ``hoisted`` are thunks producing extra
+        client-stacked operands passed ONLY on the sharded path — the
+        epoch-permutation hoist that keeps argsort-lowered RNG out of the
+        shard_map partition (the measured miscompile,
+        parallel/cohort.py); ``fn`` takes them as trailing defaulted
+        params."""
+        if self.sharded:
+            extra = tuple(h() for h in hoisted)
+            return self.eng._cohort_map(fn, *stacked, *extra)
+        return jax.vmap(fn)(*stacked)
+
+    def local_perms(self, rngs, ns, epochs: int):
+        """Hoisted per-client epoch permutations for a sharded
+        local-train stage: exactly what each client's ``local_train``
+        would derive from ``rngs`` (core/trainer.py ``epoch_perms_for``),
+        computed OUTSIDE the shard_map partition."""
+        return hoisted_epoch_perms(self.eng, rngs, ns, epochs)
+
+    def rng_after_local_train(self, rngs, epochs: int):
+        """The per-client rng values ``local_train`` leaves in
+        ``cs.rng`` after ``epochs`` epochs — the entry rngs of a SECOND
+        ``local_train`` call in the same per-client stage (subavg's
+        epoch-1 / tail split), replayed outside the partition so the
+        tail call's permutations can be hoisted too. Mirrors
+        ``local_train``'s stream exactly: one (rng0, perm) split at
+        entry, then one 3-way split per scan step."""
+        import math
+
+        o = self.eng.cfg.optim
+        steps = epochs * max(1, math.ceil(self.eng._max_samples()
+                                          / o.batch_size))
+
+        def chain(rng):
+            r0, _ = jax.random.split(rng)
+
+            def step(r, _):
+                return jax.random.split(r, 3)[0], None
+
+            r, _ = jax.lax.scan(step, r0, None, length=steps)
+            return r
+
+        return jax.vmap(chain)(rngs)
+
+    @property
+    def upload_ref(self) -> dict:
+        """The broadcast reference the attack/codec/sanitize stages
+        compare uploads against: the round's incoming global model."""
+        return {"params": self.carry["params"],
+                "batch_stats": self.carry["batch_stats"]}
+
+
+# ---------------------------------------------------------------------------
+# builder-owned stages
+# ---------------------------------------------------------------------------
+
+
+def hoisted_epoch_perms(eng, rngs, ns, epochs: int):
+    """The per-client epoch permutations ``local_train`` would derive
+    from ``rngs``, vmapped over the cohort — computed OUTSIDE a
+    shard_map partition (the argsort-lowered permutation MISCOMPILES
+    inside one on this toolchain; parallel/cohort.py documents the
+    measurement) and passed in via ``perms=``. The rng stream is
+    identical either way."""
+    from neuroimagedisttraining_tpu.core.trainer import epoch_perms_for
+
+    ms = eng._max_samples()
+    return jax.vmap(
+        lambda r, n: epoch_perms_for(r, epochs, ms, n))(rngs, ns)
+
+
+def cohort_local_stage(eng, fn, cs, Xs, ys, ns):
+    """A hoisted-perms cohort-sharded local stage for driver code
+    OUTSIDE the round program (fedavg's final fine-tune pass): hoist the
+    epoch permutations from ``cs.rng``, then run the per-client loop
+    under the client mesh. Cohort sharding only arms under
+    ``batch_order=shuffle`` (the program's mode checks), so hoistable
+    perms always exist here."""
+    perms = hoisted_epoch_perms(eng, cs.rng, ns, eng.cfg.optim.epochs)
+    return eng._cohort_map(fn, cs, Xs, ys, ns, perms)
+
+
+def sanitize_defend_aggregate(eng, upload, ref, w, losses, rngs=None):
+    """The shared tail of a defended round body (trace-safe; the builder
+    runs it for every engine without a custom aggregate stage):
+
+    1. non-finite upload guard (runs with or without ``--defense``): a
+       single NaN/Inf client would poison ``tree_weighted_mean``, so its
+       row is swapped for the broadcast ``ref`` and zero-weighted (the
+       count comes back as ``n_bad``);
+    2. defense dispatch (core/robust.py): order-statistic defenses
+       consume the whole upload payload (a Byzantine silo poisons its
+       batch_stats too) and replace the weighted mean; the clip family
+       transforms params per client (batch_stats are never clipped —
+       structural parity with ``is_weight_param``,
+       robust_aggregation.py:28-29) then reduces with the engine's
+       silo-aware ``aggregate``. A cohort too small for the configured
+       aggregator (fault-schedule shrinkage) falls back to the plain
+       mean with a warning — resolved at trace time, the cohort axis is
+       static.
+
+    ``upload``/``ref`` are ``{"params", "batch_stats"}`` dicts (stacked /
+    unstacked); ``rngs`` are the per-client keys weak_dp noise draws
+    from. Returns ``(new_params, new_bstats, mean_loss, n_bad)``."""
+    f = eng.cfg.fed
+    finite = robust.finite_per_client(upload)
+    upload = robust.replace_nonfinite_clients(upload, ref, finite)
+    n_bad = jnp.sum(~finite).astype(jnp.int32)
+    w = w * finite.astype(jnp.float32)
+    C = int(jax.tree.leaves(upload)[0].shape[0])
+    defense = robust.effective_defense(f.defense_type, C, f.byz_f,
+                                       warn=eng.log.warning)
+    if defense in robust.ROBUST_AGGREGATORS:
+        agg = robust.robust_aggregate(
+            upload, w, defense=defense, byz_f=f.byz_f,
+            geomed_iters=f.geomed_iters)
+        new_params, new_bstats = agg["params"], agg["batch_stats"]
+    else:
+        client_params = robust.defend_stacked(
+            upload["params"], ref["params"], defense=defense,
+            norm_bound=f.norm_bound, stddev=f.stddev, rngs=rngs)
+        new_params = eng.aggregate(client_params, w)
+        new_bstats = eng.aggregate(upload["batch_stats"], w)
+    safe_losses = jnp.where(jnp.isfinite(losses), losses, 0.0)
+    mean_loss = jnp.sum(safe_losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+    return new_params, new_bstats, mean_loss, n_bad
+
+
+def _codec_stage(eng, stages: RoundStages, ctx: RoundCtx, upload, efs):
+    """The wire codec's lossy roundtrip over the whole upload payload
+    (codec/device.py) — delta vs the round's broadcast reference,
+    optional top-k with per-client error feedback (``uses_ef`` engines),
+    mask handoff for engines that own one (``codec_masks``),
+    quantization. Returns ``(decoded_upload, new_efs, u0)`` where ``u0``
+    is client 0's decoded upload for the host-side byte accounting."""
+    from neuroimagedisttraining_tpu.codec import device as codec_dev
+
+    spec = eng.wire_spec
+    ref = ctx.upload_ref
+    masks_full = stages.codec_masks(ctx) if stages.codec_masks else None
+    new_efs = None
+    if stages.uses_ef and spec.needs_ef:
+        dec, new_efs = jax.vmap(
+            lambda u, e: codec_dev.lossy_roundtrip(
+                spec, u, reference=ref, ef=e))(upload, efs)
+        # a non-finite upload row (byz nonfinite attack, diverged
+        # optimizer) would park NaN in the EF stack FOREVER — EF =
+        # u - decode(u) is NaN, and every later encode consumes it, so
+        # the guard would zero-weight the client for the rest of the
+        # run. Zero those rows so the value fault stays transient (the
+        # engine-side mirror of the server's post-quarantine
+        # ARG_EF_RESET invariant).
+        fin = robust.finite_per_client(upload)
+        new_efs = jax.tree.map(
+            lambda e: jnp.where(
+                fin.reshape((-1,) + (1,) * (e.ndim - 1)),
+                e, jnp.zeros_like(e)), new_efs)
+    else:
+        dec, _ = jax.vmap(
+            lambda u: codec_dev.lossy_roundtrip(
+                spec, u, reference=ref, masks=masks_full))(upload)
+    u0 = jax.tree.map(lambda x: x[0], dec)
+    return dec, new_efs, u0
+
+
+# ---------------------------------------------------------------------------
+# the program builder
+# ---------------------------------------------------------------------------
+
+
+class RoundProgram:
+    """Compiles an engine's :class:`RoundStages` declaration into every
+    dispatch variant and owns the window planning + fallback reporting
+    that drives them. One instance per engine
+    (``FederatedEngine.program``); compiled programs are cached on the
+    ENGINE under the historic cache names
+    (``_fused_round_jit_cache`` etc.), so the one-compiled-program-per-
+    window pins keep reading the same place.
+
+    ``built`` counts program compilations (cache misses); ``dispatches``
+    counts compiled-program invocations — the bench's
+    dispatch-amortization evidence (bench.py ``round_program`` cell).
+    """
+
+    def __init__(self, eng, stages: RoundStages | None):
+        if stages is not None and stages.uses_ef \
+                and stages.codec_masks is not None:
+            # lossy_roundtrip tracks EF only when masks are absent
+            # (codec/device.py: the mask handoff REPLACES top-k error
+            # feedback), so a declaration naming both would silently
+            # drop one of them inside _codec_stage
+            raise ValueError(
+                f"{type(eng).__name__} declares both uses_ef and "
+                "codec_masks: the codec's mask handoff replaces error "
+                "feedback — declare one")
+        self.eng = eng
+        self.stages = stages
+        self.built = 0
+        self.dispatches = 0
+
+    # ---------- fallback reporting ----------
+
+    def fused_fallback_key(self) -> str | None:
+        """Why the engine dispatches one round at a time even when
+        ``--rounds_per_dispatch K`` asks for fused windows — a
+        :data:`REASONS` key, or None when the declared stages support
+        the K-round scan driver. Resident-mode checks shared by every
+        declared engine: streaming feeds cross the host per round
+        (unless the engine fuses streamed windows), and the wire codec
+        accounts bytes on the host per round."""
+        if self.stages is None:
+            return "no-fused-body"
+        if self.eng.stream is not None \
+                and not self.eng.supports_fused_streaming:
+            return "streaming-host-data"
+        if self.eng.wire_spec is not None:
+            return "wire-codec-host-bytes"
+        return None
+
+    def cohort_fallback_key(self) -> str | None:
+        """Why the engine runs unsharded even when ``--client_mesh``
+        asks for the cohort-sharded mesh — a :data:`REASONS` key, or
+        None when the sharded path arms (mode checks shared by every
+        capable engine; mirrors the fused contract)."""
+        eng = self.eng
+        if self.stages is None or not eng.supports_cohort_sharding:
+            return eng.cohort_fallback_key()
+        if eng.mesh is not None and len(eng.mesh.axis_names) != 1:
+            return "two-level-mesh"
+        if eng.mesh is not None and eng.mesh.devices.size == 1:
+            return "one-device"
+        if eng.stream is not None:
+            return "streaming-sharded-feed"
+        if eng.cfg.optim.batch_order != "shuffle":
+            return "batch-order-replacement"
+        if not self.stages.gathers_cohort \
+                and eng.num_clients % eng.mesh.devices.size != 0:
+            return "cohort-not-tiling"
+        return None
+
+    # ---------- window planning (ISSUE 4, absorbed from base.py) ----------
+
+    def dispatch_window(self, round_idx: int) -> int:
+        """Length of the fused window starting at ``round_idx``: grows
+        up to ``rounds_per_dispatch`` but stops so that any round with a
+        host-side hook — eval (``frequency_of_the_test``), checkpoint
+        (``checkpoint_every``), the final round, an engine-declared
+        extra hook — lands on the WINDOW BOUNDARY, where the driver runs
+        the hooks exactly as the sequential loop would have. Interior
+        rounds are hook-free by construction, so fusing changes no
+        observable behavior."""
+        eng = self.eng
+        f = eng.cfg.fed
+        K = max(1, int(f.rounds_per_dispatch))
+        extra = self.stages.extra_hooked if self.stages else None
+
+        def hooked(r: int) -> bool:
+            return (r % f.frequency_of_the_test == 0
+                    or r == f.comm_round - 1
+                    or (eng._ckpt_active()
+                        and (r + 1) % eng.cfg.checkpoint_every == 0)
+                    or (extra is not None and extra(r)))
+
+        k = 1
+        while (k < K and round_idx + k < f.comm_round
+               and not hooked(round_idx + k - 1)):
+            k += 1
+        return k
+
+    def window_sampling(self, round_idx: int, k: int
+                        ) -> tuple[list[np.ndarray], int]:
+        """Host-precomputed per-round cohorts for a fused window,
+        preserving the reference's ``np.random.seed(round_idx)``
+        sampling contract round by round. The scan needs one static
+        cohort size, so when a fault schedule varies the survivor count
+        mid-window the window shrinks to the maximal equal-size prefix
+        (still fused, still bit-identical cohorts)."""
+        eng = self.eng
+        sampled = [eng.client_sampling(r)
+                   for r in range(round_idx, round_idx + k)]
+        keep = 1
+        while keep < len(sampled) and \
+                len(sampled[keep]) == len(sampled[0]):
+            keep += 1
+        return sampled[:keep], keep
+
+    def window_inputs(self, round_idx: int, k: int) -> WindowInputs:
+        """Host prologue of a fused window: per-round cohorts (via
+        ``window_sampling``, which may shrink ``k``), the per-round log
+        lines the sequential loop would have emitted, and the stacked
+        device inputs for the scan — including the [K, C]-stacked
+        Byzantine attack plan when the fault schedule carries value
+        faults. With cohort sharding armed, ``idx`` and ``rngs`` cover
+        the mesh-padded per-round sets ([K, P]) while the byz plan stays
+        on the REAL sampled sets (the sharded round body slices pad rows
+        off before the attack/defense tail); ``n_real`` is the static
+        real cohort size (None when unsharded). Engines with
+        ``window_extras`` (per-round operands, no cohort sampling) build
+        their own."""
+        if self.stages is not None and self.stages.window_extras:
+            return self.stages.window_extras(round_idx, k)
+        eng = self.eng
+        sampled, k = self.window_sampling(round_idx, k)
+        for off, s in enumerate(sampled):
+            eng.log.info("################ round %d: clients %s (fused "
+                         "window of %d)", round_idx + off, s.tolist(), k)
+        if eng._cohort_on:
+            ids = [eng._cohort_pad(s)[0] for s in sampled]
+            n_real = len(sampled[0])
+        else:
+            ids, n_real = sampled, None
+        idx = jnp.asarray(np.stack(ids))
+        rngs = jnp.stack([eng.per_client_rngs(round_idx + off, s)
+                          for off, s in enumerate(ids)])
+        lrs = jnp.asarray([eng.round_lr(round_idx + off)
+                           for off in range(k)], jnp.float32)
+        byz = None
+        if eng._byz_on():
+            plans = [eng._byz_round_plan(round_idx + off, s)
+                     for off, s in enumerate(sampled)]
+            byz = tuple(jnp.stack([p[i] for p in plans])
+                        for i in range(4))
+        return WindowInputs(sampled=sampled, idx=idx, rngs=rngs, lrs=lrs,
+                            byz=byz, k=k, n_real=n_real)
+
+    def stream_window_inputs(self, round_idx: int, k: int):
+        """Host prologue of a fused STREAMED window (ISSUE 10): the
+        per-round cohorts (``window_sampling`` — may shrink ``k``), each
+        round's mesh-tiling padded id set (``stream_sampling`` — pads
+        train as zero-weight no-ops exactly like the round-granular
+        feed), the stacked per-round rngs/lrs over the PADDED ids (what
+        the streamed round body consumes), and the [K, P]-stacked byz
+        plan over the padded ids. Returns
+        ``(ids_per_round, rngs, lrs, byz, k, n_real)``."""
+        eng = self.eng
+        sampled, k = self.window_sampling(round_idx, k)
+        padded = [eng.stream_sampling(round_idx + off, sampled=s)
+                  for off, s in enumerate(sampled)]
+        ids_per_round = [p[0] for p in padded]
+        n_real = padded[0][1]
+        for off, s in enumerate(sampled):
+            eng.log.info("################ round %d (stream): clients %s "
+                         "(fused window of %d)", round_idx + off,
+                         s.tolist(), k)
+        rngs = jnp.stack([eng.per_client_rngs(round_idx + off, ids)
+                          for off, ids in enumerate(ids_per_round)])
+        lrs = jnp.asarray([eng.round_lr(round_idx + off)
+                           for off in range(k)], jnp.float32)
+        byz = None
+        if eng._byz_on():
+            plans = [eng._byz_round_plan(round_idx + off, ids)
+                     for off, ids in enumerate(ids_per_round)]
+            byz = tuple(jnp.stack([p[i] for p in plans])
+                        for i in range(4))
+        return ids_per_round, rngs, lrs, byz, k, n_real
+
+    # ---------- the round body, composed from the declared stages ----------
+
+    def _gather(self, data, idx):
+        Xs = jnp.take(data.X_train, idx, axis=0)
+        ys = jnp.take(data.y_train, idx, axis=0)
+        ns = jnp.take(data.n_train, idx, axis=0)
+        return Xs, ys, ns
+
+    def _body(self, carry_vals: tuple, data, const_vals: tuple, Xs, ys,
+              ns, idx, rngs, lr, efs, byz, per_round_vals, static_key,
+              n_real, sharded: bool):
+        """One round: the declared stages in builder order. Returns
+        ``(new_carry: dict, outs: dict, efs_tail: tuple)``."""
+        eng, st = self.eng, self.stages
+        carry = dict(zip(st.carry, carry_vals))
+        consts = dict(zip(st.consts, const_vals))
+        per_round = dict(zip(st.per_round, per_round_vals or ()))
+        if n_real is not None:
+            ns = cohort.pad_row_weights(ns, n_real)
+        ctx = RoundCtx(eng, st, carry, data, consts, Xs, ys, ns, idx,
+                       rngs, lr, per_round, static_key, n_real, sharded)
+        tr = st.train(ctx)
+        S = int(tr.losses.shape[0])
+        if n_real is not None and n_real < S:
+            # static slice: drop the mesh-pad rows before the
+            # attack/codec/defense/aggregate/update tail — it executes
+            # the identical operations the sequential C-loop executes
+            # (parallel/cohort.py contract)
+            sl = lambda t: jax.tree.map(lambda x: x[:n_real], t)
+            tr = TrainOut(losses=sl(tr.losses),
+                          upload=sl(tr.upload) if tr.upload is not None
+                          else None,
+                          state=sl(tr.state) if tr.state is not None
+                          else None,
+                          extra=sl(tr.extra))
+            ns = ns[:n_real]
+            ctx.ns = ns
+            if idx is not None:
+                ctx.sampled_idx = idx[:n_real]
+        w = ns.astype(jnp.float32)
+        upload = tr.upload
+        new_efs = u0 = None
+        if byz is not None:
+            if not st.supports_attack:
+                # trace-time consistency check: the ctor's
+                # supports_byz_faults gate should make this unreachable,
+                # but the declaration is the builder's contract — a plan
+                # reaching stages that never declared the attack stage
+                # is a bug, not a silent skip
+                raise ValueError(
+                    f"{type(eng).__name__}: byz attack plan reached a "
+                    "RoundStages declaration without supports_attack")
+            # the attack hits the WHOLE upload payload (params + batch
+            # stats — what the wire ships) before any encoding; honest
+            # clients ride the plan's identity rows bitwise-untouched
+            mult, std, nonfinite, keys = byz
+            upload = adversary.apply_attack_stacked(
+                upload, ctx.upload_ref, mult, std, nonfinite, keys)
+        if eng.wire_spec is not None:
+            upload, new_efs, u0 = _codec_stage(eng, st, ctx, upload, efs)
+        if st.aggregate is None:
+            rng_leaf = tr.state.rng if tr.state is not None else None
+            new_params, new_bstats, mean_loss, n_bad = \
+                sanitize_defend_aggregate(eng, upload, ctx.upload_ref, w,
+                                          tr.losses, rngs=rng_leaf)
+            new_carry = {"params": new_params, "batch_stats": new_bstats}
+            outs = {"loss": mean_loss, "n_bad": n_bad}
+        else:
+            new_carry, outs = st.aggregate(ctx, upload, w, tr)
+        if st.update is not None:
+            new_carry.update(st.update(ctx, tr, new_carry))
+        missing = set(st.carry) - set(new_carry)
+        assert not missing, f"stages left carry entries unset: {missing}"
+        efs_tail = ()
+        if eng.wire_spec is not None:
+            efs_tail = (new_efs, u0) if st.uses_ef else (u0,)
+        return new_carry, outs, efs_tail
+
+    def _epilogue(self, carry: dict, data) -> tuple:
+        st = self.stages
+        if st.epilogue is None:
+            return ()
+        return tuple(st.epilogue(self.eng, carry, data))
+
+    def _flat(self, new_carry: dict, epi: tuple, outs: dict,
+              efs_tail: tuple) -> tuple:
+        st = self.stages
+        return (*(new_carry[n] for n in st.carry), *epi,
+                *(outs[o] for o in st.outputs), *efs_tail)
+
+    def _count_dispatches(self, jitted):
+        """Wrap a compiled program so invocations count toward
+        ``dispatches`` (the bench's per-engine dispatch evidence);
+        ``.jit``/``.lower`` expose the underlying executable for
+        compile-text tests."""
+        def dispatch(*args):
+            self.dispatches += 1
+            return jitted(*args)
+
+        dispatch.jit = jitted
+        dispatch.lower = jitted.lower
+        return dispatch
+
+    # ---------- compiled variants ----------
+
+    def round_jit(self, n_real: int | None = None, static_key=None,
+                  sharded: bool | None = None):
+        """The single-round program:
+        ``f(carry, data, consts, idx, rngs, lr, efs=None, byz=None,
+        per_round=None)``. ``carry`` (argnum 0) and ``efs`` (argnum 6)
+        are donated; ``n_real`` marks the cohort-sharded variant over the
+        mesh-padded sampled set (static — fault-schedule cohort
+        shrinkage re-specializes via the plan cache)."""
+        shard = sharded if sharded is not None else (n_real is not None)
+
+        def build():
+            self.built += 1
+
+            def round_fn(carry, data, consts, idx, rngs, lr, efs=None,
+                         byz=None, per_round=None):
+                if self.stages.gathers_cohort:
+                    Xs, ys, ns = self._gather(data, idx)
+                else:
+                    Xs, ys, ns = data.X_train, data.y_train, data.n_train
+                new_carry, outs, efs_tail = self._body(
+                    carry, data, consts, Xs, ys, ns, idx, rngs, lr, efs,
+                    byz, per_round, static_key, n_real, shard)
+                epi = self._epilogue(new_carry, data)
+                return self._flat(new_carry, epi, outs, efs_tail)
+
+            return self._count_dispatches(jax.jit(
+                round_fn,
+                donate_argnums=self.eng._donate_argnums(0, 6)))
+
+        return self.eng._plan_cached("_round_prog_cache",
+                                     ("round", n_real, static_key, shard),
+                                     build)
+
+    def fused_jit(self, k: int, n_real: int | None = None,
+                  static_key=None, sharded: bool | None = None):
+        """K rounds as ONE dispatched program: a ``lax.scan`` over the
+        exact per-round body, consuming host-precomputed stacks of
+        sampling indices / per-client rngs / round lrs (+ the byz plan
+        and any declared per-round operands). Amortizes the per-dispatch
+        latency the sequential loop pays K times (PROFILE.md round 2).
+        Donates the carry; cached on the engine as
+        ``_fused_round_jit_cache`` (the one-compiled-program-per-window
+        pin reads it)."""
+        shard = sharded if sharded is not None else (n_real is not None)
+
+        def build():
+            self.built += 1
+
+            def fused_round_fn(carry, data, consts, idx, rngs, lrs,
+                               byz=None, per_round=None):
+                def one_round(c, xs):
+                    if self.stages.gathers_cohort:
+                        Xs, ys, ns = self._gather(data, xs["idx"])
+                    else:
+                        Xs, ys, ns = (data.X_train, data.y_train,
+                                      data.n_train)
+                    # per-step slices of the [K]-stacked per-round
+                    # operands, already in st.per_round order
+                    pr = tuple(xs["pr"]) if "pr" in xs else None
+                    new_carry, outs, _ = self._body(
+                        c, data, consts, Xs, ys, ns, xs.get("idx"),
+                        xs["rngs"], xs["lr"], None, xs.get("byz"), pr,
+                        static_key, n_real, shard)
+                    return (tuple(new_carry[n]
+                                  for n in self.stages.carry),
+                            tuple(outs[o] for o in self.stages.outputs))
+
+                xs = {"idx": idx, "rngs": rngs, "lr": lrs}
+                if byz is not None:
+                    xs["byz"] = byz
+                if per_round is not None:
+                    xs["pr"] = per_round
+                carry, outs = jax.lax.scan(one_round, tuple(carry), xs)
+                epi = self._epilogue(dict(zip(self.stages.carry, carry)),
+                                     data)
+                return (*carry, *epi, *outs)
+
+            return self._count_dispatches(jax.jit(
+                fused_round_fn,
+                donate_argnums=self.eng._donate_argnums(0)))
+
+        return self.eng._plan_cached("_fused_round_jit_cache",
+                                     (k, n_real, static_key, shard),
+                                     build)
+
+    def _reject_streamed_epilogue(self):
+        """The streamed programs have no resident federation data to
+        hand an epilogue stage (``_epilogue`` would receive data=None
+        and the fused scan drops the epilogue outputs entirely) — fail
+        loudly instead of miscompiling the declaration. An engine that
+        needs both keeps its streaming outside the builder (dpsgd's
+        chunked ``_round_streaming`` is the precedent)."""
+        if self.stages is not None and self.stages.epilogue is not None:
+            raise ValueError(
+                f"{type(self.eng).__name__} declares an epilogue stage "
+                "and streams through the builder: the streamed round "
+                "program has no resident data for the epilogue")
+
+    def stream_jit(self):
+        """The streamed single-round program: shards arrive pre-gathered
+        (data/stream.py feeds the sampled clients' padded arrays), the
+        federation data never enters the program."""
+        self._reject_streamed_epilogue()
+
+        def build():
+            self.built += 1
+
+            def stream_round_fn(carry, consts, Xs, ys, ns, idx, rngs, lr,
+                                efs=None, byz=None):
+                new_carry, outs, efs_tail = self._body(
+                    carry, None, consts, Xs, ys, ns, idx, rngs, lr, efs,
+                    byz, None, None, None, False)
+                epi = self._epilogue(new_carry, None)
+                return self._flat(new_carry, epi, outs, efs_tail)
+
+            return self._count_dispatches(jax.jit(
+                stream_round_fn,
+                donate_argnums=self.eng._donate_argnums(0)))
+
+        return self.eng._plan_cached("_round_prog_cache", ("stream",),
+                                     build)
+
+    def fused_stream_jit(self, k: int):
+        """K STREAMED rounds as one dispatched program (ISSUE 10): a
+        ``lax.scan`` over the exact streamed per-round body, consuming
+        the window's prefetched ``[K, S, nmax, ...]`` shard stacks one
+        round per step. The carried state is donated like every round
+        program's; the uint8/int32 shard stacks are NOT — no output
+        shares their dtype/shape, so the donation would be unusable (XLA
+        warns and ignores it) and the buffers die at end of dispatch
+        anyway."""
+        self._reject_streamed_epilogue()
+
+        def build():
+            self.built += 1
+
+            def fused_stream_round_fn(carry, consts, Xs, ys, ns, rngs,
+                                      lrs, byz=None):
+                def one_round(c, xs):
+                    new_carry, outs, _ = self._body(
+                        c, None, consts, xs["X"], xs["y"], xs["n"], None,
+                        xs["rngs"], xs["lr"], None, xs.get("byz"), None,
+                        None, None, False)
+                    return (tuple(new_carry[n]
+                                  for n in self.stages.carry),
+                            tuple(outs[o] for o in self.stages.outputs))
+
+                xs = {"X": Xs, "y": ys, "n": ns, "rngs": rngs, "lr": lrs}
+                if byz is not None:
+                    xs["byz"] = byz
+                carry, outs = jax.lax.scan(one_round, tuple(carry), xs)
+                return (*carry, *outs)
+
+            return self._count_dispatches(jax.jit(
+                fused_stream_round_fn,
+                donate_argnums=self.eng._donate_argnums(0)))
+
+        return self.eng._plan_cached("_fused_round_jit_cache",
+                                     ("stream", k), build)
+
+    # ---------- the fused window driver ----------
+
+    def run_window(self, carry: tuple, round_idx: int, k: int,
+                   consts: tuple = ()):
+        """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan.
+        Sampling/rng/lr — and the Byzantine attack plan when the fault
+        schedule carries value faults — are precomputed on the host
+        round by round (the ``np.random.seed(round_idx)`` contract is
+        untouched). Returns ``(new_carry: tuple, epilogue: tuple,
+        outs: dict of [k]-stacked arrays, wi: WindowInputs)`` —
+        ``wi.k`` may shrink when the fault schedule varies the cohort
+        size (or an engine's per-round operands change shape). Queues
+        any ``n_bad`` output into the engine's batched non-finite
+        accounting."""
+        eng, st = self.eng, self.stages
+        # the window IS a host boundary pair (ISSUE 9): the prologue and
+        # the dispatch are separate host spans — "dispatch" measures the
+        # enqueue only (async dispatch races ahead; the sync lands at
+        # the next eval/flush boundary, never here)
+        with obs_trace.span("window", round=round_idx, k=k):
+            with obs_trace.span("window_host_prologue", round=round_idx):
+                wi = self.window_inputs(round_idx, k)
+            with obs_trace.span("dispatch", round=round_idx, k=wi.k):
+                pr = (tuple(wi.per_round[n] for n in st.per_round)
+                      if wi.per_round is not None else None)
+                # engines that train the FULL cohort (gathers_cohort
+                # False) shard without mesh padding — n_real stays None
+                # and the armed mesh alone selects the sharded variant
+                shard = (wi.n_real is not None
+                         or (not st.gathers_cohort and eng._cohort_on))
+                out = self.fused_jit(wi.k, wi.n_real, wi.static_key,
+                                     sharded=shard)(
+                    carry, eng.data, consts, wi.idx, wi.rngs, wi.lrs,
+                    wi.byz, pr)
+        n_carry = len(st.carry)
+        n_epi = len(out) - n_carry - len(st.outputs)
+        new_carry = out[:n_carry]
+        epi = out[n_carry:n_carry + n_epi]
+        outs = dict(zip(st.outputs, out[n_carry + n_epi:]))
+        if "n_bad" in outs:
+            eng._note_nonfinite(outs["n_bad"])
+        return new_carry, epi, outs, wi

@@ -31,10 +31,9 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
-from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
-from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import snip as snip_ops
 from neuroimagedisttraining_tpu.ops.masks import mask_density, ones_mask
@@ -165,35 +164,40 @@ class SalientGradsEngine(FederatedEngine):
 
     # ---------- phase 2: masked rounds ----------
 
-    def _round_body(self, params, bstats, per_params, per_bstats, Xs, ys,
-                    ns, masks, sampled_idx, rngs, lr, byz=None,
-                    n_real=None):
-        """One masked round over pre-gathered sampled-client shards; shared
-        by the device-resident, streaming, and cohort-sharded paths
-        (sampled_idx only drives the personal-state scatter).
+    # ---------- the declared round (engines/program.py) ----------
 
-        ``n_real`` (static) marks the cohort-sharded program (ISSUE 6,
-        same contract as FedAvg's): the shards cover the MESH-PADDED
-        sampled set, local training runs as unbatched per-client loops
-        under the client-mesh shard_map (the ``masks`` ride as a
-        closed-over replicated constant), and the trained stacks — plus
-        ``ns``/``sampled_idx`` — are statically sliced back to the real
-        rows before the attack/codec/defense/aggregate/scatter tail
-        (losses bitwise from identical state, state to ~1 ulp vs the
-        sequential C-loop — the full contract in parallel/cohort.py).
+    def round_stages(self):
+        """The masked round as a declaration: FedAvg's carry plus the
+        persistent per-client personal stacks, the phase-1 mask as a
+        loop constant, and an update stage scattering each sampled
+        client's HONEST local result (pre-attack/codec — the attack is
+        on the wire payload, not the silo's own state). The builder's
+        codec stage packs uploads against the mask (``codec_masks``
+        handoff: top-k sparse by construction, bitmap-free)."""
+        return round_program.RoundStages(
+            carry=("params", "batch_stats", "per_params", "per_bstats"),
+            train=self._train_stage,
+            update=self._update_stage,
+            consts=("masks",),
+            supports_attack=True,
+            codec_masks=self._codec_masks,
+        )
 
-        Byzantine hooks (ISSUE 5, same stages as FedAvg's round): ``byz``
-        transforms the scheduled clients' uploads BEFORE the wire codec
-        (personal models keep the client's honest local result — the
-        attack is on the wire payload, not the silo's own state); every
-        round then applies the non-finite guard, and ``--defense``
-        dispatches through core/robust.py on what the codec decoded."""
+    def _train_stage(self, ctx) -> round_program.TrainOut:
+        """Masked local-train stage (post-step re-mask ``param *= mask``,
+        my_model_trainer.py:228-231): vmapped, or unbatched per-client
+        loops under the client mesh with the mask riding as a closed-over
+        replicated constant (ctx.client_map; perms hoisted —
+        parallel/cohort.py)."""
         trainer = self.trainer
         o = self.cfg.optim
+        params = ctx.carry["params"]
+        bstats = ctx.carry["batch_stats"]
+        masks = ctx.consts["masks"]
+        Xs, ys, ns = ctx.Xs, ctx.ys, ctx.ns
+        lr = ctx.lr
         S = Xs.shape[0]
         max_samples = self._max_samples()
-        if n_real is not None:
-            ns = cohort.pad_row_weights(ns, n_real)
         cs = ClientState(
             params=jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (S,) + x.shape), params),
@@ -202,7 +206,7 @@ class SalientGradsEngine(FederatedEngine):
             opt_state=jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (S,) + x.shape),
                 trainer.opt.init(params)),
-            rng=rngs,
+            rng=ctx.rngs,
         )
 
         def local(cs_c, Xc, yc, nc, perms_c=None):
@@ -211,188 +215,88 @@ class SalientGradsEngine(FederatedEngine):
                 batch_size=o.batch_size, max_samples=max_samples,
                 mask=masks, perms=perms_c)
 
-        if n_real is None:
-            cs, losses = jax.vmap(local, in_axes=(0, 0, 0, 0))(cs, Xs, ys,
-                                                               ns)
-        else:
-            # hoisted-perms sharded loop (base._cohort_local_stage)
-            cs, losses = self._cohort_local_stage(local, cs, Xs, ys, ns)
-            if n_real < S:  # static slice: drop the mesh-pad rows
-                cs = jax.tree.map(lambda x: x[:n_real], cs)
-                losses = losses[:n_real]
-                ns = ns[:n_real]
-                sampled_idx = sampled_idx[:n_real]
-        w = ns.astype(jnp.float32)
-        client_params = cs.params
-        client_bstats = cs.batch_stats
-        if byz is not None:
-            mult, std, nonfinite, keys = byz
-            atk = adversary.apply_attack_stacked(
-                {"params": client_params, "batch_stats": client_bstats},
-                {"params": params, "batch_stats": bstats},
-                mult, std, nonfinite, keys)
-            client_params = atk["params"]
-            client_bstats = atk["batch_stats"]
-        u0 = None
-        if self.wire_spec is not None:
-            # wire-codec roundtrip with MASK HANDOFF (codec/device.py)
-            # over the WHOLE upload payload {params, batch_stats} — the
-            # exact tree a cross-silo silo encodes (distributed/run.py),
-            # with all-ones masks on the (never-pruned) batch stats.
-            # Uploads are top-k sparse by construction (the phase-1
-            # global mask both endpoints hold), so the sparse stage packs
-            # against ``masks`` bitmap-free and delta/quant apply on the
-            # surviving values — aggregation sees what a cross-silo
-            # server would decode. Personal models stay the client's own
-            # untouched local result (they never cross the wire).
-            from neuroimagedisttraining_tpu.codec import device as codec_dev
+        cs, losses = ctx.client_map(
+            local, cs, Xs, ys, ns,
+            hoisted=(lambda: ctx.local_perms(ctx.rngs, ns, o.epochs),))
+        return round_program.TrainOut(
+            losses=losses,
+            upload={"params": cs.params, "batch_stats": cs.batch_stats},
+            state=cs)
 
-            spec = self.wire_spec
-            masks_full = {"params": masks,
-                          "batch_stats": jax.tree.map(jnp.ones_like,
-                                                      bstats)}
-            ref = {"params": params, "batch_stats": bstats}
-            dec, _ = jax.vmap(
-                lambda u: codec_dev.lossy_roundtrip(
-                    spec, u, reference=ref, masks=masks_full))(
-                {"params": client_params, "batch_stats": client_bstats})
-            client_params = dec["params"]
-            client_bstats = dec["batch_stats"]
-            u0 = jax.tree.map(lambda x: x[0], dec)
-        # non-finite guard + defense dispatch (base._sanitize_and_defend)
-        # on what the (possibly codec-roundtripped) wire delivered; the
-        # clip path reduces through the silo-aware base.aggregate (two-
-        # level mesh: silo-first over ICI, ONE aggregate per silo across
-        # DCN — tests/test_sharding.py, ABCD/data_loader.py:216-315)
-        new_params, new_bstats, mean_loss, n_bad = self._sanitize_and_defend(
-            {"params": client_params, "batch_stats": client_bstats},
-            {"params": params, "batch_stats": bstats}, w, losses,
-            rngs=cs.rng)
-        # personal models <- this round's local results; pad entries from
-        # stream_sampling are dropped, never written (base.scatter_sampled_rows)
-        real = ns > 0
-        per_params = self.scatter_sampled_rows(per_params, cs.params,
-                                               sampled_idx, real)
-        per_bstats = self.scatter_sampled_rows(per_bstats, cs.batch_stats,
-                                               sampled_idx, real)
-        if self.wire_spec is not None:
-            return (new_params, new_bstats, per_params, per_bstats,
-                    mean_loss, n_bad, u0)
-        return (new_params, new_bstats, per_params, per_bstats, mean_loss,
-                n_bad)
+    def _codec_masks(self, ctx) -> dict:
+        """Mask handoff to the builder's codec stage: the phase-1 global
+        mask over params, all-ones over the (never-pruned) batch stats —
+        the exact tree a cross-silo silo encodes (distributed/run.py)."""
+        return {"params": ctx.consts["masks"],
+                "batch_stats": jax.tree.map(jnp.ones_like,
+                                            ctx.carry["batch_stats"])}
+
+    def _update_stage(self, ctx, tr, new_carry) -> dict:
+        """Personal models <- this round's local results; pad entries
+        (mesh tiling / streamed feed) are dropped, never written
+        (base.scatter_sampled_rows)."""
+        real = ctx.ns > 0
+        per_params = self.scatter_sampled_rows(
+            ctx.carry["per_params"], tr.state.params, ctx.sampled_idx,
+            real)
+        per_bstats = self.scatter_sampled_rows(
+            ctx.carry["per_bstats"], tr.state.batch_stats,
+            ctx.sampled_idx, real)
+        return {"per_params": per_params, "per_bstats": per_bstats}
+
+    # ---------- legacy-signature program adapters ----------
 
     @functools.cached_property
     def _round_jit(self):
-        def round_fn(params, bstats, per_params, per_bstats, data, masks,
-                     sampled_idx, rngs, lr, byz=None):
-            Xs = jnp.take(data.X_train, sampled_idx, axis=0)
-            ys = jnp.take(data.y_train, sampled_idx, axis=0)
-            ns = jnp.take(data.n_train, sampled_idx, axis=0)
-            return self._round_body(params, bstats, per_params, per_bstats,
-                                    Xs, ys, ns, masks, sampled_idx, rngs,
-                                    lr, byz)
+        prog = self.program.round_jit()
 
-        # donation: the global model and the [C, ...] per-client personal
-        # stacks are consumed — their buffers back the round's outputs
-        # (the per-client stack is the engine's largest resident state;
-        # without donation XLA holds input AND output copies of it across
-        # the dispatch). ``masks`` is NOT donated: the phase-1 global
-        # mask is reused every round (and by the wire_masks handoff).
-        return jax.jit(round_fn,
-                       donate_argnums=self._donate_argnums(0, 1, 2, 3))
+        def round_call(params, bstats, per_params, per_bstats, data,
+                       masks, sampled_idx, rngs, lr, byz=None):
+            return prog((params, bstats, per_params, per_bstats), data,
+                        (masks,), sampled_idx, rngs, lr, None, byz)
+
+        return round_call
 
     def _sharded_round_jit(self, n_real: int):
         """The cohort-sharded masked round (ISSUE 6): ``_round_jit``'s
         signature and donation contract, with ``sampled_idx``/``rngs``
-        covering the MESH-PADDED sampled set and the local-train stage
-        shard_mapped over the client mesh (``n_real`` static)."""
-        def build():
-            def sharded_round_fn(params, bstats, per_params, per_bstats,
-                                 data, masks, sampled_idx, rngs, lr,
-                                 byz=None):
-                Xs = jnp.take(data.X_train, sampled_idx, axis=0)
-                ys = jnp.take(data.y_train, sampled_idx, axis=0)
-                ns = jnp.take(data.n_train, sampled_idx, axis=0)
-                return self._round_body(params, bstats, per_params,
-                                        per_bstats, Xs, ys, ns, masks,
-                                        sampled_idx, rngs, lr, byz,
-                                        n_real=n_real)
+        covering the MESH-PADDED sampled set and the builder sharding
+        the local-train stage over the client mesh (``n_real`` static)."""
+        prog = self.program.round_jit(n_real=n_real)
 
-            return jax.jit(sharded_round_fn,
-                           donate_argnums=self._donate_argnums(0, 1, 2, 3))
+        def sharded_round_call(params, bstats, per_params, per_bstats,
+                               data, masks, sampled_idx, rngs, lr,
+                               byz=None):
+            return prog((params, bstats, per_params, per_bstats), data,
+                        (masks,), sampled_idx, rngs, lr, None, byz)
 
-        return self._plan_cached("_sharded_round_jit_cache", n_real, build)
+        return sharded_round_call
 
     @functools.cached_property
     def _round_stream_jit(self):
-        return jax.jit(self._round_body,
-                       donate_argnums=self._donate_argnums(0, 1, 2, 3))
+        prog = self.program.stream_jit()
+
+        def stream_round_call(params, bstats, per_params, per_bstats,
+                              Xs, ys, ns, masks, sampled_idx, rngs, lr,
+                              byz=None):
+            return prog((params, bstats, per_params, per_bstats),
+                        (masks,), Xs, ys, ns, sampled_idx, rngs, lr,
+                        None, byz)
+
+        return stream_round_call
 
     # ---------- fused multi-round dispatch (ISSUE 4) ----------
 
-    def fused_fallback_reason(self) -> str | None:
-        return self._resident_fallback_reason()
-
-    def _fused_round_jit(self, k: int, n_real: int | None = None):
-        """K masked rounds as one ``lax.scan`` over the exact round body
-        (same dispatch-amortization shape as FedAvg's); the phase-1 mask
-        and the resident federation ride as loop constants. ``n_real``
-        marks the cohort-sharded variant (mesh-padded [K, P] index/rng
-        stacks, sharded local-train stage inside the scan)."""
-        def build():
-            def fused_round_fn(params, bstats, per_params, per_bstats, data,
-                         masks, sampled_idx, rngs, lrs, byz=None):
-                def one_round(carry, xs):
-                    p, b, pp, pb = carry
-                    if byz is None:
-                        (si, rg, lr), bz = xs, None
-                    else:
-                        si, rg, lr, bz = xs
-                    Xs = jnp.take(data.X_train, si, axis=0)
-                    ys = jnp.take(data.y_train, si, axis=0)
-                    ns = jnp.take(data.n_train, si, axis=0)
-                    p, b, pp, pb, loss, bad = self._round_body(
-                        p, b, pp, pb, Xs, ys, ns, masks, si, rg, lr, bz,
-                        n_real=n_real)
-                    return (p, b, pp, pb), (loss, bad)
-
-                xs = ((sampled_idx, rngs, lrs) if byz is None
-                      else (sampled_idx, rngs, lrs, byz))
-                carry, (losses, bads) = jax.lax.scan(
-                    one_round, (params, bstats, per_params, per_bstats),
-                    xs)
-                return (*carry, losses, bads)
-
-            return jax.jit(fused_round_fn,
-                           donate_argnums=self._donate_argnums(0, 1, 2, 3))
-
-        return self._plan_cached("_fused_round_jit_cache", (k, n_real),
-                                 build)
-
     def _run_fused_window(self, params, bstats, per_params, per_bstats,
                           masks, round_idx: int, k: int):
-        """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan;
-        host-side sampling/rng/lr (and the Byzantine plan when value
-        faults are scheduled) precomputed per round (reference
-        ``np.random.seed(round_idx)`` contract untouched). Returns the
-        new state, per-round sampled sets (for the host-side stat
-        accounting), the boundary round's loss, and the actual window
-        length."""
-        # window edges are host boundaries (obs/, ISSUE 9): the same
-        # window ⊃ {prologue, dispatch} span structure as the fedavg
-        # driver, so flagship masked traces read identically
-        with obs_trace.span("window", round=round_idx, k=k):
-            with obs_trace.span("window_host_prologue", round=round_idx):
-                (sampled, idx, rngs, lrs, byz, k,
-                 n_real) = self._window_host_inputs(round_idx, k)
-            with obs_trace.span("dispatch", round=round_idx, k=k):
-                (params, bstats, per_params, per_bstats, losses,
-                 bads) = self._fused_round_jit(k, n_real)(
-                    params, bstats, per_params, per_bstats, self.data,
-                    masks, idx, rngs, lrs, byz)
-        self._note_nonfinite(bads)
-        return (params, bstats, per_params, per_bstats, sampled,
-                losses[-1], k)
+        """Dispatch rounds ``[round_idx, round_idx + k)`` as one scan
+        (program.run_window). Returns the new state, per-round sampled
+        sets (for the host-side stat accounting), the boundary round's
+        loss, and the actual window length."""
+        carry, _, outs, wi = self.program.run_window(
+            (params, bstats, per_params, per_bstats), round_idx, k,
+            consts=(masks,))
+        return (*carry, wi.sampled, outs["loss"][-1], wi.k)
 
     def _eval_ckpt_hooks(self, round_idx, params, bstats, per_params,
                          per_bstats, masks, loss, history):

@@ -25,11 +25,16 @@ subavg/my_model_trainer.py:48-82):
 - Personalized model of client c = ``w_global * mask_c``
   (_local_test_on_all_clients, subavg_api.py:150-170).
 
-TPU-native: one jitted round program — sampled clients' masks/models are
-stacked and vmapped, the percentile prune is a sort-based quantile per
-layer, the accept-test is a vmapped masked evaluation, and the overlap-count
-average is a masked sum over the client axis (ICI all-reduce under the
-mesh).
+The round is DECLARED through the round-program builder
+(engines/program.py, ISSUE 11): the per-client prune/accept composite is
+the train stage, the overlap-count average is a CUSTOM aggregate stage
+(it replaces the weighted mean — order-statistic defenses have nothing
+to select over a count-quotient), and the personal-mask scatter is the
+update stage. The builder supplies fused ``--rounds_per_dispatch K``
+windows (per-round ``up_nnz``/dist/accept scalars come back [K]-stacked)
+and ``--client_mesh`` cohort sharding of the per-client composite — the
+two-call epoch split hoists BOTH calls' permutations out of the
+partition (ctx.rng_after_local_train replays the rng chain).
 """
 
 from __future__ import annotations
@@ -42,6 +47,7 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.core.losses import binary_auc
 from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import prune as P
@@ -55,6 +61,8 @@ class SubFedAvgEngine(FederatedEngine):
     # data shards (same shape as FedAvg's streaming round); per-client masks
     # and the global model stay device-resident.
     supports_streaming = True
+    supports_cohort_sharding = True  # the per-client prune/accept
+    # composite runs as unbatched loops under the --client_mesh shard_map
     #: current per-client personal masks, tracked for the codec handoff
     _mask_pers = None
 
@@ -65,18 +73,36 @@ class SubFedAvgEngine(FederatedEngine):
         frame with the surviving values (as DisPFL)."""
         return self._mask_pers
 
-    def _round_body(self, params, bstats, mask_pers, Xs, ys, ns,
-                    sampled_idx, rngs, lr):
-        """One Sub-FedAvg round over pre-gathered sampled-client shards;
-        shared by the device-resident and streaming paths."""
+    # ---------- the declared round (engines/program.py) ----------
+
+    def round_stages(self):
+        return round_program.RoundStages(
+            carry=("params", "batch_stats", "mask_pers"),
+            train=self._train_stage,
+            aggregate=self._aggregate_stage,
+            update=self._update_stage,
+            outputs=("loss", "mean_dist", "n_accept", "up_nnz"),
+        )
+
+    def _train_stage(self, ctx) -> round_program.TrainOut:
+        """The per-client composite: masked epoch-1 train -> fake_prune
+        m1 -> masked tail epochs -> fake_prune m2 -> accept-test. On the
+        sharded path both ``local_train`` calls' epoch permutations are
+        hoisted out of the partition: the tail call's entry rngs are the
+        chain ``local_train`` leaves after epoch 1, replayed outside the
+        shard_map (ctx.rng_after_local_train)."""
         trainer = self.trainer
         o = self.cfg.optim
         s = self.cfg.sparsity
+        params = ctx.carry["params"]
+        bstats = ctx.carry["batch_stats"]
+        Xs, ys, ns = ctx.Xs, ctx.ys, ctx.ns
+        lr = ctx.lr
         max_samples = self._max_samples()
         epochs_tail = max(o.epochs - 1, 0)
-        Ms = pt.tree_stack_index(mask_pers, sampled_idx)
+        Ms = pt.tree_stack_index(ctx.carry["mask_pers"], ctx.sampled_idx)
 
-        def per_client(m, rng, Xc, yc, nc):
+        def per_client(m, rng, Xc, yc, nc, perms1_c=None, perms2_c=None):
             w_per = jax.tree.map(jnp.multiply, params, m)
             dense = P.density_all_leaves(w_per)
             cs_c = ClientState(params=w_per, batch_stats=bstats,
@@ -85,14 +111,14 @@ class SubFedAvgEngine(FederatedEngine):
             # epoch 1, then fake_prune -> m1
             cs_c, loss1 = trainer.local_train(
                 cs_c, Xc, yc, nc, lr, epochs=1, batch_size=o.batch_size,
-                max_samples=max_samples, mask=m)
+                max_samples=max_samples, mask=m, perms=perms1_c)
             m1 = P.fake_prune(s.each_prune_ratio, cs_c.params, m)
             # remaining epochs, then fake_prune -> m2
             if epochs_tail:
                 cs_c, loss2 = trainer.local_train(
                     cs_c, Xc, yc, nc, lr, epochs=epochs_tail,
                     batch_size=o.batch_size, max_samples=max_samples,
-                    mask=m)
+                    mask=m, perms=perms2_c)
                 loss = (loss1 + epochs_tail * loss2) / o.epochs
             else:
                 loss = loss1
@@ -116,19 +142,33 @@ class SubFedAvgEngine(FederatedEngine):
             return (new_params, cs_c.batch_stats, new_mask, loss, dist,
                     accept)
 
-        (new_p, new_b, new_m, losses, dists, accepts) = jax.vmap(
-            per_client)(Ms, rngs, Xs, ys, ns)
+        hoisted = [lambda: ctx.local_perms(ctx.rngs, ns, 1)]
+        if epochs_tail:
+            hoisted.append(lambda: ctx.local_perms(
+                ctx.rng_after_local_train(ctx.rngs, 1), ns, epochs_tail))
+        (new_p, new_b, new_m, losses, dists, accepts) = ctx.client_map(
+            per_client, Ms, ctx.rngs, Xs, ys, ns, hoisted=tuple(hoisted))
+        return round_program.TrainOut(
+            losses=losses,
+            upload={"params": new_p, "batch_stats": new_b},
+            extra={"Ms": Ms, "new_m": new_m, "dists": dists,
+                   "accepts": accepts})
 
-        # mesh-tiling pad entries (ns == 0, possibly duplicate ids from
-        # stream_sampling) must not contribute to the count-based
-        # aggregation, the stats, or the mask scatter
-        real = (ns > 0).astype(jnp.float32)
+    def _aggregate_stage(self, ctx, upload, w, tr):
+        """Overlap-count aggregation against the OLD masks
+        (subavg_api.py:123-140) — a custom aggregate stage: per weight,
+        ``count`` = sampled clients whose old mask keeps it, server
+        value = sum/count where count > 0, previous value elsewhere.
+        Mesh-tiling pad entries (ns == 0, possibly duplicate ids from
+        stream_sampling) contribute nothing."""
+        params = ctx.carry["params"]
+        Ms, new_m = tr.extra["Ms"], tr.extra["new_m"]
+        new_p, new_b = upload["params"], upload["batch_stats"]
+        real = (ctx.ns > 0).astype(jnp.float32)
         rb = lambda x: real.reshape((-1,) + (1,) * (x.ndim - 1))
-
-        # ---- overlap-count aggregation against the OLD masks ----
         count = jax.tree.map(lambda m: jnp.sum(m * rb(m), axis=0), Ms)
         summed = jax.tree.map(
-            lambda w: jnp.sum(w.astype(jnp.float32) * rb(w), axis=0),
+            lambda p: jnp.sum(p.astype(jnp.float32) * rb(p), axis=0),
             new_p)
         agg = jax.tree.map(
             lambda sm, ct, old: jnp.where(ct > 0, sm
@@ -138,38 +178,60 @@ class SubFedAvgEngine(FederatedEngine):
         new_bstats = jax.tree.map(
             lambda b: jnp.sum(b.astype(jnp.float32) * rb(b), axis=0)
             / n_real, new_b)
-        # scatter updated personal masks back; pad entries are dropped,
-        # never written (base.scatter_sampled_rows)
-        mask_pers = self.scatter_sampled_rows(mask_pers, new_m,
-                                              sampled_idx, ns > 0)
-        mean_loss = jnp.sum(losses * real) / n_real
+        mean_loss = jnp.sum(tr.losses * real) / n_real
         # per-sampled-client nnz of the NEW masks: the true uplink volume
         # (reference nonzero-comm metric, model_trainer.py:49-53)
         up_nnz = jax.vmap(lambda m: sum(
             jnp.sum(x) for x in jax.tree.leaves(m)))(new_m)
-        return (agg, new_bstats, mask_pers, mean_loss,
-                jnp.sum(dists * real) / n_real,
-                jnp.sum(accepts * real),
-                jnp.sum(up_nnz * real))
+        return ({"params": agg, "batch_stats": new_bstats},
+                {"loss": mean_loss,
+                 "mean_dist": jnp.sum(tr.extra["dists"] * real) / n_real,
+                 "n_accept": jnp.sum(tr.extra["accepts"] * real),
+                 "up_nnz": jnp.sum(up_nnz * real)})
+
+    def _update_stage(self, ctx, tr, new_carry) -> dict:
+        """Scatter updated personal masks back; pad entries are dropped,
+        never written (base.scatter_sampled_rows)."""
+        mask_pers = self.scatter_sampled_rows(
+            ctx.carry["mask_pers"], tr.extra["new_m"], ctx.sampled_idx,
+            ctx.ns > 0)
+        return {"mask_pers": mask_pers}
+
+    # ---------- legacy-signature program adapters ----------
 
     @functools.cached_property
     def _round_jit(self):
-        def round_fn(params, bstats, mask_pers, data, sampled_idx, rngs, lr):
-            Xs = jnp.take(data.X_train, sampled_idx, axis=0)
-            ys = jnp.take(data.y_train, sampled_idx, axis=0)
-            ns = jnp.take(data.n_train, sampled_idx, axis=0)
-            return self._round_body(params, bstats, mask_pers, Xs, ys, ns,
-                                    sampled_idx, rngs, lr)
+        prog = self.program.round_jit()
 
-        # donation: global model + the persistent per-client mask stack
-        # are consumed; the driver rebinds all three on return
-        return jax.jit(round_fn,
-                       donate_argnums=self._donate_argnums(0, 1, 2))
+        def round_call(params, bstats, mask_pers, data, sampled_idx,
+                       rngs, lr):
+            return prog((params, bstats, mask_pers), data, (),
+                        sampled_idx, rngs, lr)
+
+        return round_call
+
+    def _sharded_round_jit(self, n_real: int):
+        prog = self.program.round_jit(n_real=n_real)
+
+        def sharded_round_call(params, bstats, mask_pers, data,
+                               sampled_idx, rngs, lr):
+            return prog((params, bstats, mask_pers), data, (),
+                        sampled_idx, rngs, lr)
+
+        return sharded_round_call
 
     @functools.cached_property
     def _round_stream_jit(self):
-        return jax.jit(self._round_body,
-                       donate_argnums=self._donate_argnums(0, 1, 2))
+        prog = self.program.stream_jit()
+
+        def stream_round_call(params, bstats, mask_pers, Xs, ys, ns,
+                              sampled_idx, rngs, lr):
+            return prog((params, bstats, mask_pers), (), Xs, ys, ns,
+                        sampled_idx, rngs, lr)
+
+        return stream_round_call
+
+    # ---------- personalized (masked-global) evaluation ----------
 
     @functools.cached_property
     def _eval_masked_global_jit(self):
@@ -218,6 +280,23 @@ class SubFedAvgEngine(FederatedEngine):
             cat, n_all = [c[:1] for c in cat], n_all[:1]
         return self._summarize(*cat, n=n_all)
 
+    # ---------- driver ----------
+
+    def _account_round(self, sampled, up_nnz, n_params, flops_per_sample
+                       ) -> None:
+        """Per-round host-side stat accounting, shared by the per-round
+        and fused-window drivers. ``up_nnz`` is the round's device
+        scalar (already synced by the caller)."""
+        n_samples = float(np.sum(self._n_train_host[sampled]))
+        self.stat_info["sum_training_flops"] += (
+            flops_per_sample * self.cfg.optim.epochs * n_samples)
+        # down: the dense w_global per sampled client; up: the pruned
+        # client models' TRUE nonzero count (reference nonzero-comm
+        # metric, model_trainer.py:49-53) — computed inside the round
+        # program, so the "device pull" is one scalar per round
+        self.stat_info["sum_comm_params"] += (
+            n_params * len(sampled) + float(up_nnz))
+
     def train(self):
         cfg = self.cfg
         gs = self.init_global_state()
@@ -236,42 +315,62 @@ class SubFedAvgEngine(FederatedEngine):
             mask_pers, history = restored["mask_pers"], restored["history"]
         if self.stream is not None:
             self.stream.prefetch_train(*self.stream_sampling(start))
-        for round_idx in range(start, cfg.fed.comm_round):
-            sampled = self.client_sampling(round_idx)
-            self.log.info("################ round %d: clients %s",
-                          round_idx, sampled.tolist())
-            if self.stream is not None:
-                fed_ids, n_real = self.stream_sampling(round_idx, sampled)
-                rngs = self.per_client_rngs(round_idx, fed_ids)
-                Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
-                if round_idx + 1 < cfg.fed.comm_round:
-                    self.stream.prefetch_train(
-                        *self.stream_sampling(round_idx + 1))
-                (params, bstats, mask_pers, loss, mean_dist, n_accept,
-                 up_nnz) = self._round_stream_jit(
-                    params, bstats, mask_pers, Xs, ys, ns,
-                    jnp.asarray(fed_ids), rngs, self.round_lr(round_idx))
+        fuse = (cfg.fed.rounds_per_dispatch > 1
+                and self.fused_fallback_reason() is None)
+        round_idx = start
+        while round_idx < cfg.fed.comm_round:
+            k = self._dispatch_window(round_idx) if fuse else 1
+            if k > 1:
+                ((params, bstats, mask_pers), _, outs,
+                 wi) = self.program.run_window(
+                    (params, bstats, mask_pers), round_idx, k)
+                k = wi.k
+                loss, mean_dist = outs["loss"][-1], outs["mean_dist"][-1]
+                n_accept = outs["n_accept"][-1]
+                # one batched sync for the window's K per-round upload
+                # nnz scalars (the sequential loop syncs one per round)
+                nnz_rounds = np.asarray(jax.device_get(outs["up_nnz"]))
+                for off, s in enumerate(wi.sampled):
+                    self._account_round(s, nnz_rounds[off], n_params,
+                                        flops_per_sample)
+                round_idx += k - 1
             else:
-                rngs = self.per_client_rngs(round_idx, sampled)
-                (params, bstats, mask_pers, loss, mean_dist, n_accept,
-                 up_nnz) = self._round_jit(
-                    params, bstats, mask_pers, self.data,
-                    jnp.asarray(sampled), rngs, self.round_lr(round_idx))
+                sampled = self.client_sampling(round_idx)
+                self.log.info("################ round %d: clients %s",
+                              round_idx, sampled.tolist())
+                if self.stream is not None:
+                    fed_ids, n_real = self.stream_sampling(round_idx,
+                                                           sampled)
+                    rngs = self.per_client_rngs(round_idx, fed_ids)
+                    Xs, ys, ns = self.stream.get_train(fed_ids, n_real)
+                    if round_idx + 1 < cfg.fed.comm_round:
+                        self.stream.prefetch_train(
+                            *self.stream_sampling(round_idx + 1))
+                    (params, bstats, mask_pers, loss, mean_dist, n_accept,
+                     up_nnz) = self._round_stream_jit(
+                        params, bstats, mask_pers, Xs, ys, ns,
+                        jnp.asarray(fed_ids), rngs,
+                        self.round_lr(round_idx))
+                else:
+                    # cohort sharding (ISSUE 6): the sharded program
+                    # gathers the mesh-padded set; the accounting stays
+                    # on the REAL sampled set
+                    ids, round_prog = self._cohort_round_prog(sampled)
+                    rngs = self.per_client_rngs(round_idx, ids)
+                    (params, bstats, mask_pers, loss, mean_dist, n_accept,
+                     up_nnz) = round_prog(
+                        params, bstats, mask_pers, self.data,
+                        jnp.asarray(ids), rngs, self.round_lr(round_idx))
+                self._account_round(sampled, up_nnz, n_params,
+                                    flops_per_sample)
             self._mask_pers = mask_pers
             # NaN-poisoned-mask diagnosability (ADVICE r5): a NaN in the
             # trained params poisons fake_prune's percentile into an
             # all-False m2; if the accept-test then fires, the client's
             # personal mask collapses — make it visible immediately
+            # (fused windows check once per window, at the boundary the
+            # driver already syncs)
             self.warn_if_masks_collapsed(mask_pers, round_idx)
-            n_samples = float(np.sum(self._n_train_host[sampled]))
-            self.stat_info["sum_training_flops"] += (
-                flops_per_sample * cfg.optim.epochs * n_samples)
-            # down: the dense w_global per sampled client; up: the pruned
-            # client models' TRUE nonzero count (reference nonzero-comm
-            # metric, model_trainer.py:49-53) — computed inside the round
-            # program, so the "device pull" is one scalar
-            self.stat_info["sum_comm_params"] += (
-                n_params * len(sampled) + float(up_nnz))
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 mp = self.eval_masked_global(params, bstats, mask_pers)
@@ -288,6 +387,7 @@ class SubFedAvgEngine(FederatedEngine):
             self.maybe_checkpoint(round_idx, {
                 "params": params, "batch_stats": bstats,
                 "mask_pers": mask_pers, "history": history})
+            round_idx += 1
         m_person = self.eval_masked_global(params, bstats, mask_pers)
         self.log.metrics(-1, personal=m_person)
         densities = np.asarray(jax.device_get(jax.vmap(

@@ -66,10 +66,15 @@ class TurboAggregateEngine(FedAvgEngine):
     supports_cohort_sharding = False
     supported_defenses = robust.CLIP_DEFENSES
 
-    def cohort_fallback_reason(self) -> str | None:
-        return ("turboaggregate's round crosses the host at the MPC "
-                "share boundary every round (quantize/share/aggregate "
-                "models the client<->server link); no sharded round body")
+    def round_stages(self):
+        # no declared stages: the round is a host-driven two-stage
+        # dispatch (train program -> MPC share/aggregate program with a
+        # per-round host-side mask seed), which the scan-fused builder
+        # cannot express — the overrides below name the table reasons
+        return None
+
+    def cohort_fallback_key(self) -> str | None:
+        return "mpc-host-boundary"
 
     def _train_only_body(self, params, bstats, Xs, ys, ns, rngs, lr):
         """Local training WITHOUT the in-program aggregation: returns the
@@ -148,12 +153,12 @@ class TurboAggregateEngine(FedAvgEngine):
         return jax.jit(self._train_only_body,
                        donate_argnums=self._donate_argnums(1))
 
-    def fused_fallback_reason(self) -> str | None:
+    def fused_fallback_key(self) -> str | None:
         # overrides FedAvg's: even the device MPC backend is a host-driven
         # two-stage dispatch (train program -> share/aggregate program with
         # a per-round host-side mask seed), and the host backend crosses
         # the process boundary by design
-        return "the MPC aggregation stage is host-driven between rounds"
+        return "mpc-host-stage"
 
     @functools.cached_property
     def _secure_agg_jit(self):

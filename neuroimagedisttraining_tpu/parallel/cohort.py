@@ -69,8 +69,9 @@ a hard CORRECTNESS requirement, not a preference:
   ``optimization_barrier`` placement, and XLA runtime flag — and went
   to ZERO the moment the permutations were computed outside the
   ``shard_map`` and passed in. Hence ``LocalTrainer.local_train``'s
-  ``perms=`` parameter + ``FederatedEngine._cohort_perms`` /
-  ``_cohort_local_stage`` for the rounds, and
+  ``perms=`` parameter + the round-program builder's perm hoist
+  (``engines/program.py``: ``hoisted_epoch_perms`` /
+  ``RoundCtx.client_map``) for the rounds, and
   ``ops.snip.iter_snip_batch_indices`` for phase-1's IterSNIP draws;
   the non-hoistable ``batch_order=replacement`` (i.i.d. per-step
   randint draws — same in-partition lowering family, same measured

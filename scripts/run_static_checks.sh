@@ -42,7 +42,13 @@ fi
 # bodies under core/, ops/, models/ — the bf16_mixed contract keeps
 # compute in the model dtype; blessed master-weight/loss sites carry
 # justified precision-upcast pragmas
-echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline / precision-discipline) =="
+# the round-program-discipline family (ISSUE 11) keeps the declarative
+# builder the ONLY owner of fused round machinery: no hand-rolled
+# lax.scan fused round bodies in engine classes outside
+# engines/program.py, and *_fallback_key overrides must name keys from
+# the builder's REASONS table (the structured nidt_fallback_total
+# counter's single source of truth)
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline / precision-discipline / round-program-discipline) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
 

@@ -1010,3 +1010,119 @@ def test_precision_upcast_pragma_suppresses_with_reason():
             return x.astype(jnp.float32)  # nidt: allow[precision-upcast] -- blessed loss site
         """, path="pkg/core/mod.py", rules=["precision-upcast"])
     assert fs == []
+
+
+# ---------------- round-program discipline (ISSUE 11) ----------------
+
+def test_round_program_flags_hand_rolled_fused_scan():
+    """A lax.scan inside a *round*/*fused*-named method of an engine
+    class is a hand-rolled fused round body — the builder
+    (engines/program.py) owns the K-round scan."""
+    fs = lint("""
+        import jax
+        from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+
+        class E(FederatedEngine):
+            name = "e"
+            supports_streaming = False
+
+            def train(self):
+                pass
+
+            def _fused_round_jit(self, k):
+                def fused_round_fn(params, xs):
+                    return jax.lax.scan(lambda c, x: (c, c), params, xs)
+                return jax.jit(fused_round_fn,
+                               donate_argnums=self._donate_argnums(0))
+        """, path="pkg/engines/mod.py",
+        rules=["round-program-fused-body"])
+    assert rules_of(fs) == ["round-program-fused-body"]
+
+
+def test_round_program_allows_scan_outside_engines_and_in_builder():
+    src = """
+        import jax
+
+        def fused_round_fn(params, xs):
+            return jax.lax.scan(lambda c, x: (c, c), params, xs)
+        """
+    # module-level scan (no engine class): fine
+    assert lint(src, path="pkg/engines/mod.py",
+                rules=["round-program-fused-body"]) == []
+    # the builder itself: exempt by file
+    engine_src = """
+        import jax
+        from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+
+        class E(FederatedEngine):
+            name = "e"
+            supports_streaming = False
+
+            def train(self):
+                pass
+
+            def _fused_round_jit(self, k):
+                def fused_round_fn(params, xs):
+                    return jax.lax.scan(lambda c, x: (c, c), params, xs)
+                return jax.jit(fused_round_fn,
+                               donate_argnums=self._donate_argnums(0))
+        """
+    assert lint(engine_src, path="pkg/engines/program.py",
+                rules=["round-program-fused-body"]) == []
+
+
+def test_round_program_allows_non_round_scan_in_engine():
+    """Scans in non-round methods (phase-1 scoring, eval chunking) stay
+    legal — only the fused-round naming convention is fenced."""
+    fs = lint("""
+        import jax
+        from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+
+        class E(FederatedEngine):
+            name = "e"
+            supports_streaming = False
+
+            def train(self):
+                pass
+
+            def _scores_body(self, xs):
+                return jax.lax.scan(lambda c, x: (c, c), 0, xs)
+        """, path="pkg/engines/mod.py",
+        rules=["round-program-fused-body"])
+    assert fs == []
+
+
+def test_round_program_reason_must_be_table_key():
+    base = """
+        from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+
+        class E(FederatedEngine):
+            name = "e"
+            supports_streaming = False
+
+            def train(self):
+                pass
+
+            def fused_fallback_key(self):
+                return {key}
+        """
+    fs = lint(base.format(key="'my ad-hoc reason string'"),
+              path="pkg/engines/mod.py", rules=["round-program-reason"])
+    assert rules_of(fs) == ["round-program-reason"]
+    assert lint(base.format(key="'mpc-host-stage'"),
+                path="pkg/engines/mod.py",
+                rules=["round-program-reason"]) == []
+    assert lint(base.format(key="None"),
+                path="pkg/engines/mod.py",
+                rules=["round-program-reason"]) == []
+
+
+def test_round_program_reason_keys_parse_from_source():
+    from neuroimagedisttraining_tpu.analysis.round_program import (
+        _reason_keys,
+    )
+
+    keys = _reason_keys()
+    assert "no-fused-body" in keys
+    assert "mpc-host-stage" in keys
+    assert "gossip-mesh-collectives" in keys

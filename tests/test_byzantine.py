@@ -396,11 +396,12 @@ def test_engine_rejects_unknown_or_unsupported_defense(tmp_path,
     with pytest.raises(ValueError, match="unknown defense"):
         _make_engine(tmp_path, synthetic_cohort,
                      defense_type="krumm")  # typo fails at startup
-    # ditto's round has no defended aggregation path: loud, at startup
+    # local's round has no defended aggregation path (no declared
+    # aggregate stage routes through the builder's defense dispatch —
+    # ditto gained one in ISSUE 11): loud, at startup
     with pytest.raises(ValueError, match="does not support"):
-        _make_engine(tmp_path, synthetic_cohort, algorithm="ditto",
-                     defense_type="trimmed_mean", lamda=0.5,
-                     local_epochs=1)
+        _make_engine(tmp_path, synthetic_cohort, algorithm="local",
+                     defense_type="trimmed_mean")
     # breakdown point vs the sampled cohort: krum needs n >= f + 3
     with pytest.raises(ValueError, match="f \\+ 3"):
         _make_engine(tmp_path, synthetic_cohort, defense_type="krum",
@@ -411,13 +412,15 @@ def test_engine_without_byz_support_rejects_value_faults(tmp_path,
                                                          synthetic_cohort):
     from tests.test_fedavg import _make_engine
 
+    # local never puts uploads on a wire — no attack surface, and no
+    # builder attack stage to route them through (ditto gained byz
+    # support with its stage declaration, ISSUE 11)
     with pytest.raises(ValueError, match="byz"):
-        _make_engine(tmp_path, synthetic_cohort, algorithm="ditto",
-                     fault_spec="byz:1@0:sign_flip", lamda=0.5,
-                     local_epochs=1)
+        _make_engine(tmp_path, synthetic_cohort, algorithm="local",
+                     fault_spec="byz:1@0:sign_flip")
     # omission faults keep working everywhere
-    e = _make_engine(tmp_path, synthetic_cohort, algorithm="ditto",
-                     fault_spec="crash:1@1", lamda=0.5, local_epochs=1)
+    e = _make_engine(tmp_path, synthetic_cohort, algorithm="local",
+                     fault_spec="crash:1@1")
     assert e.fault_schedule is not None
 
 

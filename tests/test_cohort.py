@@ -381,10 +381,8 @@ def test_sharded_fused_window_losses_match_sequential(tmp_path, cohort21):
 
 @pytest.mark.parametrize("algorithm,needle", [
     ("fedfomo", "no cohort-sharded round body"),
-    ("dpsgd", "gossip collectives"),
     ("dispfl", "gossip collectives"),
     ("local", "no cohort-sharded round body"),
-    ("subavg", "no cohort-sharded round body"),
     ("turboaggregate", "MPC share boundary"),
 ])
 def test_engines_without_sharded_round_fall_back(tmp_path,

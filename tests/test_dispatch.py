@@ -107,8 +107,11 @@ def _one_round_outputs(eng):
 
 
 @pytest.mark.parametrize("algorithm", [
-    "fedavg", "salientgrads",
-    pytest.param("ditto", marks=pytest.mark.slow),  # tier-1 window (PR 7)
+    "fedavg",
+    # tier-1 870s window (PR 7/11 precedent): the fedavg twin keeps the
+    # donation pin; the stacked-state variants ride the full suite
+    pytest.param("salientgrads", marks=pytest.mark.slow),
+    pytest.param("ditto", marks=pytest.mark.slow),
 ])
 def test_donated_round_bitwise_equals_undonated(tmp_path, synthetic_cohort,
                                                 algorithm):
@@ -142,6 +145,7 @@ def test_donated_inputs_are_consumed(tmp_path, synthetic_cohort):
 # (b) K-fused scan == K sequential dispatches, bitwise
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): heavy twin rides the full suite; a lighter tier-1 sibling keeps the pin
 def test_fused_driver_bitwise_equal_sequential_fedavg(tmp_path,
                                                       synthetic_cohort):
     """The full driver end to end: a K=4 fedavg run — windows planned
@@ -296,6 +300,7 @@ def test_streaming_falls_back_with_logged_reason(tmp_path,
         eng.stream.close()
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): heavy twin rides the full suite; a lighter tier-1 sibling keeps the pin
 def test_streaming_fedavg_fused_window_bitwise(tmp_path, synthetic_cohort):
     """The fused STREAMED driver (ISSUE 10): a K=4 streamed fedavg run —
     whole-window shard stacks prefetched, one lax.scan dispatch per

@@ -1,0 +1,339 @@
+"""Round-program builder tests (ISSUE 11, engines/program.py).
+
+Contracts:
+
+(a) Newly-declared engines (ditto / dpsgd / subavg) gain fused
+    ``--rounds_per_dispatch`` windows: a K=4 window dispatched through
+    ``program.run_window`` equals four K=1 single dispatches BITWISE
+    (params, batch_stats, persistent per-client state, per-round
+    losses), with ONE compiled program per window (the ``built`` /
+    ``dispatches`` counters pin it). fedavg/fedprox/salientgrads keep
+    their pre-builder pins in tests/test_dispatch.py — unchanged, the
+    regression oracle of the port.
+(b) The same engines gain ``--client_mesh`` cohort sharding: the
+    sharded round from identical state matches the sequential C-loop
+    (losses bitwise, state to the ~1-ulp compile-context residue —
+    parallel/cohort.py contract, same bounds as tests/test_cohort.py).
+(c) Fallback reporting is unified: every reason is a key of
+    ``program.REASONS``, engines that declared stages stopped reporting
+    the old no-fused-body reason, and each announcement increments the
+    structured ``nidt_fallback_total{plane, engine, reason}`` counter
+    (value-pinned).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.config import (
+    DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+)
+from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+from neuroimagedisttraining_tpu.data.federate import federate_cohort
+from neuroimagedisttraining_tpu.engines import ENGINES, create_engine
+from neuroimagedisttraining_tpu.engines import program as round_program
+from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+ULP_RTOL = 1e-6
+ULP_ATOL = 1e-6
+
+
+def _engine(tmp_path, cohort, algorithm="ditto", K=1, comm_round=4,
+            freq=4, tag="p", epochs=1, client_mesh=0, seq=False,
+            donate=True, val_fraction=0.0, **fed_kw):
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm=algorithm,
+        data=DataConfig(dataset="synthetic", partition_method="site",
+                        val_fraction=val_fraction),
+        optim=OptimConfig(lr=1e-3, batch_size=8, epochs=epochs),
+        fed=FedConfig(client_num_in_total=4, comm_round=comm_round,
+                      frequency_of_the_test=freq, rounds_per_dispatch=K,
+                      client_mesh=client_mesh, **fed_kw),
+        log_dir=str(tmp_path), tag=tag)
+    mesh = make_mesh()
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1),
+                           cfg.optim, num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic", cfg.identity(),
+                           console=False)
+    fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh,
+                             val_fraction=val_fraction)
+    eng = create_engine(algorithm, cfg, fed, trainer, mesh=mesh,
+                        logger=log)
+    eng._donate = donate
+    if seq:
+        eng._cohort_sequential = True
+    return eng
+
+
+def _assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _assert_trees_ulp(a, b, rtol=ULP_RTOL, atol=ULP_ATOL):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x, np.float64),
+                                   np.asarray(y, np.float64),
+                                   rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# per-engine sequential references / initial carries
+# ---------------------------------------------------------------------------
+
+def _init_carry(eng):
+    gs = eng.init_global_state()
+    if eng.name in ("ditto", "salientgrads"):
+        per = eng.broadcast_states(gs, eng.num_clients)
+        return (gs.params, gs.batch_stats, per.params, per.batch_stats)
+    if eng.name == "subavg":
+        from neuroimagedisttraining_tpu.ops.masks import ones_mask
+
+        masks = eng.broadcast_states(ones_mask(gs.params),
+                                     eng.num_clients)
+        return (gs.params, gs.batch_stats, masks)
+    if eng.name == "dpsgd":
+        per = eng.broadcast_states(gs, eng.num_clients)
+        return (per.params, per.batch_stats)
+    return (gs.params, gs.batch_stats)
+
+
+def _one_round(eng, carry, r):
+    """One K=1 dispatch through the engine's legacy round adapter;
+    returns (new_carry, loss)."""
+    lr = eng.round_lr(r)
+    if eng.name == "dpsgd":
+        M_np = eng.mixing_matrix(r)
+        plan, plan_arrays = eng.gossip_plan(M_np)
+        rngs = eng.per_client_rngs(r, np.arange(eng.num_clients))
+        out = eng._round_jit_for(plan)(*carry, eng.data,
+                                       jnp.asarray(M_np), rngs, lr,
+                                       plan_arrays)
+        return out[:2], out[4]
+    sampled = eng.client_sampling(r)
+    rngs = eng.per_client_rngs(r, sampled)
+    n = len(carry)
+    out = eng._round_jit(*carry, eng.data, jnp.asarray(sampled),
+                         rngs, lr)
+    return out[:n], out[n]
+
+
+# ---------------------------------------------------------------------------
+# (a) fused K=4 == 4 x K=1, bitwise, one compiled program per window
+# ---------------------------------------------------------------------------
+
+# tier-1 window budget (PR 2/7/9 precedent): the heavy bitwise pins ride
+# the full suite; tier-1 keeps the cheap fallback/counter/reason pins
+# below plus the builder coverage every per-round engine test already
+# exercises (all K=1 dispatches now route through engines/program.py)
+@pytest.mark.parametrize("algorithm,fed_kw", [
+    pytest.param("ditto", {"frac": 0.5}, marks=pytest.mark.slow),
+    pytest.param("subavg", {"frac": 0.5}, marks=pytest.mark.slow),
+    pytest.param("dpsgd", {"cs": "ring", "frac": 0.5},
+                 marks=pytest.mark.slow),
+    pytest.param("dpsgd", {"cs": "random", "frac": 0.5},
+                 marks=pytest.mark.slow),
+])
+def test_fused_window_bitwise_equals_sequential(tmp_path,
+                                                synthetic_cohort,
+                                                algorithm, fed_kw):
+    """The newly-declared engines' K-round scan: a K=4 window equals
+    four single dispatches bitwise in the full carried state and the
+    per-round losses — and the window is ONE compiled program, dispatched
+    once (program.built / program.dispatches pins)."""
+    seq = _engine(tmp_path, synthetic_cohort, algorithm, K=1,
+                  tag=f"sq-{algorithm}-{len(fed_kw)}", **fed_kw)
+    carry = _init_carry(seq)
+    losses = []
+    for r in range(4):
+        carry, loss = _one_round(seq, carry, r)
+        losses.append(float(loss))
+    # the dispatch counter is the bench's evidence: 4 sequential rounds
+    # = 4 invocations of 1 compiled program
+    assert seq.program.dispatches == 4
+    assert seq.program.built == 1
+
+    fz = _engine(tmp_path, synthetic_cohort, algorithm, K=4,
+                 tag=f"fz-{algorithm}-{len(fed_kw)}", **fed_kw)
+    assert fz.fused_fallback_reason() is None
+    fcarry = _init_carry(fz)
+    built0 = fz.program.built
+    fcarry, _, outs, wi = fz.program.run_window(fcarry, 0, 4)
+    assert wi.k == 4
+    assert [float(x) for x in np.asarray(outs["loss"])] == losses
+    _assert_trees_bitwise(carry, fcarry)
+    # one compiled program, one dispatch, for the whole window
+    assert fz.program.built - built0 == 1
+    assert fz.program.dispatches == 1
+    assert len(fz.__dict__["_fused_round_jit_cache"]) == 1
+
+
+@pytest.mark.slow
+def test_fused_driver_end_to_end_bitwise_ditto(tmp_path,
+                                               synthetic_cohort):
+    """The full ditto driver: a K=4 train() — windows planned around the
+    eval cadence, personal stacks carried, hooks at boundaries — equals
+    the K=1 run bitwise in global AND personal state, metrics history
+    included."""
+    r1 = _engine(tmp_path, synthetic_cohort, "ditto", K=1, frac=0.5,
+                 tag="dk1").train()
+    e4 = _engine(tmp_path, synthetic_cohort, "ditto", K=4, frac=0.5,
+                 tag="dk4")
+    r4 = e4.train()
+    _assert_trees_bitwise(r1["params"], r4["params"])
+    _assert_trees_bitwise(r1["personal_params"], r4["personal_params"])
+    assert r1["history"] == r4["history"]
+    # windows reused ONE fused program per distinct plan
+    assert len(e4.__dict__["_fused_round_jit_cache"]) == 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("algorithm,key", [
+    ("subavg", "params"),
+    ("dpsgd", "personal_params"),
+])
+def test_fused_driver_end_to_end_bitwise(tmp_path, synthetic_cohort,
+                                         algorithm, key):
+    kw = {"cs": "ring", "frac": 0.5} if algorithm == "dpsgd" \
+        else {"frac": 0.5}
+    r1 = _engine(tmp_path, synthetic_cohort, algorithm, K=1,
+                 tag=f"ek1{algorithm}", **kw).train()
+    r4 = _engine(tmp_path, synthetic_cohort, algorithm, K=4,
+                 tag=f"ek4{algorithm}", **kw).train()
+    _assert_trees_bitwise(r1[key], r4[key])
+    assert r1["history"] == r4["history"]
+
+
+# ---------------------------------------------------------------------------
+# (b) cohort sharding for the newly-declared engines
+# ---------------------------------------------------------------------------
+
+def _one_sharded_round(eng, r=0):
+    carry = _init_carry(eng)
+    lr = eng.round_lr(r)
+    if eng.name == "dpsgd":
+        M_np = eng.mixing_matrix(r)
+        plan, plan_arrays = eng.gossip_plan(M_np)
+        rngs = eng.per_client_rngs(r, np.arange(eng.num_clients))
+        return eng._round_jit_for(plan)(*carry, eng.data,
+                                        jnp.asarray(M_np), rngs, lr,
+                                        plan_arrays)
+    sampled = eng.client_sampling(r)
+    ids, n_real = eng._cohort_pad(sampled)
+    rngs = eng.per_client_rngs(r, ids)
+    return eng._sharded_round_jit(n_real)(*carry, eng.data,
+                                          jnp.asarray(ids), rngs, lr)
+
+
+@pytest.mark.parametrize("algorithm,loss_i,epochs", [
+    pytest.param("ditto", 4, 1, marks=pytest.mark.slow),
+    pytest.param("subavg", 3, 2, marks=pytest.mark.slow),
+    pytest.param("dpsgd", 4, 1, marks=pytest.mark.slow),
+])
+def test_sharded_round_vs_sequential_loop(tmp_path, synthetic_cohort,
+                                          algorithm, loss_i, epochs):
+    """The sharded round vs the sequential C-loop reference
+    (_cohort_sequential): per-client work identical by construction, so
+    the round loss is bitwise (ditto/dpsgd; subavg's two-phase masked
+    composite is allowed the same 1-ulp seam as salientgrads' masked
+    round) and state agrees to the ~1-ulp compile-context residue
+    (parallel/cohort.py). subavg runs epochs=2 so the hoisted two-call
+    permutation chain (epoch-1 + tail) is load-bearing; an rng-replay
+    drift would show as 1e-0-level loss divergence."""
+    eng_sh = _engine(tmp_path, synthetic_cohort, algorithm,
+                     client_mesh=8, epochs=epochs, donate=False,
+                     tag=f"sh{algorithm}")
+    eng_sq = _engine(tmp_path, synthetic_cohort, algorithm,
+                     client_mesh=8, epochs=epochs, donate=False,
+                     seq=True, tag=f"sq{algorithm}")
+    assert eng_sh._cohort_on and eng_sq._cohort_on
+    out_sh = _one_sharded_round(eng_sh)
+    out_sq = _one_sharded_round(eng_sq)
+    if algorithm == "subavg":
+        np.testing.assert_allclose(float(out_sh[loss_i]),
+                                   float(out_sq[loss_i]), rtol=3e-7)
+    else:
+        np.testing.assert_array_equal(np.asarray(out_sh[loss_i]),
+                                      np.asarray(out_sq[loss_i]))
+    _assert_trees_ulp(out_sh, out_sq)
+
+
+# ---------------------------------------------------------------------------
+# (c) unified fallback reporting
+# ---------------------------------------------------------------------------
+
+def test_reason_table_has_no_orphans(tmp_path, synthetic_cohort):
+    """Single source of truth: every engine's fallback keys resolve in
+    REASONS, declared engines stopped reporting the old no-fused-body
+    reason, and no key in the table is unreachable by construction (the
+    lint rule round-program-reason rejects ad-hoc strings)."""
+    declared = {"fedavg", "fedprox", "salientgrads", "ditto", "dpsgd",
+                "subavg"}
+    seen = set()
+    for name, cls in ENGINES.items():
+        if name in ("sailentgrads", "sub-fedavg"):  # registry aliases
+            continue
+        kw = {"val_fraction": 0.25} if name == "fedfomo" else {}
+        eng = _engine(tmp_path, synthetic_cohort, name, K=4,
+                      tag=f"rt-{name}", **kw)
+        key = eng.fused_fallback_key()
+        ckey = eng.program.cohort_fallback_key()
+        for k in (key, ckey):
+            if k is not None:
+                assert k in round_program.REASONS, (name, k)
+                seen.add(k)
+        if name in declared:
+            assert key is None, (name, key)
+            assert eng.fused_fallback_reason() is None
+        else:
+            assert key is not None
+            assert eng.fused_fallback_reason() == \
+                round_program.reason(key)
+    # every key the engine matrix announced is a table key, and every
+    # message renders from the table (no orphaned ad-hoc strings — the
+    # round-program-reason lint rule enforces the source side)
+    for k in seen:
+        assert round_program.REASONS[k][0] in ("fused", "sharding",
+                                               "streaming")
+
+
+def test_fallback_counter_value_pinned(tmp_path, synthetic_cohort):
+    """Every announced fallback increments
+    nidt_fallback_total{plane, engine, reason} — scrapeable, not
+    grep-able. Constructing a K=4 fedfomo engine announces exactly one
+    fused fallback with the table key."""
+    c = obs_metrics.counter(
+        "nidt_fallback_total", labelnames=("plane", "engine", "reason"))
+    labels = dict(plane="fused", engine="fedfomo",
+                  reason="no-fused-body")
+    before = c.get(**labels)
+    _engine(tmp_path, synthetic_cohort, "fedfomo", K=4,
+            val_fraction=0.25, tag="ctr")
+    assert c.get(**labels) == before + 1.0
+    # and a sharding fallback announcement rides the same counter
+    sh_labels = dict(plane="sharding", engine="local",
+                     reason="no-sharded-body")
+    before_sh = c.get(**sh_labels)
+    _engine(tmp_path, synthetic_cohort, "local", K=1, client_mesh=8,
+            tag="ctr2")
+    assert c.get(**sh_labels) == before_sh + 1.0
+
+
+def test_wire_codec_still_collapses_with_counted_reason(
+        tmp_path, synthetic_cohort):
+    """Declared engines still fall back per MODE: fedavg + --wire_codec
+    reports the wire-codec-host-bytes key (counted), not the stale
+    no-fused-body reason."""
+    eng = _engine(tmp_path, synthetic_cohort, "fedavg", K=4,
+                  wire_codec="delta+quant", tag="wck")
+    assert eng.fused_fallback_key() == "wire-codec-host-bytes"
+
+

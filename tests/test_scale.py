@@ -189,6 +189,7 @@ def test_salientgrads_100clients_resident_and_streaming(tmp_path,
         stream_engine.stream.close()
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): per-engine streamed==resident e2e twins ride the full suite; the fedavg/salientgrads/local siblings + the streamed machinery tests keep tier-1 coverage
 def test_ditto_100clients_streamed_round_matches_resident(tmp_path,
                                                           scale_cohort):
     """Ditto's guarded personal-state scatter + n-weighted aggregation
@@ -230,6 +231,7 @@ def test_ditto_100clients_streamed_round_matches_resident(tmp_path,
         st.stream.close()
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): per-engine streamed==resident e2e twins ride the full suite; the fedavg/salientgrads/local siblings + the streamed machinery tests keep tier-1 coverage
 def test_subavg_100clients_streamed_round_matches_resident(tmp_path,
                                                            scale_cohort):
     """Sub-FedAvg's count-based aggregation and mask scatter explicitly

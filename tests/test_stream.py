@@ -172,6 +172,7 @@ def _open_stream(path):
                                      test_map)
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): per-engine streamed==resident e2e twins ride the full suite; the fedavg/salientgrads/local siblings + the streamed machinery tests keep tier-1 coverage
 def test_streaming_subavg_identical_to_resident(h5_cohort, tmp_path):
     """Sub-FedAvg streams its sampled clients' shards per round; personal
     masks stay resident. Streamed == resident bitwise."""
@@ -193,6 +194,7 @@ def test_streaming_subavg_identical_to_resident(h5_cohort, tmp_path):
                                   st["client_densities"])
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): per-engine streamed==resident e2e twins ride the full suite; the fedavg/salientgrads/local siblings + the streamed machinery tests keep tier-1 coverage
 def test_streaming_dispfl_identical_to_resident(h5_cohort, tmp_path):
     """DisPFL trains every client per round, so the streamed round chunks
     local training (chunk=2 < 4 clients exercises real chunking); the
@@ -244,6 +246,7 @@ def test_streaming_salientgrads_chunked_phase1(h5_cohort, tmp_path):
     assert res["final_global"] == st["final_global"]
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): per-engine streamed==resident e2e twins ride the full suite; the fedavg/salientgrads/local siblings + the streamed machinery tests keep tier-1 coverage
 def test_streaming_ditto_identical_to_resident(h5_cohort, tmp_path):
     """Ditto's two tracks only consume sampled clients' shards — the
     streamed round is shape-identical to resident, so bitwise equal."""
@@ -284,6 +287,7 @@ def test_streaming_local_identical_to_resident(h5_cohort, tmp_path):
     assert res["final_personal"] == st["final_personal"]
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): per-engine streamed==resident e2e twins ride the full suite; the fedavg/salientgrads/local siblings + the streamed machinery tests keep tier-1 coverage
 def test_streaming_dpsgd_identical_to_resident(h5_cohort, tmp_path):
     """D-PSGD: state-only gossip consensus + chunked local training."""
     path, data = h5_cohort
@@ -304,6 +308,7 @@ def test_streaming_dpsgd_identical_to_resident(h5_cohort, tmp_path):
     _assert_final_metrics(res["final_global"], st["final_global"])
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): per-engine streamed==resident e2e twins ride the full suite; the fedavg/salientgrads/local siblings + the streamed machinery tests keep tier-1 coverage
 def test_streaming_turboaggregate_identical_to_resident(h5_cohort,
                                                         tmp_path):
     """TurboAggregate inherits FedAvg's streamed loop; the MPC stage is
@@ -324,6 +329,7 @@ def test_streaming_turboaggregate_identical_to_resident(h5_cohort,
     assert res["final_global"] == st["final_global"]
 
 
+@pytest.mark.slow  # tier-1 870s window (PR 11, the PR 2/7 precedent): per-engine streamed==resident e2e twins ride the full suite; the fedavg/salientgrads/local siblings + the streamed machinery tests keep tier-1 coverage
 def test_streaming_fedfomo_identical_to_resident(h5_cohort, tmp_path):
     """FedFomo — the last engine onto the streaming list (VERDICT r3
     next-step #5): train shards chunk through stream_map_train_chunks
