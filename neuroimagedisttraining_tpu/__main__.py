@@ -175,12 +175,18 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "client<->server boundary)")
     # privacy plane (privacy/, ISSUE 8)
     parser.add_argument("--secure_quant", action="store_true",
-                        help="secure QUANTIZED aggregation: uploads ride "
-                             "as field-element frames in a small GF(p) "
-                             "(privacy/secure_quant.py). The encoded "
-                             "secure wire lives on the cross-silo/async "
-                             "control planes (distributed.run); recorded "
-                             "in the config for parity")
+                        help="secure QUANTIZED aggregation "
+                             "(privacy/secure_quant.py): the simulated "
+                             "round aggregates through the jitted GF(p) "
+                             "integer-weight fold (the builder's codec-"
+                             "family stage, engines/program.py — bitwise "
+                             "the host SlotAccumulator fold), so round "
+                             "metrics reflect exactly what the encoded "
+                             "secure wire would deliver; the wire itself "
+                             "lives on the cross-silo/async planes "
+                             "(distributed.run). Needs "
+                             "--secure_quant_field_bits 32 (the one-"
+                             "phase capacity bound)")
     parser.add_argument("--secure_quant_field_bits", type=int, default=16,
                         choices=(8, 16, 32),
                         help="secure_quant field width: p = largest prime "
@@ -605,6 +611,34 @@ def main(argv: list[str] | None = None) -> int:
                 f"would train un-noised while the accountant reported "
                 f"epsilon (supported: {ok})")
     if args.secure_quant:
+        # privacy-plane conflicts die AT ARGPARSE with the resolution
+        # named (the engine ctor re-checks, but only after the
+        # data/model build, deep in a stack trace)
+        from neuroimagedisttraining_tpu.core import robust
+        from neuroimagedisttraining_tpu.engines import ENGINES
+
+        cls = ENGINES.get(args.algorithm.lower())
+        if cls is None or not cls.supports_secure_quant:
+            ok = sorted({c.name for c in ENGINES.values()
+                         if c.supports_secure_quant})
+            parser.error(
+                f"--secure_quant needs an engine whose round routes the "
+                f"builder's default aggregation tail; algorithm "
+                f"{args.algorithm!r} has no server fold for the field "
+                f"algebra to replace (supported: {ok})")
+        if args.wire_codec not in ("", "none"):
+            parser.error(
+                "--secure_quant does not compose with --wire_codec "
+                "(the codec's float stages would corrupt the GF(p) "
+                "residue embedding); see ARCHITECTURE.md 'Privacy "
+                "plane'")
+        if args.defense_type in robust.ROBUST_AGGREGATORS:
+            parser.error(
+                f"--defense {args.defense_type} does not compose with "
+                "--secure_quant (no per-client plaintext to select "
+                "over); the clip family (norm_diff_clipping, weak_dp) "
+                "composes client-side — see ARCHITECTURE.md 'Privacy "
+                "plane'")
         # field-geometry headroom fails at argparse here exactly like
         # distributed.run's startup check — misconfigured frac/field
         # bits must never surface as silent field wraparound

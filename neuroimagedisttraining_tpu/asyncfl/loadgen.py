@@ -2,10 +2,13 @@
 
 The point of the asynchronous control plane is a population no
 thread-per-connection server can hold; this module proves it ON THIS BOX
-with an asyncio client fleet in one thread — each simulated client is a
-coroutine holding one persistent connection, speaking the real protocol
+with an asyncio client fleet — each simulated client is a coroutine
+holding one persistent connection, speaking the real protocol
 (register -> version-tagged sync -> upload echoing the tag), uploading a
-canned update pytree instead of training. Churn comes from the seeded
+canned update pytree instead of training. For bench cells the fleet
+shards across ``fleet_procs`` PROCESSES (one asyncio loop is ~a core of
+socket syscalls on this box; an unsharded generator caps near the
+server's own throughput and measures itself). Churn comes from the seeded
 ``FaultSchedule``: ``crash:RANK@ROUND`` disconnects the client when it
 observes that version, ``rejoin:RANK@ROUND`` reconnects and re-registers
 once the server's version reaches the rejoin point, ``straggle:P:MAX_S``
@@ -36,6 +39,10 @@ import argparse
 import asyncio
 import dataclasses
 import json
+import logging
+import multiprocessing as mp
+import os
+import signal
 import struct
 import threading
 import time
@@ -51,6 +58,9 @@ from neuroimagedisttraining_tpu.faults.schedule import (
     FaultSchedule,
     parse_fault_spec,
 )
+
+
+log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
 
 
 def canned_update_tree(rank: int, leaf_elems: int = 256) -> dict:
@@ -74,6 +84,9 @@ class ClientStats:
     rejoins: int = 0
     finished: int = 0
     errors: int = 0
+    #: sampled upload->sync round-trips (ms, every 4th), fleet-merged
+    #: by list concatenation in run_load's aggregation loop
+    rtt_ms: list = dataclasses.field(default_factory=list)
 
 
 def _frame(msg: M.Message) -> bytes:
@@ -118,7 +131,8 @@ async def _run_client(rank: int, port: int, update: dict,
                       num_samples: float, stats: ClientStats,
                       schedule: FaultSchedule | None,
                       version_probe, server_done, train_delay: float,
-                      start_stagger: float, report_corpse=None) -> None:
+                      start_stagger: float, report_corpse=None,
+                      reconnect: bool = False) -> None:
     """One simulated client: persistent connection, real protocol, canned
     uploads, schedule-driven churn. ``version_probe``/``server_done``
     peek at the in-process server so a crashed client knows when its
@@ -131,14 +145,35 @@ async def _run_client(rank: int, port: int, update: dict,
         return
     reader, writer = conn
     seq = 0
+    t_sent = None
+
+    async def _lost_connection() -> bool:
+        """Unexpected connection loss. Returns True when the client
+        should keep running (reconnected — the sharded ingest plane's
+        kill-one-worker story: the kernel re-balances the fresh
+        connection onto a surviving listener), False to stop."""
+        nonlocal reader, writer
+        if server_done():
+            stats.finished += 1
+            return False
+        if not reconnect:
+            stats.errors += 1
+            return False
+        stats.errors += 1
+        c = await _connect_and_register(rank, port, server_done)
+        if c is None:
+            stats.finished += 1
+            return False
+        stats.rejoins += 1
+        reader, writer = c
+        return True
+
     while True:
         try:
             msg = await _read_msg(reader)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            if server_done():
-                stats.finished += 1
-            else:
-                stats.errors += 1
+            if await _lost_connection():
+                continue
             return
         if msg.msg_type == M.MSG_TYPE_S2C_FINISH:
             stats.finished += 1
@@ -146,6 +181,11 @@ async def _run_client(rank: int, port: int, update: dict,
             return
         version = int(msg.get(M.ARG_ROUND_IDX, 0))
         stats.syncs_seen += 1
+        if t_sent is not None:
+            if seq % 4 == 0:
+                stats.rtt_ms.append(
+                    1e3 * (time.monotonic() - t_sent))
+            t_sent = None
         if schedule is not None and schedule.crashed(version, rank):
             # simulated SIGKILL: drop the connection, then wait out the
             # crash window (rejoin directive) by watching the server's
@@ -192,13 +232,64 @@ async def _run_client(rank: int, port: int, update: dict,
             writer.write(buf)
             await writer.drain()
         except (ConnectionError, OSError):
-            if server_done():
-                stats.finished += 1
-            else:
-                stats.errors += 1
+            if await _lost_connection():
+                continue
             return
         stats.sent += 1
         stats.bytes_sent += len(buf)
+        t_sent = time.monotonic()
+
+
+def bench_payload(r: int, leaf_elems: int, quant, seed: int):
+    """The canned upload of one simulated client — shared by the
+    in-process fleet and the spawned fleet shards so the two generators
+    stay byte-identical. Secure path: ONE field-element frame (masks
+    cancel inside the frame, so reusing it upload-to-upload is sound;
+    seq dedups)."""
+    if quant is not None:
+        from neuroimagedisttraining_tpu.privacy import encode_secure_quant
+
+        rng = np.random.default_rng(31337 * (seed + 1) + r)
+        return encode_secure_quant(canned_update_tree(r, leaf_elems),
+                                   1.0, quant, rng)
+    return canned_update_tree(r, leaf_elems)
+
+
+def _fleet_proc_main(conn, ranks, port, leaf_elems, secure, seed,
+                     train_delay, ready_go, done_ev, reconnect) -> None:
+    """Spawned fleet shard (loadgen scale-out). One asyncio client loop
+    is ~one core of SYSCALL work on this box (socket.send alone profiles
+    at ~0.5 ms in this kernel), so a single-process fleet caps near the
+    server's own throughput and would measure ITSELF. The bench drives
+    the server from several fleet processes instead: each shard runs the
+    same ``_run_client`` coroutines over its rank slice and ships its
+    ``ClientStats`` home over the pipe. The shard imports and builds its
+    payloads BEFORE signalling ready, and starts connecting only on the
+    go event — interpreter spawn never leaks into the measured window."""
+    quant = None
+    if secure:
+        from neuroimagedisttraining_tpu.privacy import QuantSpec
+
+        quant = QuantSpec.from_bits(32, 10, 3)
+
+    payloads = {r: bench_payload(r, leaf_elems, quant, seed)
+                for r in ranks}
+    stats = {r: ClientStats() for r in ranks}
+
+    async def fleet():
+        tasks = [asyncio.create_task(_run_client(
+            r, port, payloads[r], float(8 + r % 5), stats[r], None,
+            lambda: -1, done_ev.is_set, train_delay,
+            start_stagger=r * 0.002, report_corpse=None,
+            reconnect=reconnect))
+            for r in ranks]
+        await asyncio.gather(*tasks)
+
+    conn.send("ready")  # nidt: allow[lock-send] -- the shard's end of the pipe has exactly one writer: this function, sequentially
+    ready_go.wait()
+    asyncio.run(fleet())
+    conn.send([dataclasses.asdict(s) for s in stats.values()])  # nidt: allow[lock-send] -- same single sequential writer
+    conn.close()
 
 
 class _TimedSyncServer(FedAvgServer):
@@ -220,14 +311,28 @@ def run_load(mode: str = "async", num_clients: int = 200,
              fault_spec: str = "", seed: int = 0,
              train_delay: float = 0.0, leaf_elems: int = 256,
              sync_round_deadline: float = 5.0,
-             base_port: int | None = None) -> dict:
+             base_port: int | None = None,
+             ingest_workers: int = 2,
+             ingest_kill_at: int = -1,
+             ingest_secure_quant: bool = False,
+             fleet_procs: int = 1) -> dict:
     """Drive ``num_clients`` simulated clients against one server and
     return the metrics dict. ``mode="async"`` runs the buffered server
     for ``aggregations`` aggregations of ``buffer_k`` uploads each;
     ``mode="sync"`` runs the round-synchronous server for the number of
-    rounds that consumes a comparable upload volume."""
-    if mode not in ("async", "sync"):
-        raise ValueError(f"mode must be async|sync, got {mode!r}")
+    rounds that consumes a comparable upload volume; ``mode="ingest"``
+    runs the SHARDED ingest plane (asyncfl/ingest.py):
+    ``ingest_workers`` selector worker processes on one SO_REUSEPORT
+    port folding partials into the root. ``ingest_kill_at >= 0``
+    SIGKILLs worker 0 once the version reaches that value (the chaos
+    cell — clients reconnect onto the surviving listeners and the
+    audit must stay green, lost uploads accounted). ``fleet_procs > 1``
+    shards the CLIENT fleet across that many processes (bench cells
+    only — fault schedules need the in-process server probes and pin
+    ``fleet_procs=1``); the same fleet drives every mode, so the
+    comparison stays generator-fair."""
+    if mode not in ("async", "sync", "ingest"):
+        raise ValueError(f"mode must be async|sync|ingest, got {mode!r}")
     port = base_port if base_port is not None else free_port_block(2)
     k = int(buffer_k) if buffer_k else num_clients
     init = canned_update_tree(0, leaf_elems)
@@ -238,15 +343,34 @@ def run_load(mode: str = "async", num_clients: int = 200,
     # client that stops draining must stall the dispatch thread for at
     # most 2 s, not the 30 s default — the p99 numbers exist to measure
     # the control plane, not one stuck peer
-    comm = SelectorCommManager(0, num_clients + 1, base_port=port,
-                               send_timeout=2.0)
-    if mode == "async":
+    comm = None
+    quant = None
+    if mode == "ingest":
+        from neuroimagedisttraining_tpu.asyncfl.ingest import (
+            ShardedIngestServer,
+        )
+
+        if ingest_secure_quant:
+            from neuroimagedisttraining_tpu.privacy import QuantSpec
+
+            quant = QuantSpec.from_bits(32, 10, 3)
+        server = ShardedIngestServer(
+            init, aggregations, num_clients,
+            ingest_workers=ingest_workers, buffer_k=k,
+            staleness_alpha=staleness_alpha, max_staleness=max_staleness,
+            base_port=port, secure_quant=quant)
+        rounds = aggregations
+    elif mode == "async":
+        comm = SelectorCommManager(0, num_clients + 1, base_port=port,
+                                   send_timeout=2.0)
         server = BufferedFedAvgServer(
             init, aggregations, num_clients, buffer_k=k,
             staleness_alpha=staleness_alpha, max_staleness=max_staleness,
             comm=comm)
         rounds = aggregations
     else:
+        comm = SelectorCommManager(0, num_clients + 1, base_port=port,
+                                   send_timeout=2.0)
         rounds = max(2, (aggregations * k) // num_clients)
         server = _TimedSyncServer(
             init, rounds, num_clients, comm=comm,
@@ -275,25 +399,91 @@ def run_load(mode: str = "async", num_clients: int = 200,
         # buffer (buffer_k=0) plus one permanent crash can never fill —
         # _k_eff only shrinks on suspicion. Real deployments arm
         # --heartbeat_interval/--heartbeat_timeout for the same signal.
-        if mode == "async":
+        # The ingest root keeps the same _suspect/_k_eff machinery; its
+        # event loop re-checks the harvest trigger on its next tick.
+        if mode in ("async", "ingest"):
             with server._rlock:
                 server._suspect.add(rank)
                 server._maybe_complete()
         # the sync server's deadline/quorum path handles corpses itself
 
+    def client_payload(r):
+        return bench_payload(r, leaf_elems, quant, seed)
+
     async def _fleet():
         # ~500 connects/s ramp: enough to dodge backlog overflow, short
         # against the measured window
         tasks = [asyncio.create_task(_run_client(
-            r, port, canned_update_tree(r, leaf_elems), float(8 + r % 5),
+            r, port, client_payload(r), float(8 + r % 5),
             stats[r], schedule, version_probe, server_done, train_delay,
-            start_stagger=r * 0.002, report_corpse=report_corpse))
+            start_stagger=r * 0.002, report_corpse=report_corpse,
+            reconnect=(mode == "ingest")))
             for r in range(1, num_clients + 1)]
         await asyncio.gather(*tasks)
 
+    if fleet_procs > 1 and (schedule is not None or mode == "sync"):
+        raise ValueError(
+            "fleet_procs > 1 drives bench cells only: fault schedules "
+            "need the in-process server probes (version_probe/"
+            "report_corpse) and the sync server's barrier needs the "
+            "single fleet's completion semantics")
+    fleet_workers: list[tuple] = []
+    ready_go = done_ev = None
+    if fleet_procs > 1:
+        # spawn + import + payload build happen BEFORE t0 (children
+        # signal ready, then wait for go) — interpreter startup never
+        # leaks into the measured accept window
+        ctx = mp.get_context("spawn")
+        ready_go, done_ev = ctx.Event(), ctx.Event()
+        slices = np.array_split(np.arange(1, num_clients + 1),
+                                fleet_procs)
+        for sl in slices:
+            parent_c, child_c = ctx.Pipe(duplex=False)
+            p = ctx.Process(
+                target=_fleet_proc_main,
+                args=(child_c, [int(r) for r in sl], port, leaf_elems,
+                      quant is not None, seed, train_delay, ready_go,
+                      done_ev, mode == "ingest"),
+                daemon=True, name="nidt-loadgen-fleet")
+            p.start()
+            child_c.close()
+            fleet_workers.append((p, parent_c))
+        for p, c in fleet_workers:
+            if not c.poll(300.0) or c.recv() != "ready":
+                raise RuntimeError("loadgen fleet shard failed to start")
+
     t0 = time.monotonic()
     server_thread.start()
-    asyncio.run(_fleet())
+    if mode == "ingest" and ingest_kill_at >= 0:
+        def _kill_watch():
+            # the chaos cell: SIGKILL worker 0 once the version reaches
+            # the trigger — its clients reconnect onto the surviving
+            # SO_REUSEPORT listeners and the audit must stay green
+            while not server_done():
+                if server.round_idx >= ingest_kill_at:
+                    try:
+                        os.kill(server.worker_pids[0], signal.SIGKILL)
+                    except (OSError, IndexError):
+                        pass
+                    return
+                time.sleep(0.02)
+
+        threading.Thread(target=_kill_watch, daemon=True).start()
+    if fleet_procs > 1:
+        ready_go.set()
+        if not server._done.wait(timeout=600.0):
+            log.warning("loadgen: server not done after 600s; "
+                        "collecting what the fleet has")
+        done_ev.set()
+        for p, c in fleet_workers:
+            if c.poll(60.0):
+                for d in c.recv():
+                    stats.append(ClientStats(**d))
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+    else:
+        asyncio.run(_fleet())
     server_thread.join(timeout=60.0)
     wall = time.monotonic() - t0
 
@@ -302,7 +492,7 @@ def run_load(mode: str = "async", num_clients: int = 200,
         for f in dataclasses.fields(ClientStats):
             setattr(fleet, f.name,
                     getattr(fleet, f.name) + getattr(s, f.name))
-    if mode == "async":
+    if mode in ("async", "ingest"):
         adv_t = [h["t"] for h in server.history]
         accepted = server.upload_stats["accepted"]
         audit = server.upload_audit()
@@ -318,12 +508,23 @@ def run_load(mode: str = "async", num_clients: int = 200,
         audit = {"received_accounted": True, "accepted_accounted": True}
     deltas_ms = (1e3 * np.diff(np.asarray(adv_t))
                  if len(adv_t) >= 2 else np.asarray([]))
+    # sustained ingest throughput: uploads that reached an aggregation,
+    # over the window from fleet start to the LAST aggregation — the
+    # teardown tail (FINISH fan-out, worker joins) measures shutdown,
+    # not the ingest plane, and its variance would swamp short cells
+    aggregated_hist = sum(h["clients"] for h in server.history)
+    accept_window = (adv_t[-1] - t0) if adv_t else None
+    sustained = (round(aggregated_hist / accept_window, 2)
+                 if accept_window else None)
+    buffered_modes = ("async", "ingest")
     result = {
         "mode": mode,
         "num_clients": num_clients,
-        "buffer_k": k if mode == "async" else None,
-        "staleness_alpha": staleness_alpha if mode == "async" else None,
-        "max_staleness": max_staleness if mode == "async" else None,
+        "buffer_k": k if mode in buffered_modes else None,
+        "staleness_alpha": (staleness_alpha if mode in buffered_modes
+                            else None),
+        "max_staleness": (max_staleness if mode in buffered_modes
+                          else None),
         "rounds_or_aggregations": len(server.history),
         "target": aggregations if mode == "async" else rounds,
         "fault_spec": fault_spec,
@@ -331,6 +532,9 @@ def run_load(mode: str = "async", num_clients: int = 200,
         "uploads_sent": fleet.sent,
         "uploads_accepted": accepted,
         "uploads_per_s": round(accepted / wall, 2) if wall else 0.0,
+        "uploads_per_s_sustained": sustained,
+        "accept_window_s": (round(accept_window, 3)
+                            if accept_window else None),
         "sent_per_s": round(fleet.sent / wall, 2) if wall else 0.0,
         "aggregations_per_s": (round(len(server.history) / wall, 3)
                                if wall else 0.0),
@@ -338,24 +542,49 @@ def run_load(mode: str = "async", num_clients: int = 200,
             np.percentile(deltas_ms, 50)), 2) if deltas_ms.size else None),
         "version_advance_p99_ms": (round(float(
             np.percentile(deltas_ms, 99)), 2) if deltas_ms.size else None),
-        "peak_connections": comm.peak_connections,
-        "client_stats": dataclasses.asdict(fleet),
-        "byte_stats": comm.byte_stats(),
+        # client-observed upload->sync round-trip (sampled every 4th):
+        # the per-upload service latency of the whole plane, the number
+        # that localizes a throughput ceiling (queueing at the server
+        # side shows here long before any process pegs a core)
+        "rtt_ms_p50": (round(float(np.percentile(fleet.rtt_ms, 50)), 2)
+                       if fleet.rtt_ms else None),
+        "rtt_ms_p99": (round(float(np.percentile(fleet.rtt_ms, 99)), 2)
+                       if fleet.rtt_ms else None),
+        "peak_connections": (server.peak_connection_estimate()
+                             if mode == "ingest"
+                             else comm.peak_connections),
+        "client_stats": {k: v for k, v in
+                         dataclasses.asdict(fleet).items()
+                         if k != "rtt_ms"},
+        "byte_stats": (server.worker_byte_stats() if mode == "ingest"
+                       else comm.byte_stats()),
         "upload_audit": audit,
         # async: every client has at most one upload in flight when the
         # server stops reading, so sent can exceed received by at most
         # the fleet size — anything else is a lost or double-counted
-        # frame. Sync: the server keeps no received counter (deadline
-        # rounds drop stale uploads by design), so only accepted <= sent
-        # is provable.
+        # frame. Ingest: a killed worker's socket buffers can hold any
+        # number of sent-but-never-read frames, so only the one-sided
+        # received <= sent bound is provable (the audit itself is the
+        # zero-lost/zero-double-counted check). Sync: the server keeps
+        # no received counter (deadline rounds drop stale uploads by
+        # design), so only accepted <= sent is provable.
         "frames_reconciled": bool(
             audit["received_accounted"] and audit["accepted_accounted"]
             and (accepted <= fleet.sent if received is None
                  else (received <= fleet.sent
-                       and fleet.sent - received <= num_clients))),
+                       and (mode == "ingest"
+                            or fleet.sent - received <= num_clients)))),
         "staleness_hist": (_staleness_hist(server.history)
-                           if mode == "async" else None),
+                           if mode in buffered_modes else None),
     }
+    if mode == "ingest":
+        result["ingest_workers"] = int(ingest_workers)
+        result["ingest_kill_at"] = (int(ingest_kill_at)
+                                    if ingest_kill_at >= 0 else None)
+        result["workers_live_at_end"] = server.live_workers()
+        result["secure_quant"] = bool(ingest_secure_quant)
+        result["lost_with_worker"] = int(
+            server.upload_stats["lost_with_worker"])
     return result
 
 
@@ -372,8 +601,14 @@ def main(argv=None) -> int:
         prog="neuroimagedisttraining_tpu.asyncfl.loadgen",
         description=__doc__.split("\n\n")[0])
     ap.add_argument("--clients", type=int, default=1000)
-    ap.add_argument("--mode", choices=("async", "sync", "both"),
-                    default="both")
+    ap.add_argument("--mode", choices=("async", "sync", "both", "ingest",
+                                       "ingest_bench"),
+                    default="both",
+                    help="ingest = one sharded-plane run at "
+                         "--ingest_workers; ingest_bench = the headline "
+                         "sweep (single-process async baseline, then "
+                         "ingest at N in {1, 2, 4} workers) -> "
+                         "bench_matrix/ingest_bench.json")
     ap.add_argument("--aggregations", type=int, default=30,
                     help="async: buffered aggregations to run; the sync "
                          "baseline runs the round count consuming a "
@@ -390,23 +625,102 @@ def main(argv=None) -> int:
     ap.add_argument("--train_delay", type=float, default=0.0,
                     help="seconds each client 'trains' per round")
     ap.add_argument("--leaf_elems", type=int, default=256)
+    ap.add_argument("--ingest_workers", type=int, default=2,
+                    help="selector worker processes for --mode ingest")
+    ap.add_argument("--ingest_kill_at", type=int, default=-1,
+                    help="SIGKILL ingest worker 0 at this version "
+                         "(chaos cell; -1 = never)")
+    ap.add_argument("--ingest_secure_quant", action="store_true",
+                    help="clients ship secure-quant field-element "
+                         "frames; workers fold SlotAccumulator chunks")
+    ap.add_argument("--fleet_procs", type=int, default=0,
+                    help="shard the client fleet across N processes "
+                         "(one asyncio loop is ~a core of syscalls on "
+                         "this box — a single-process fleet measures "
+                         "itself); 0 = 3 for the bench modes, 1 "
+                         "otherwise. Incompatible with --fault_spec")
     ap.add_argument("--out", type=str, default="",
                     help="write the JSON cell here (bench_matrix/"
                          "async_bench.json)")
     args = ap.parse_args(argv)
 
+    fleet_procs = args.fleet_procs
+    if fleet_procs == 0:
+        fleet_procs = (3 if args.mode == "ingest_bench"
+                       and not args.fault_spec else 1)
+    common = dict(
+        num_clients=args.clients, aggregations=args.aggregations,
+        buffer_k=args.buffer_k, staleness_alpha=args.staleness_alpha,
+        max_staleness=args.max_staleness, fault_spec=args.fault_spec,
+        seed=args.seed, train_delay=args.train_delay,
+        leaf_elems=args.leaf_elems, fleet_procs=fleet_procs)
     cells = {}
-    modes = ("async", "sync") if args.mode == "both" else (args.mode,)
-    for mode in modes:
-        cells[mode] = run_load(
-            mode=mode, num_clients=args.clients,
-            aggregations=args.aggregations, buffer_k=args.buffer_k,
-            staleness_alpha=args.staleness_alpha,
-            max_staleness=args.max_staleness,
-            fault_spec=args.fault_spec, seed=args.seed,
-            train_delay=args.train_delay, leaf_elems=args.leaf_elems)
-        print(json.dumps(cells[mode]), flush=True)
-    out = {"bench": "async_control_plane", **cells}
+    if args.mode == "ingest_bench":
+        # the headline sweep (ISSUE 12): the committed single-process
+        # selector baseline, then the sharded plane at N in {1, 2, 4}
+        # workers on the SAME cohort/churn/buffer configuration
+        cells["async"] = run_load(mode="async", **common)
+        print(json.dumps(cells["async"]), flush=True)
+        for n in (1, 2, 4):
+            cells[f"ingest_w{n}"] = run_load(
+                mode="ingest", ingest_workers=n,
+                ingest_secure_quant=args.ingest_secure_quant, **common)
+            print(json.dumps(cells[f"ingest_w{n}"]), flush=True)
+    else:
+        modes = (("async", "sync") if args.mode == "both"
+                 else (args.mode,))
+        for mode in modes:
+            kw = dict(common)
+            if mode == "ingest":
+                kw.update(ingest_workers=args.ingest_workers,
+                          ingest_kill_at=args.ingest_kill_at,
+                          ingest_secure_quant=args.ingest_secure_quant)
+            cells[mode] = run_load(mode=mode, **kw)
+            print(json.dumps(cells[mode]), flush=True)
+    bench_name = ("ingest_plane" if args.mode == "ingest_bench"
+                  else "async_control_plane")
+    out = {"bench": bench_name, **cells}
+    if args.mode == "ingest_bench":
+        base = cells["async"]["uploads_per_s_sustained"]
+        # the ISSUE's yardstick is the COMMITTED single-process selector
+        # baseline (bench_matrix/async_bench.json, PR 7 — the "~250
+        # uploads/s GIL saturation" the motivation cites); the in-run
+        # async cell is also reported, but it already carries this PR's
+        # selector-core optimizations (wake dedup, lock-free-flush,
+        # optimistic send) and the sharded loadgen fleet, so it is a
+        # moving target, not the committed one
+        committed = None
+        try:
+            with open("bench_matrix/async_bench.json") as f:
+                committed = json.load(f)["async"]["uploads_per_s"]
+        except (OSError, KeyError, ValueError):
+            pass
+        out["summary"] = {
+            "baseline_uploads_per_s": base,
+            "committed_baseline_uploads_per_s": committed,
+            **{f"speedup_w{n}": (round(
+                cells[f"ingest_w{n}"]["uploads_per_s_sustained"] / base,
+                2) if base else None) for n in (1, 2, 4)},
+            **{f"speedup_w{n}_vs_committed": (round(
+                cells[f"ingest_w{n}"]["uploads_per_s_sustained"]
+                / committed, 2) if committed else None)
+               for n in (1, 2, 4)},
+            "audits_green": all(c["upload_audit"]["received_accounted"]
+                                and c["upload_audit"]["accepted_accounted"]
+                                for c in cells.values()),
+            "fleet_procs": fleet_procs,
+            "notes": (
+                "2-core box, sandboxed kernel (~0.5-1 ms per socket "
+                "syscall measured): a raw asyncio echo of the same "
+                "1k-connection ping-pong pattern ceilings at ~1500-1800 "
+                "roundtrips/s with ZERO application logic, and the "
+                "client fleet is the binding constraint above ~2 "
+                "workers (sharded across fleet_procs processes so the "
+                "generator does not measure itself). Worker counts "
+                "above the core count oversubscribe; the knee on this "
+                "box is N=2."),
+        }
+        print(json.dumps({"summary": out["summary"]}), flush=True)
     if "async" in cells and "sync" in cells:
         a, s = cells["async"], cells["sync"]
         out["summary"] = {

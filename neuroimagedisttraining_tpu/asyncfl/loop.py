@@ -94,17 +94,36 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
                  host_map: dict[int, str] | None = None,
                  base_port: int = BASE_PORT,
                  max_pending_frames: int = 64,
-                 send_timeout: float = 30.0):
+                 send_timeout: float = 30.0,
+                 reuse_port: bool = False,
+                 inline_dispatch: bool = False):
         self.rank = rank
         self.world_size = world_size
         self.base_port = base_port
         self.host_map = host_map or {}
         self.max_pending_frames = int(max_pending_frames)
         self.send_timeout = float(send_timeout)
+        #: inline_dispatch runs observers ON the loop thread instead of
+        #: handing each frame to the dispatch thread over the queue.
+        #: Every cross-thread handoff is a futex wakeup — a SYSCALL, ~1
+        #: ms in sandboxed kernels, two per frame round trip — so a
+        #: server whose per-frame work is small and bounded (the ingest
+        #: worker's admission+fold, ~0.3 ms) roughly doubles its
+        #: throughput by staying on the loop thread. Servers with heavy
+        #: per-frame work (the buffered server's jitted aggregation)
+        #: MUST keep the queue: inline observers stall every socket the
+        #: loop owns for as long as they run.
+        self._inline = bool(inline_dispatch)
         self._init_dispatch()
         #: guards _conns/_by_rank/every write queue; doubles as the
         #: backpressure condition senders wait on
         self._send_lock = threading.Condition()
+        #: True while a self-pipe wake byte is in flight (under
+        #: _send_lock): senders skip the wake SYSCALL when one is
+        #: already pending — a socket send costs ~1 ms in sandboxed
+        #: kernels, and per-frame nudges were the measured choke of the
+        #: reply path at 1k-client upload rates
+        self._wake_armed = False
         self._conns: dict[socket.socket, _Conn] = {}
         self._by_rank: dict[int, _Conn] = {}
         self.peak_connections = 0
@@ -133,6 +152,14 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
         self._sel = selectors.DefaultSelector()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuse_port:
+            # sharded ingest plane (asyncfl/ingest.py): N worker
+            # processes bind the SAME port and the kernel hash-balances
+            # incoming connections across their listeners — a client's
+            # persistent connection therefore has a stable worker
+            # affinity for its whole lifetime
+            self._server.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEPORT, 1)
         self._server.bind(("0.0.0.0", base_port + rank))
         self._server.listen(1024)
         self._server.setblocking(False)
@@ -206,6 +233,7 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
         # senders queued frames since the last pass: express write
         # interest for every connection with pending bytes
         with self._send_lock:
+            self._wake_armed = False
             pending = [c for c in self._conns.values()
                        if c.wq and not c.want_write and c.open]
             for c in pending:
@@ -261,35 +289,55 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
                     # peers never set the flag and are reached by
                     # dial-out instead.
                     self._by_rank[msg.sender_id] = conn
+            self._deliver(msg)
+
+    def _deliver(self, msg: Message) -> None:
+        if not self._inline:
             self._enqueue(msg)
+            return
+        # inline mode: observers run here, on the loop thread — no
+        # queue handoff, no futex wakeup. An observer failure is a
+        # dropped frame, never a dead event loop (the dispatch-thread
+        # contract, kept).
+        try:
+            for obs in list(self._observers):
+                obs.receive_message(msg.msg_type, msg)
+        except Exception:  # noqa: BLE001 — see above
+            log.exception("rank %s: inline observer failed on %s",
+                          self.rank, msg.msg_type)
 
     def _flush(self, conn: _Conn) -> None:
-        with self._send_lock:
-            while conn.wq:
-                buf, frame_len = conn.wq[0]
-                try:
-                    n = conn.sock.send(buf)
-                except BlockingIOError:
+        while True:
+            with self._send_lock:
+                if not conn.wq:
+                    conn.want_write = False
+                    self._send_lock.notify_all()  # backpressure release
                     break
-                except OSError as e:
-                    self._close_locked(conn, f"write error: {e}")
-                    self._sel_unregister(conn)
-                    return
+                buf, frame_len = conn.wq[0]
+            # the send SYSCALL runs outside the lock (it costs ~1 ms in
+            # sandboxed kernels, and every sender in the process would
+            # queue-wait behind it); only this loop thread ever pops wq
+            # or closes conns, so the head reference stays valid between
+            # the two holds and senders only ever append on the right
+            try:
+                n = conn.sock.send(buf)  # nidt: allow[lock-send] -- non-blocking; only the loop thread (this one) ever writes a persistent socket or pops wq, so no concurrent writer can interleave mid-frame
+            except BlockingIOError:
+                return
+            except OSError as e:
+                self._close(conn, f"write error: {e}")
+                return
+            with self._send_lock:
                 if n < len(buf):
                     conn.wq[0] = (buf[n:], frame_len)
-                    break
+                    return
                 conn.wq.popleft()
                 conn.wq_frames -= 1
                 self._count_sent(frame_len)
-            drained = not conn.wq
-            if drained:
-                conn.want_write = False
-            self._send_lock.notify_all()  # backpressure release
-        if drained:
-            try:
-                self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
-            except (KeyError, ValueError, OSError):
-                pass
+                self._send_lock.notify_all()  # backpressure release
+        try:
+            self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+        except (KeyError, ValueError, OSError):
+            pass
 
     def _close_locked(self, conn: _Conn, why: str) -> None:
         """Under ``_send_lock``: drop a connection's shared state and
@@ -328,6 +376,15 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
     # ---- send side (any thread) ----
 
     def _wake(self) -> None:
+        """One self-pipe nudge per loop wakeup, not per queued frame:
+        the armed flag dedups wake bytes, and ``_drain_wake`` re-arms
+        BEFORE it collects pending writers — a frame queued after the
+        collection always sees the flag down and sends a fresh byte, so
+        no wakeup is ever lost."""
+        with self._send_lock:
+            if self._wake_armed:
+                return
+            self._wake_armed = True
         try:
             self._wake_w.send(b"\0")  # nidt: allow[lock-send] -- 1-byte self-pipe nudge; the pipe has exactly one writer semantic-free byte stream
         except (BlockingIOError, OSError):
@@ -347,6 +404,18 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
             conn = self._by_rank.get(msg.receiver_id)
             while (conn is not None and conn.open and self._running
                    and conn.wq_frames >= self.max_pending_frames):
+                if threading.get_ident() == self._loop_thread.ident:
+                    # inline observers send from the loop thread — the
+                    # thread that IS the flusher. Blocking here would
+                    # deadlock every socket for send_timeout; a full
+                    # queue to a non-draining reader drops the frame
+                    # loudly instead (the peer re-syncs on its next
+                    # upload).
+                    raise ConnectionError(
+                        f"rank {self.rank}: write queue to rank "
+                        f"{msg.receiver_id} full ({conn.wq_frames} "
+                        "frames) on the loop thread; dropping rather "
+                        "than deadlocking the flusher")
                 if deadline is None:
                     deadline = time.monotonic() + self.send_timeout
                     # counted ONCE per stalled send, on entry — the
@@ -362,9 +431,46 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
                 self._send_lock.wait(min(remaining, 0.5))
                 conn = self._by_rank.get(msg.receiver_id)
             if conn is not None and conn.open and self._running:
-                conn.wq.append((memoryview(frame), len(frame)))
-                conn.wq_frames += 1
-                self._wake()
+                on_loop = (threading.get_ident()
+                           == self._loop_thread.ident)
+                if on_loop and not conn.wq:
+                    # optimistic inline send (the asyncio-transport
+                    # idiom): the ping-pong common case is a writable
+                    # socket and an empty queue — ONE send syscall, no
+                    # wake pipe, no epoll re-arm, no flush pass. Only
+                    # the loop thread may touch the socket directly;
+                    # with wq empty there is no partial frame to
+                    # interleave with.
+                    try:
+                        n = conn.sock.send(frame)  # nidt: allow[lock-send] -- non-blocking socket, loop thread owns it; the blocking path below is the lint's target
+                    except (BlockingIOError, InterruptedError):
+                        n = 0
+                    except OSError as e:
+                        self._close_locked(conn, f"write error: {e}")
+                        self._sel_unregister(conn)
+                        return
+                    if n == len(frame):
+                        self._count_sent(len(frame))
+                        return
+                    conn.wq.append((memoryview(frame)[n:], len(frame)))
+                    conn.wq_frames += 1
+                else:
+                    conn.wq.append((memoryview(frame), len(frame)))
+                    conn.wq_frames += 1
+                if on_loop:
+                    # the loop thread owns the selector: arm write
+                    # interest directly instead of nudging itself
+                    # through the wake pipe
+                    if not conn.want_write:
+                        conn.want_write = True
+                        try:
+                            self._sel.modify(
+                                conn.sock, selectors.EVENT_READ
+                                | selectors.EVENT_WRITE, conn)
+                        except (KeyError, ValueError, OSError):
+                            pass
+                else:
+                    self._wake()
                 return
         self._dial_out(msg, frame, retries, retry_delay, max_delay)
 

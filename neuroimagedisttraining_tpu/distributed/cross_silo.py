@@ -1353,6 +1353,9 @@ class FedAvgClientProc(ClientManager):
         self.wire_masks = (_to_numpy_tree(wire_masks)
                            if wire_masks is not None else None)
         self._wire_ef = None  # per-silo error-feedback accumulator
+        #: last full model body received, reused when a cached-sync
+        #: reply (version unchanged; asyncfl/ingest.py) omits the body
+        self._last_sync_params = None
         #: value-fault schedule (None, or a FaultSchedule whose spec may
         #: schedule THIS rank to upload Byzantine values)
         self.fault_schedule = fault_schedule
@@ -1396,9 +1399,30 @@ class FedAvgClientProc(ClientManager):
                 # a missed beat (server busy/gone) must not kill the loop
                 pass
 
-    def _on_sync(self, msg: M.Message) -> None:
+    def _resolve_sync_params(self, msg: M.Message, round_idx: int):
+        """The cached-sync contract (sharded ingest plane,
+        asyncfl/ingest.py): an upload answered at an UNCHANGED version
+        omits the model body — this silo already holds that exact tree
+        from its previous sync. A body-less sync before any full sync
+        is a protocol error (the ingest worker always ships the full
+        model on register and on every version change); returns None
+        for that dropped-sync case."""
         params = msg.get(M.ARG_MODEL_PARAMS)
+        if params is None:
+            if self._last_sync_params is None:
+                log.error("silo %d: body-less sync at version %d with no "
+                          "cached model - dropping the sync", self.rank,
+                          round_idx)
+                return None
+            return self._last_sync_params
+        self._last_sync_params = params
+        return params
+
+    def _on_sync(self, msg: M.Message) -> None:
         round_idx = int(msg.get(M.ARG_ROUND_IDX))
+        params = self._resolve_sync_params(msg, round_idx)
+        if params is None:
+            return
         if msg.get(M.ARG_EF_RESET):
             log.info("silo %d: server requested ef_reset (round %d) - "
                      "clearing the codec error-feedback accumulator",
@@ -1566,8 +1590,10 @@ class SecureFedAvgClientProc(FedAvgClientProc):
         self.send_message(out)
 
     def _on_sync(self, msg: M.Message) -> None:
-        params = msg.get(M.ARG_MODEL_PARAMS)
         round_idx = int(msg.get(M.ARG_ROUND_IDX))
+        params = self._resolve_sync_params(msg, round_idx)
+        if params is None:  # dropped cached-sync protocol error
+            return
         new_params, n = self.train_fn(params, round_idx)
         self._sync_ref = _to_numpy_tree(params)
         trained = self._client_side_defense(_to_numpy_tree(new_params),

@@ -414,6 +414,16 @@ def main(argv=None) -> int:
                     help="async server: polynomial staleness exponent; "
                          "an upload tau versions stale weighs "
                          "n * (1+tau)^-alpha")
+    ap.add_argument("--ingest_workers", type=int, default=0,
+                    help="async server: shard the ingest plane across N "
+                         "selector worker PROCESSES on one SO_REUSEPORT "
+                         "port (asyncfl/ingest.py) — each worker runs "
+                         "the admission gates and folds accepted "
+                         "uploads into an exact int64 partial "
+                         "aggregate; the root merges partials in "
+                         "worker-id order, bitwise-equal to the "
+                         "single-process fold. 0 = the single-process "
+                         "BufferedFedAvgServer")
     ap.add_argument("--max_staleness", type=int, default=20,
                     help="async server: uploads staler than this many "
                          "versions are dropped at admission (with a "
@@ -719,6 +729,20 @@ def main(argv=None) -> int:
                     f"{args.secure_quant_field_bits}-bit field lacks "
                     f"for a {k_cap}-upload buffer — pass "
                     "--secure_quant_field_bits 32")
+    if args.ingest_workers:
+        if args.ingest_workers < 0:
+            ap.error("--ingest_workers must be >= 0")
+        if not args.async_server:
+            ap.error("--ingest_workers shards the ASYNC ingest plane "
+                     "(asyncfl/ingest.py) — add --async_server")
+        if args.defense != "none" or args.quarantine_rounds:
+            ap.error("--ingest_workers supports neither server-side "
+                     "defenses nor quarantine: workers fold uploads "
+                     "into partial aggregates, so the root never sees "
+                     "per-client updates to select over or score "
+                     "(matrix precedent: the buffered secure path). "
+                     "Use the single-process plane (--ingest_workers 0) "
+                     "or client-side clipping")
     if args.round_deadline > 0 and args.quorum == 0:
         args.quorum = args.num_clients // 2 + 1  # simple majority
     if args.heartbeat_timeout > 0 and not (
@@ -819,18 +843,38 @@ def main(argv=None) -> int:
                       "norm_bound": args.norm_bound,
                       "stddev": args.stddev, "defense_seed": args.seed,
                       "dp_delta": args.dp_delta}
-            server = BufferedFedAvgServer(
-                init, args.comm_round, args.num_clients,
-                buffer_k=args.buffer_k,
-                staleness_alpha=args.staleness_alpha,
-                max_staleness=args.max_staleness,
-                base_port=args.base_port, host_map=host_map,
-                heartbeat_timeout=args.heartbeat_timeout, **kw)
-            print(f"[server] asyncfl selector control plane on port "
-                  f"{args.base_port}; buffer_k="
-                  f"{server.buffer_k}, staleness_alpha="
-                  f"{args.staleness_alpha}, max_staleness="
-                  f"{args.max_staleness}", flush=True)
+            if args.ingest_workers:
+                from neuroimagedisttraining_tpu.asyncfl.ingest import (
+                    ShardedIngestServer,
+                )
+
+                server = ShardedIngestServer(
+                    init, args.comm_round, args.num_clients,
+                    ingest_workers=args.ingest_workers,
+                    buffer_k=args.buffer_k,
+                    staleness_alpha=args.staleness_alpha,
+                    max_staleness=args.max_staleness,
+                    base_port=args.base_port, host_map=host_map,
+                    heartbeat_timeout=args.heartbeat_timeout, **kw)
+                print(f"[server] sharded ingest plane on port "
+                      f"{args.base_port}: {args.ingest_workers} "
+                      f"selector workers (SO_REUSEPORT), buffer_k="
+                      f"{server.buffer_k}, staleness_alpha="
+                      f"{args.staleness_alpha}, max_staleness="
+                      f"{args.max_staleness}", flush=True)
+            else:
+                server = BufferedFedAvgServer(
+                    init, args.comm_round, args.num_clients,
+                    buffer_k=args.buffer_k,
+                    staleness_alpha=args.staleness_alpha,
+                    max_staleness=args.max_staleness,
+                    base_port=args.base_port, host_map=host_map,
+                    heartbeat_timeout=args.heartbeat_timeout, **kw)
+                print(f"[server] asyncfl selector control plane on "
+                      f"port {args.base_port}; buffer_k="
+                      f"{server.buffer_k}, staleness_alpha="
+                      f"{args.staleness_alpha}, max_staleness="
+                      f"{args.max_staleness}", flush=True)
             broker = None
         else:
             comm, broker = _make_comm(args, 0, host_map)
@@ -863,7 +907,9 @@ def main(argv=None) -> int:
                      "registered": len(server._registered),
                      "suspects": len(server._suspect)}
                 if args.async_server:
-                    h["buffered"] = len(server._buffer)
+                    h["buffered"] = (server._pending()
+                                     if args.ingest_workers
+                                     else len(server._buffer))
             finally:
                 server._rlock.release()
             return h
@@ -907,6 +953,11 @@ def main(argv=None) -> int:
                      "staleness_taus": sorted({
                          t for h in server.history
                          for t in h.get("taus", ())})}
+            if args.ingest_workers:
+                extra["ingest_workers"] = args.ingest_workers
+                # workers own the client sockets: the wire accounting
+                # lives with them, not the root's placeholder comm
+                stats = server.worker_byte_stats()
         dp = server.dp_report()
         if dp is not None:
             # run-end privacy audit: per-silo (epsilon, delta) from the

@@ -73,6 +73,14 @@ class FederatedEngine:
     #: others must reject the flags loudly instead of silently training
     #: without the noise the accountant would be charging for
     supports_dp = False
+    #: engines whose declared round routes the builder's DEFAULT
+    #: sanitize/defend/aggregate tail — exactly the engines where
+    #: ``--secure_quant`` can swap that tail for the in-process secure
+    #: QUANTIZED aggregation stage (ROADMAP 1(b),
+    #: program.secure_quant_aggregate); engines with a custom aggregate
+    #: stage (or none) have no server fold for the field algebra to
+    #: protect and must reject the flag loudly
+    supports_secure_quant = False
     #: engines whose STREAMING driver can run fused K-round windows
     #: (ISSUE 10): the window's shards are prefetched as one [K, S, ...]
     #: stack (data/stream.py prefetch_window) and the scanned round body
@@ -198,6 +206,77 @@ class FederatedEngine:
                 "the device-resident path only; streaming rounds "
                 "(--streaming) keep the dense in-mesh aggregation — the "
                 "real encoded transport lives in distributed/run.py")
+        # in-process secure QUANTIZED aggregation (privacy/, ROADMAP
+        # 1(b)): --secure_quant swaps the builder's sanitize/defend/
+        # aggregate tail for the jitted GF(p) integer-weight fold
+        # (program.secure_quant_aggregate) — bitwise the host
+        # SlotAccumulator fold at the same (p, frac_bits, weights).
+        # Every incompatibility fails HERE (startup), never mid-round.
+        self.sq_spec = None
+        self.sq_weight_shift = 0
+        if cfg.fed.secure_quant:
+            from neuroimagedisttraining_tpu.privacy import (
+                QuantSpec, check_headroom,
+            )
+            from neuroimagedisttraining_tpu.privacy.secure_quant import (
+                WEIGHT_FRAC_BITS, weighted_fold_capacity,
+            )
+
+            if not self.supports_secure_quant:
+                from neuroimagedisttraining_tpu.engines import ENGINES
+                ok = sorted({c.name for c in ENGINES.values()
+                             if c.supports_secure_quant})
+                raise ValueError(
+                    f"algorithm {self.name!r} does not simulate "
+                    "--secure_quant: its round has no default "
+                    "server-side aggregation tail for the field fold to "
+                    f"replace; supported: {ok}. The encoded secure wire "
+                    "itself lives on the cross-silo/async planes "
+                    "(distributed/run.py)")
+            if self.wire_spec is not None:
+                raise ValueError(
+                    "--secure_quant does not compose with --wire_codec: "
+                    "the codec's float stages would corrupt the GF(p) "
+                    "residue embedding (field-element frames, not model "
+                    "floats) — ARCHITECTURE.md 'Privacy plane'")
+            if cfg.fed.defense_type in robust.ROBUST_AGGREGATORS:
+                raise ValueError(
+                    f"--defense {cfg.fed.defense_type} does not compose "
+                    "with --secure_quant (no per-client plaintext to "
+                    "select over); the clip family (norm_diff_clipping, "
+                    "weak_dp) composes CLIENT-side pre-quantize — "
+                    "ARCHITECTURE.md 'Privacy plane'")
+            spec = QuantSpec.from_bits(cfg.fed.secure_quant_field_bits,
+                                       cfg.fed.secure_quant_frac_bits)
+            check_headroom(spec, cfg.fed.client_num_per_round)
+            # the one-phase integer-weight fold (the async server's and
+            # the sharded ingest plane's algebra): pick the largest
+            # STATIC weight shift whose worst-case mass keeps the
+            # aggregate inside the field's centered range — per-round
+            # weights then fold exactly for the whole run
+            cap = weighted_fold_capacity(spec)
+            cohort = max(1, int(cfg.fed.client_num_per_round))
+            shift = None
+            for s in range(WEIGHT_FRAC_BITS, -1, -1):
+                if cohort * (1 << s) < cap:
+                    shift = s
+                    break
+            if shift is None:
+                raise ValueError(
+                    f"--secure_quant field too small for the in-process "
+                    f"integer-weight fold: a {cohort}-client cohort "
+                    f"exceeds the {cfg.fed.secure_quant_field_bits}-bit "
+                    f"field's capacity of {cap:.1f} weight units — pass "
+                    "--secure_quant_field_bits 32 (the same requirement "
+                    "as the buffered one-phase path; ARCHITECTURE.md "
+                    "'Privacy plane')")
+            self.sq_spec = spec
+            self.sq_weight_shift = int(shift)
+            # materialize the static per-leaf scales NOW, outside any
+            # trace: a lazy first touch would run the jitted model init
+            # inside the round trace (tracer leaves -> leaf_scales'
+            # host max() raises TracerArrayConversionError)
+            _ = self.sq_scales
         self.stat_info: dict[str, Any] = {
             "sum_comm_params": 0.0, "sum_training_flops": 0.0,
             "sum_comm_bytes": 0.0, "sum_comm_bytes_dense": 0.0,
@@ -502,6 +581,23 @@ class FederatedEngine:
         return tuple(nums) if self._donate else ()
 
     # ---------- the declared round program (ISSUE 11) ----------
+
+    @functools.cached_property
+    def sq_scales(self) -> dict:
+        """Static per-leaf power-of-two scales for the in-process
+        secure-quant stage, derived ONCE from the seed-deterministic
+        init model (privacy.leaf_scales — BatchNorm raw-moment leaves
+        would otherwise saturate the small field). Static for the run —
+        the fused scan's carry changes per round, so per-round reference
+        scales would force a host boundary; the fixed-scale contract is
+        the async one-phase protocol's (frames fold unscaled against a
+        startup bound there; scaled against the init here)."""
+        from neuroimagedisttraining_tpu.privacy import leaf_scales
+
+        gs = self.init_global_state()
+        ref = {"params": jax.tree.map(np.asarray, gs.params),
+               "batch_stats": jax.tree.map(np.asarray, gs.batch_stats)}
+        return leaf_scales(ref)
 
     @functools.cached_property
     def program(self) -> "round_program.RoundProgram":

@@ -40,6 +40,8 @@ class DittoEngine(FederatedEngine):
     # clients' shards, so the streamed round has FedAvg's shape — data per
     # round on device, persistent personal state resident.
     supports_streaming = True
+    supports_secure_quant = True  # default aggregate tail on the
+    # global track — the secure fold protects exactly that upload
     supports_byz_faults = True  # the builder's attack stage hits the
     # global-track upload; the personal track stays honest
     supports_cohort_sharding = True  # both tracks run as unbatched

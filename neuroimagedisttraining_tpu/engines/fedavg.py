@@ -34,6 +34,9 @@ class FedAvgEngine(FederatedEngine):
     supports_streaming = True
     supports_wire_codec = True  # the declared round runs the codec
     # roundtrip (builder codec stage, engines/program.py)
+    supports_secure_quant = True  # the declared round routes the
+    # builder's default aggregate tail, which --secure_quant swaps for
+    # the jitted GF(p) fold (program.secure_quant_aggregate)
     supports_byz_faults = True  # uploads route through the builder's
     # attack stage when the schedule carries byz: value faults
     supports_cohort_sharding = True  # the declared local-train stage

@@ -3,7 +3,15 @@ communication (fedml_api/standalone/local/local_api.py:51-80).
 
 The whole federation's persistent states live as one stacked pytree; every
 round is one vmapped/sharded jitted program over ALL clients. The optimizer
-is re-created each round (reference builds a fresh torch SGD per call)."""
+is re-created each round (reference builds a fresh torch SGD per call).
+
+DECLARED through the round-program builder (engines/program.py, ROADMAP
+item 1(a)): the carry is the per-client state stacks, the train stage is
+the vmapped/sharded local pass, and a custom aggregate stage simply
+promotes the trained stacks to next round's carry (there is no server
+aggregation in a local-only run). The declaration is what buys the
+engine fused ``--rounds_per_dispatch K`` windows and ``--client_mesh``
+cohort sharding — K=4 fused == 4x K=1 BITWISE (tests/test_program.py)."""
 
 from __future__ import annotations
 
@@ -14,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from neuroimagedisttraining_tpu.core.trainer import ClientState
+from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
 
 
@@ -24,43 +33,107 @@ class LocalEngine(FederatedEngine):
     # concatenates the resident per-client state back (same chunked shape
     # as DisPFL's streamed round, minus any consensus).
     supports_streaming = True
+    supports_cohort_sharding = True  # the train stage (every client,
+    # every round) shards over the --client_mesh like dpsgd's; there is
+    # no aggregation tail to replicate
 
-    def _local_block(self, per_params, per_bstats, rngs, X, y, n, lr):
-        """Vmapped local training over a block of clients."""
+    # ---------- the declared round (engines/program.py) ----------
+
+    def round_stages(self):
+        return round_program.RoundStages(
+            carry=("per_params", "per_bstats"),
+            train=self._train_stage,
+            aggregate=self._aggregate_stage,
+            outputs=("loss",),
+            gathers_cohort=False,
+            window_extras=self._window_extras,
+        )
+
+    def _train_stage(self, ctx) -> round_program.TrainOut:
+        """Every client trains its own persistent model — vmapped, or
+        sharded over the client mesh (perms hoisted out of the
+        partition, parallel/cohort.py)."""
         trainer = self.trainer
         o = self.cfg.optim
         max_samples = self._max_samples()
+        lr = ctx.lr
 
-        def local(p, b, rng, Xc, yc, nc):
+        def local(p, b, rng, Xc, yc, nc, perms_c=None):
             cs = ClientState(params=p, batch_stats=b,
                              opt_state=trainer.opt.init(p), rng=rng)
             cs, loss = trainer.local_train(
                 cs, Xc, yc, nc, lr, epochs=o.epochs,
-                batch_size=o.batch_size, max_samples=max_samples)
+                batch_size=o.batch_size, max_samples=max_samples,
+                perms=perms_c)
             return cs.params, cs.batch_stats, loss
 
-        return jax.vmap(local)(per_params, per_bstats, rngs, X, y, n)
+        new_p, new_b, losses = ctx.client_map(
+            local, ctx.carry["per_params"], ctx.carry["per_bstats"],
+            ctx.rngs, ctx.Xs, ctx.ys, ctx.ns,
+            hoisted=(lambda: ctx.local_perms(ctx.rngs, ctx.ns,
+                                             o.epochs),))
+        return round_program.TrainOut(
+            losses=losses, extra={"new_p": new_p, "new_b": new_b})
+
+    def _aggregate_stage(self, ctx, upload, w, tr):
+        """No server aggregation: the trained stacks ARE next round's
+        carry; the round's scalar is the sample-weighted mean loss
+        (bitwise the legacy ``_round_jit``'s)."""
+        mean_loss = jnp.sum(tr.losses * w) / jnp.maximum(jnp.sum(w),
+                                                         1e-9)
+        return ({"per_params": tr.extra["new_p"],
+                 "per_bstats": tr.extra["new_b"]},
+                {"loss": mean_loss})
+
+    def _window_extras(self, round_idx: int, k: int
+                       ) -> round_program.WindowInputs:
+        """Window prologue: no sampling (every client trains every
+        round), just the stacked per-round rngs/lrs."""
+        C = self.num_clients
+        for off in range(k):
+            self.log.info("################ round %d: local-only cohort "
+                          "(fused window of %d)", round_idx + off, k)
+        rngs = jnp.stack([self.per_client_rngs(round_idx + off,
+                                               np.arange(C))
+                          for off in range(k)])
+        lrs = jnp.asarray([self.round_lr(round_idx + off)
+                           for off in range(k)], jnp.float32)
+        return round_program.WindowInputs(
+            sampled=None, idx=None, rngs=rngs, lrs=lrs, byz=None, k=k,
+            n_real=None)
+
+    # ---------- legacy-signature program adapters ----------
 
     @functools.cached_property
     def _round_jit(self):
-        def round_fn(per_params, per_bstats, data, rngs, lr):
-            new_p, new_b, losses = self._local_block(
-                per_params, per_bstats, rngs, data.X_train, data.y_train,
-                data.n_train, lr)
-            w = data.n_train.astype(jnp.float32)
-            mean_loss = jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
-            return new_p, new_b, mean_loss
+        prog = self.program.round_jit(sharded=self._cohort_on)
 
-        # donation: the persistent per-client stacks are consumed; the
-        # driver rebinds them on return
-        return jax.jit(round_fn, donate_argnums=self._donate_argnums(0, 1))
+        def round_call(per_params, per_bstats, data, rngs, lr):
+            return prog((per_params, per_bstats), data, (), None, rngs,
+                        lr)
+
+        return round_call
 
     @functools.cached_property
     def _block_jit(self):
         # the streamed chunk program consumes gathered per-chunk copies
         # (stream_map_train_chunks builds them fresh each chunk)
-        return jax.jit(self._local_block,
-                       donate_argnums=self._donate_argnums(0, 1))
+        trainer = self.trainer
+        o = self.cfg.optim
+        max_samples = self._max_samples()
+
+        def block(per_params, per_bstats, rngs, X, y, n, lr):
+            def local(p, b, rng, Xc, yc, nc):
+                cs = ClientState(params=p, batch_stats=b,
+                                 opt_state=trainer.opt.init(p), rng=rng)
+                cs, loss = trainer.local_train(
+                    cs, Xc, yc, nc, lr, epochs=o.epochs,
+                    batch_size=o.batch_size, max_samples=max_samples)
+                return cs.params, cs.batch_stats, loss
+
+            return jax.vmap(local)(per_params, per_bstats, rngs, X, y, n)
+
+        return jax.jit(block, donate_argnums=self._donate_argnums(0, 1))
 
     def _round_streaming(self, per_params, per_bstats, rngs, lr):
         (new_p, new_b), losses = self.stream_map_train_chunks(
@@ -82,13 +155,30 @@ class LocalEngine(FederatedEngine):
             per_params, per_bstats = (restored["per_params"],
                                       restored["per_bstats"])
             history = restored["history"]
-        for round_idx in range(start, cfg.fed.comm_round):
-            rngs = self.per_client_rngs(round_idx,
-                                        np.arange(self.num_clients))
-            if self.stream is not None:
+        # fused K-round windows (builder-owned, ROADMAP 1(a)): the
+        # window planner pins eval/checkpoint rounds to boundaries, so
+        # the fused driver's observable behavior matches the per-round
+        # loop
+        fuse = (cfg.fed.rounds_per_dispatch > 1
+                and self.fused_fallback_reason() is None)
+        round_idx = start
+        while round_idx < cfg.fed.comm_round:
+            k = self._dispatch_window(round_idx) if fuse else 1
+            if k > 1:
+                ((per_params, per_bstats), _, outs,
+                 wi) = self.program.run_window(
+                    (per_params, per_bstats), round_idx, k)
+                loss, k = outs["loss"][-1], wi.k
+                round_idx += k - 1
+            elif self.stream is not None:
+                rngs = self.per_client_rngs(round_idx,
+                                            np.arange(self.num_clients))
                 per_params, per_bstats, loss = self._round_streaming(
-                    per_params, per_bstats, rngs, self.round_lr(round_idx))
+                    per_params, per_bstats, rngs,
+                    self.round_lr(round_idx))
             else:
+                rngs = self.per_client_rngs(round_idx,
+                                            np.arange(self.num_clients))
                 per_params, per_bstats, loss = self._round_jit(
                     per_params, per_bstats, self.data, rngs,
                     self.round_lr(round_idx))
@@ -102,6 +192,7 @@ class LocalEngine(FederatedEngine):
             self.maybe_checkpoint(round_idx, {
                 "per_params": per_params, "per_bstats": per_bstats,
                 "history": history})
+            round_idx += 1
         m = self._eval_p(per_params, per_bstats)
         self.log.metrics(-1, personal=m)
         return {"personal_params": per_params,

@@ -414,6 +414,97 @@ def sanitize_defend_aggregate(eng, upload, ref, w, losses, rngs=None):
     return new_params, new_bstats, mean_loss, n_bad
 
 
+def sq_integer_weights(w, shift: int):
+    """The per-round integer fold weights of the in-process secure-quant
+    stage: ``max(rint(w / max(w) * 2^shift), 1)``. Every operation is a
+    single correctly-rounded f32 op (or exact: max, rint, the power-of-
+    two multiply), so the identical numpy formula over the same f32
+    weights reproduces these integers EXACTLY — the bridge the bitwise
+    host-fold pin crosses (tests/test_program.py). Ratios are preserved
+    to ~2^-shift relative; an admitted client never folds at zero."""
+    wn = w.astype(jnp.float32) / jnp.max(w.astype(jnp.float32))
+    return jnp.maximum(jnp.rint(wn * jnp.float32(1 << shift)),
+                       jnp.float32(1.0)).astype(jnp.uint32)
+
+
+def secure_quant_aggregate(eng, upload, ref, w, losses, rngs=None):
+    """The in-process secure QUANTIZED aggregation stage (ROADMAP item
+    1(b)): ``--secure_quant`` swaps the builder's sanitize/defend/
+    aggregate tail for the jitted one-phase GF(p) fold — the CODEC-
+    family emulation of privacy/secure_quant.py inside the round body,
+    so simulated runs train on exactly the numbers the encoded secure
+    wire would deliver.
+
+    Per leaf: scale (static ``sq_scales`` from the init model), quantize
+    into the field (ops/mpc_device.quantize_device — bitwise
+    ``mpc.quantize32``), multiply by the integer fold weight INSIDE the
+    field (shift-add mulmod: products of residues never materialize, so
+    uint32 suffices for p < 2^31 with x64 disabled), residue-sum over
+    clients, dequantize, undo the scale, divide by the integer mass.
+    Every step is exact field/integer arithmetic or one correctly-
+    rounded f32 op, so the aggregate is BITWISE what the host fold — a
+    ``SlotAccumulator`` over ``encode_secure_quant`` frames at the same
+    ``(p, frac_bits, scales, weights)`` — produces (pinned in
+    tests/test_program.py; masks cancel exactly mod p, which is why the
+    mask-free device fold can BE the parity reference).
+
+    The privacy-plane matrix applies: clip-family defenses run
+    CLIENT-side pre-quantize (``SecureFedAvgClientProc`` precedent);
+    order statistics were rejected at startup; there is no server-side
+    non-finite gate — a NaN quantizes to the neutral zero residue (its
+    weight still enters the mass, exactly like the real protocol, and
+    ``n_bad`` reports the count without changing the fold)."""
+    from neuroimagedisttraining_tpu.codec.wire import (
+        _named_leaves, _rebuild_like,
+    )
+    from neuroimagedisttraining_tpu.ops import mpc_device
+
+    f = eng.cfg.fed
+    spec, scales = eng.sq_spec, eng.sq_scales
+    shift = int(eng.sq_weight_shift)
+    p, fb = int(spec.p), int(spec.frac_bits)
+    pp = jnp.uint32(p)
+    if f.defense_type != "none":
+        # client-side clip family (the ctor admitted nothing else)
+        upload = dict(upload, params=robust.defend_stacked(
+            upload["params"], ref["params"], defense=f.defense_type,
+            norm_bound=f.norm_bound, stddev=f.stddev, rngs=rngs))
+    finite = robust.finite_per_client(upload)
+    n_bad = jnp.sum(~finite).astype(jnp.int32)
+    wi = sq_integer_weights(w, shift)
+    # integer mass < cohort * 2^shift < the startup capacity bound,
+    # well inside f32's 2^24 exact-integer range
+    denom = jnp.sum(wi).astype(jnp.float32)
+    C = int(jax.tree.leaves(upload)[0].shape[0])
+    out = {}
+    for name, x in _named_leaves(upload):
+        s_leaf = jnp.float32(scales.get(name, 1.0))
+        q = mpc_device.quantize_device(
+            x.astype(jnp.float32) / s_leaf, p=p, frac_bits=fb)
+        # (wi_c * q_c) mod p by shift-add doubling: wi < 2^(shift+1), so
+        # shift+1 conditional field-adds — addmod keeps everything < p,
+        # no uint32 wrap for any admissible field
+        wib = wi.reshape((-1,) + (1,) * (q.ndim - 1))
+        acc = jnp.zeros_like(q)
+        cur = q
+        for b in range(shift + 1):
+            bit = ((wib >> b) & jnp.uint32(1)) > 0
+            acc = jnp.where(bit, mpc_device._addmod(acc, cur, pp), acc)
+            cur = mpc_device._addmod(cur, cur, pp)
+        # ascending client order, like secure_sum_device — mod-p adds
+        # are exact, so the order is convention, not a numerics choice
+        total = jax.lax.fori_loop(
+            1, C, lambda c, t: mpc_device._addmod(t, acc[c], pp),
+            acc[0])
+        deq = mpc_device.dequantize_device(total, p=p,
+                                           frac_bits=fb) * s_leaf
+        out[name] = (deq / denom).astype(x.dtype)
+    agg = _rebuild_like(ref, out)
+    safe_losses = jnp.where(jnp.isfinite(losses), losses, 0.0)
+    mean_loss = jnp.sum(safe_losses * w) / jnp.maximum(jnp.sum(w), 1e-9)
+    return agg["params"], agg["batch_stats"], mean_loss, n_bad
+
+
 def _codec_stage(eng, stages: RoundStages, ctx: RoundCtx, upload, efs):
     """The wire codec's lossy roundtrip over the whole upload payload
     (codec/device.py) — delta vs the round's broadcast reference,
@@ -704,9 +795,16 @@ class RoundProgram:
             upload, new_efs, u0 = _codec_stage(eng, st, ctx, upload, efs)
         if st.aggregate is None:
             rng_leaf = tr.state.rng if tr.state is not None else None
-            new_params, new_bstats, mean_loss, n_bad = \
-                sanitize_defend_aggregate(eng, upload, ctx.upload_ref, w,
-                                          tr.losses, rngs=rng_leaf)
+            if getattr(eng, "sq_spec", None) is not None:
+                # --secure_quant: the field fold REPLACES the default
+                # tail (the in-process codec-family stage, ROADMAP 1(b))
+                new_params, new_bstats, mean_loss, n_bad = \
+                    secure_quant_aggregate(eng, upload, ctx.upload_ref,
+                                           w, tr.losses, rngs=rng_leaf)
+            else:
+                new_params, new_bstats, mean_loss, n_bad = \
+                    sanitize_defend_aggregate(eng, upload, ctx.upload_ref,
+                                              w, tr.losses, rngs=rng_leaf)
             new_carry = {"params": new_params, "batch_stats": new_bstats}
             outs = {"loss": mean_loss, "n_bad": n_bad}
         else:

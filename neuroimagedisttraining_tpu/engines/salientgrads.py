@@ -49,6 +49,8 @@ class SalientGradsEngine(FederatedEngine):
     # round granularity, same as FedAvg's streaming path.
     supports_streaming = True
     supports_wire_codec = True  # masked roundtrip inside _round_body
+    supports_secure_quant = True  # masked uploads still aggregate
+    # through the builder's default tail — the field fold replaces it
     supports_byz_faults = True  # uploads route through faults/adversary
     supports_cohort_sharding = True  # phase-1 scores and the phase-2
     # round's local-train stage shard over the --client_mesh (ISSUE 6)

@@ -33,5 +33,7 @@ from neuroimagedisttraining_tpu.privacy.secure_quant import (  # noqa: F401
     encode_secure_quant,
     integer_weights,
     is_secure_quant_frame,
+    leaf_scales,
     quantized_weighted_mean,
+    weighted_fold_capacity,
 )

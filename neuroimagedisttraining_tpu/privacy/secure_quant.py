@@ -337,6 +337,66 @@ class SlotAccumulator:
                 [a.reshape(-1) for a in s.values()]).copy()
                 for s in self._slots)
 
+    def merge(self, other: "SlotAccumulator") -> None:
+        """Fold another accumulator INTO this one slot-wise:
+        ``slot_j := (slot_j + other.slot_j) mod p``. The GF(p) residue
+        algebra is commutative and associative, so merging per-worker
+        accumulators in ANY order equals folding every frame into one
+        accumulator — the cross-process invariant the sharded ingest
+        plane (asyncfl/ingest.py) is built on. Both accumulators must
+        share the spec and leaf structure; ``other`` is left untouched."""
+        if other.spec != self.spec:
+            raise ValueError(
+                f"cannot merge SlotAccumulators with different specs: "
+                f"{other.spec} vs {self.spec}")
+        if other._slots is None:
+            return
+        if self._slots is None:
+            if self._sizes is not None and other._sizes != self._sizes:
+                raise ValueError(
+                    "secure-quant accumulator merge: leaf structure "
+                    f"mismatch ({other._sizes[:3]}... vs "
+                    f"{self._sizes[:3]}...)")
+            self._sizes = other._sizes
+            self._slots = [{name: v.copy() for name, v in s.items()}
+                           for s in other._slots]
+        else:
+            if other._sizes != self._sizes:
+                raise ValueError(
+                    "secure-quant accumulator merge: leaf structure "
+                    f"mismatch ({other._sizes[:3]}... vs "
+                    f"{self._sizes[:3]}...)")
+            for acc, s in zip(self._slots, other._slots):
+                for name, v in s.items():
+                    acc[name] = (acc[name] + v) % self.spec.p
+        self.folded += other.folded
+        if self.trace is not None:
+            self.trace.extend(np.concatenate(
+                [a.reshape(-1) for a in s.values()]).copy()
+                for s in self._slots)
+
+    def export_centered(self) -> dict[str, np.ndarray] | None:
+        """Combine the slots and CENTER-LIFT the total into plain int64:
+        ``t - p`` for residues above ``p//2``. When the accumulated
+        weighted aggregate is inside the field's centered range (the
+        caller's headroom contract — asyncfl/ingest.py flushes partials
+        before the folded weight mass can leave it), the lifted value IS
+        the true integer ``sum_c w_c * q~_c`` over this accumulator's
+        frames, so lifted partials from different processes combine
+        EXACTLY in ordinary int64 addition — no shared modulus needed
+        across partials, which is what makes the cross-worker merge
+        bitwise partition-independent. Returns None when nothing folded;
+        does not reset the accumulator."""
+        if self._slots is None:
+            return None
+        total = self._slots[0]
+        for s in self._slots[1:]:
+            total = {name: (total[name] + s[name]) % self.spec.p
+                     for name in total}
+        half = self.spec.p // 2
+        return {name: np.where(t > half, t - self.spec.p, t)
+                for name, t in total.items()}
+
     def finalize(self, like: PyTree, rescale: float = 1.0,
                  scales: dict[str, float] | None = None) -> PyTree:
         """Combine slots, dequantize (float32 centered lift — bitwise

@@ -252,6 +252,94 @@ print(f"OK(secure_quant/crash): {res['rounds_completed']} rounds over "
 EOF
 }
 
+run_ingest() {
+    # sharded ingest plane (ISSUE 12, asyncfl/ingest.py), two cells:
+    # (1) a REAL cross-silo federation served by 2 SO_REUSEPORT worker
+    #     processes + the merging root — every aggregation lands, both
+    #     accounting audits green across processes;
+    # (2) the loadgen kill-one-worker chaos cell — worker 0 SIGKILLed
+    #     mid-run, clients reconnect onto the surviving listener, the
+    #     audit reconciles with the dead worker's buffered uploads
+    #     counted lost_with_worker, never silently vanished.
+    local port
+    port=$($PY -c "from neuroimagedisttraining_tpu.distributed.ports \
+import free_port_block; print(free_port_block(16))")
+    local common=(--num_clients "$CLIENTS" --comm_round "$ROUNDS"
+                  --model 3dcnn_tiny --dataset synthetic
+                  --synthetic_num_subjects 24
+                  --synthetic_shape 12 14 12 --batch_size 4
+                  --base_port "$port" --force_cpu
+                  --async_server --buffer_k 3 --max_staleness 8
+                  --ingest_workers 2)
+    echo "== chaos smoke (sharded ingest cell, port $port): real" \
+         "federation on 2 SO_REUSEPORT workers + merging root =="
+    local out="/tmp/chaos_smoke_ingest.log"
+    $PY -m neuroimagedisttraining_tpu.distributed.run \
+        --role server "${common[@]}" > "$out" 2>&1 &
+    local server_pid=$!
+    local pids=()
+    for r in $(seq 1 "$CLIENTS"); do
+        $PY -m neuroimagedisttraining_tpu.distributed.run \
+            --role client --rank "$r" "${common[@]}" \
+            > "/tmp/chaos_smoke_ingest_c${r}.log" 2>&1 &
+        pids+=($!)
+    done
+    if ! wait "$server_pid"; then
+        echo "FAIL(ingest): server exited non-zero"
+        cat "$out"; return 1
+    fi
+    for p in "${pids[@]}"; do wait "$p" 2>/dev/null || true; done
+    local json
+    json=$(grep -a -o '^{.*}' "$out" | tail -1)
+    echo "$json"
+    $PY - "$json" <<EOF
+import json, math, sys
+res = json.loads(sys.argv[1])
+assert res.get("ingest_workers") == 2, res
+assert res["rounds_completed"] == $ROUNDS, res
+audit = res["upload_audit"]
+assert audit["received_accounted"], audit
+assert audit["accepted_accounted"], audit
+assert audit["lost_with_worker"] == 0, audit
+assert math.isfinite(res["final_param_norm"]), res
+assert res["frames_recv"] > 0, res
+print(f"OK(ingest/federation): {res['rounds_completed']} aggregations "
+      f"over 2 workers, audits green, |params|="
+      f"{res['final_param_norm']:.3f}")
+EOF
+    local irc=$?
+    [ $irc -ne 0 ] && return $irc
+    echo "== chaos smoke (sharded ingest kill-one-worker cell):" \
+         "SIGKILL worker 0 at version 2, audits must stay green =="
+    # a real file, not a '$PY -' heredoc: the ingest root spawns worker
+    # processes with the 'spawn' context, which re-imports the parent's
+    # main module — '<stdin>' has no path to re-import
+    local killpy="/tmp/chaos_smoke_ingest_kill.py"
+    cat > "$killpy" <<'EOF'
+from neuroimagedisttraining_tpu.asyncfl.loadgen import run_load
+
+# the __main__ guard matters: the spawn context re-imports this file in
+# every worker child
+if __name__ == "__main__":
+    res = run_load(mode="ingest", num_clients=60, aggregations=8,
+                   buffer_k=20, ingest_workers=3, ingest_kill_at=2,
+                   leaf_elems=64)
+    audit = res["upload_audit"]
+    assert audit["received_accounted"], audit
+    assert audit["accepted_accounted"], audit
+    assert res["frames_reconciled"], res
+    assert res["rounds_or_aggregations"] == 8, res
+    assert not audit["workers"][0]["alive"], audit
+    print(f"OK(ingest/kill-worker): 8 aggregations, worker 0 killed, "
+          f"{res['lost_with_worker']} buffered uploads accounted "
+          f"lost_with_worker, {res['client_stats']['rejoins']} client "
+          "rejoins, audits green")
+EOF
+    # PYTHONPATH: running a file from /tmp drops the repo cwd from
+    # sys.path ('python -' used to add it); worker children inherit it
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" $PY "$killpy"
+}
+
 rc=0
 run_one socket crash || rc=1
 run_one broker crash || rc=1
@@ -259,4 +347,5 @@ run_one socket byz   || rc=1
 run_one broker byz   || rc=1
 run_async            || rc=1
 run_secure_quant     || rc=1
+run_ingest           || rc=1
 exit $rc

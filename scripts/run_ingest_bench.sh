@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Sharded ingest plane headline bench (ISSUE 12, asyncfl/ingest.py):
+# the committed single-process selector baseline (BufferedFedAvgServer,
+# the async_bench cell's server) vs the sharded plane at N in {1, 2, 4}
+# SO_REUSEPORT worker processes, SAME cohort / buffer / canned-update
+# configuration. Metric: sustained accepted uploads/s over the accept
+# window (fleet start -> last aggregation; the teardown tail measures
+# shutdown, not ingest). Acceptance: >= 3x at N=4 with every
+# received==accepted+dropped / accepted==aggregated+buffered audit
+# green across processes.
+#
+# Writes bench_matrix/ingest_bench.json (committed artifact).
+#
+# BENCH_AGGREGATIONS defaults high (300) on purpose: the metric is
+# SUSTAINED throughput, and the accept window opens at fleet start — a
+# short cell is dominated by the 1k-client connection ramp (~2 s at the
+# ~500 connects/s stagger), not by steady-state ingest.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PY=${PYTHON:-python}
+OUT=${1:-bench_matrix/ingest_bench.json}
+
+$PY -m neuroimagedisttraining_tpu.asyncfl.loadgen \
+    --mode ingest_bench \
+    --clients "${BENCH_CLIENTS:-1000}" \
+    --aggregations "${BENCH_AGGREGATIONS:-300}" \
+    --buffer_k "${BENCH_BUFFER_K:-50}" \
+    --leaf_elems "${BENCH_LEAF_ELEMS:-256}" \
+    --out "$OUT"
+
+$PY - "$OUT" <<'EOF'
+import json, sys
+res = json.load(open(sys.argv[1]))
+s = res["summary"]
+assert s["audits_green"], "ingest bench: an accounting audit came back red"
+print(f"baseline (1-process selector, in-run): {s['baseline_uploads_per_s']} uploads/s sustained")
+print(f"baseline (committed, async_bench.json): {s['committed_baseline_uploads_per_s']} uploads/s")
+for n in (1, 2, 4):
+    print(f"  ingest x{n} workers: {res[f'ingest_w{n}']['uploads_per_s_sustained']} uploads/s "
+          f"({s[f'speedup_w{n}']}x in-run, {s[f'speedup_w{n}_vs_committed']}x vs committed)")
+# the ISSUE's yardstick: >=3x sustained uploads/s at 4 workers vs the
+# COMMITTED single-process selector baseline (~256/s, PR 7). The in-run
+# ratio is reported too but is a moving target: the baseline cell
+# already rides this PR's selector-core syscall optimizations.
+target = s["speedup_w4_vs_committed"]
+if target is None or target < 3.0:
+    print(f"WARNING: speedup at 4 workers {target}x vs committed < 3x target")
+    sys.exit(1)
+if s["speedup_w4"] < 3.0:
+    print(f"note: in-run ratio {s['speedup_w4']}x < 3x — the baseline cell shares "
+          "this PR's selector optimizations; see summary.notes for the box ceiling")
+print(f"OK: {target}x at 4 workers vs the committed baseline (>= 3x), all audits green")
+EOF

@@ -271,6 +271,27 @@ def test_lock_flags_unlocked_send_only_under_distributed():
     assert lint(src, path="pkg/engines/t.py") == []
 
 
+def test_lock_and_async_rules_cover_ingest_module():
+    """ISSUE 12: the sharded ingest plane rides the SAME discipline
+    families — an unlocked worker-pipe send and a blocking call inside
+    an asyncfl coroutine both fire against asyncfl/ingest.py paths (the
+    kill-one-worker plane multiplies the threads sharing each pipe)."""
+    ingest = "neuroimagedisttraining_tpu/asyncfl/ingest.py"
+    fs = lint("""
+        class Worker:
+            def reply(self, conn, verdict):
+                conn.send(("v", verdict))
+        """, path=ingest)
+    assert rules_of(fs) == ["lock-send"]
+    fs = lint("""
+        import time
+
+        async def watch_worker(pipe):
+            time.sleep(0.5)
+        """, path=ingest, rules=["async-blocking-call"])
+    assert rules_of(fs) == ["async-blocking-call"]
+
+
 def test_lock_flags_unlocked_shared_map_mutations():
     fs = lint("""
         class Broker:

@@ -382,7 +382,9 @@ def test_sharded_fused_window_losses_match_sequential(tmp_path, cohort21):
 @pytest.mark.parametrize("algorithm,needle", [
     ("fedfomo", "no cohort-sharded round body"),
     ("dispfl", "gossip collectives"),
-    ("local", "no cohort-sharded round body"),
+    # local declared its round on the builder (ROADMAP 1(a)) and now
+    # ARMS cohort sharding — its positive pins live in tests/
+    # test_program.py (arm assertion + sharded==sequential-C-loop)
     ("turboaggregate", "MPC share boundary"),
 ])
 def test_engines_without_sharded_round_fall_back(tmp_path,

@@ -1,0 +1,359 @@
+"""Sharded ingest plane tests (ISSUE 12, asyncfl/ingest.py).
+
+Contracts:
+
+(a) THE sharded-ingest invariant: any partitioning of the same uploads
+    into per-worker ``PartialAccumulator``s, merged in any order, equals
+    one accumulator that folded everything — BITWISE, for the dense
+    int64 lattice AND the secure-quant ``SlotAccumulator`` chunk fold
+    (exact integer/field algebra; a float tree-sum could never give
+    this, its reduction tree changes with the partitioning).
+(b) The worker admission gates render the same verdicts as the
+    single-process ``BufferedFedAvgServer`` key for key (stale /
+    duplicate / future / non-finite / malformed / after-done), and a
+    re-register resets the sender's dedup state.
+(c) Live multi-process runs (SO_REUSEPORT workers + root): audits green
+    — ``received == accepted + dropped`` and
+    ``accepted == aggregated + buffered + lost_with_worker`` — across
+    processes, including the kill-one-worker chaos case where a
+    SIGKILLed worker's buffered uploads are counted, never silently
+    vanished.
+(d) The cached-sync reply contract: a body-less sync at an unchanged
+    version reuses the silo's cached tree; body-less before any full
+    sync is a dropped protocol error.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.asyncfl.ingest import (
+    IngestWorkerCore,
+    PartialAccumulator,
+    ShardedIngestServer,
+    make_fold_spec,
+    model_sizes,
+    single_process_fold,
+)
+from neuroimagedisttraining_tpu.asyncfl.loadgen import (
+    canned_update_tree,
+    run_load,
+)
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.privacy import (
+    QuantSpec,
+    encode_secure_quant,
+)
+
+LIKE = canned_update_tree(0, 64)
+
+
+def _dense_entries(n, leaf_elems=64):
+    return [(canned_update_tree(r, leaf_elems), 100 + 7 * r)
+            for r in range(1, n + 1)]
+
+
+def _secure_entries(n, spec, leaf_elems=64):
+    return [(encode_secure_quant(canned_update_tree(r, leaf_elems), 1.0,
+                                 spec, np.random.default_rng(r)),
+             200 + 11 * r)
+            for r in range(1, n + 1)]
+
+
+def _merge_partition(entries, spec, parts):
+    """Fold ``entries`` split into ``parts``-sized per-worker
+    accumulators, then merge the exported partials in order."""
+    merged = PartialAccumulator(spec, model_sizes(LIKE))
+    i = 0
+    for n in parts:
+        acc = PartialAccumulator(spec, model_sizes(LIKE))
+        for payload, w in entries[i:i + n]:
+            if spec.quant is not None:
+                acc.fold_frame(payload, w)
+            else:
+                acc.fold_dense(payload, w)
+        i += n
+        p = acc.export()
+        if p is not None:
+            merged.merge_payload(p)
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# (a) partition-independent exact merge
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("parts", [[12], [4, 4, 4], [1, 11], [6, 3, 3],
+                                   [2, 2, 2, 2, 2, 2]])
+def test_dense_merge_partition_independent_bitwise(parts):
+    spec = make_fold_spec(LIKE)
+    entries = _dense_entries(12)
+    ref = single_process_fold(entries, spec, LIKE)
+    merged = _merge_partition(entries, spec, parts)
+    assert merged.w_int_total == ref.w_int_total
+    assert merged.count == ref.count
+    for name, _ in model_sizes(LIKE):
+        np.testing.assert_array_equal(merged.totals[name],
+                                      ref.totals[name])
+    # and the dequantized model is bitwise too (same totals, same denom)
+    for a, b in zip(jax.tree.leaves(merged.finalize(LIKE)),
+                    jax.tree.leaves(ref.finalize(LIKE))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("parts", [[9], [3, 3, 3], [1, 8], [5, 2, 2]])
+def test_secure_merge_partition_independent_bitwise(parts):
+    """The SlotAccumulator chunk fold: per-worker chunks lift into plain
+    int64 at partition-dependent boundaries, yet the totals are exact
+    integer sums — bitwise identical for every partitioning (the
+    center-lift is exact while the folded mass stays inside the field's
+    range, which the chunk capacity guarantees)."""
+    quant = QuantSpec.from_bits(32, 10, 3)
+    spec = make_fold_spec(LIKE, quant=quant)
+    entries = _secure_entries(9, quant)
+    ref = single_process_fold(entries, spec, LIKE)
+    refp = ref.export()
+    merged = _merge_partition(entries, spec, parts)
+    assert merged.w_int_total == refp["w_int"]
+    for name, _ in model_sizes(LIKE):
+        np.testing.assert_array_equal(merged.totals[name],
+                                      refp["slots"][name])
+
+
+def test_dense_fold_nan_and_saturation():
+    """The dense lattice's documented edges: NaN coordinates fold as the
+    neutral zero contribution; +/-inf saturates sign-preservingly at the
+    clamp edge (never wraps)."""
+    spec = make_fold_spec(LIKE)
+    bad = canned_update_tree(1, 64)
+    k = bad["params"]["dense"]["kernel"]
+    k[0], k[1], k[2] = np.nan, np.inf, -np.inf
+    acc = PartialAccumulator(spec, model_sizes(LIKE))
+    acc.fold_dense(bad, 3)
+    t = acc.totals["params/dense/kernel"]
+    assert t[0] == 0
+    assert t[1] == 3 * spec.q_max
+    assert t[2] == -3 * spec.q_max
+
+
+def test_fold_spec_headroom_validation():
+    with pytest.raises(ValueError, match="field too small"):
+        make_fold_spec(LIKE, quant=QuantSpec.from_bits(16, 10, 3))
+    spec = make_fold_spec(LIKE, quant=QuantSpec.from_bits(32, 10, 3))
+    assert spec.weight_cap >= 1 << 10
+    assert spec.mass_bound() > 0
+
+
+def test_root_rejects_defenses():
+    with pytest.raises(ValueError, match="defenses"):
+        ShardedIngestServer(LIKE, 2, 4, ingest_workers=1,
+                            defense="trimmed_mean")
+
+
+# ---------------------------------------------------------------------------
+# (b) worker-core admission gates (socket-free)
+# ---------------------------------------------------------------------------
+
+
+def _upload(c, tag=None, n=8.0, seq=None, tree=None, leaf_elems=64):
+    msg = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, c, 0)
+    msg.add(M.ARG_MODEL_PARAMS,
+            tree if tree is not None else canned_update_tree(c,
+                                                             leaf_elems))
+    msg.add(M.ARG_NUM_SAMPLES, n)
+    if tag is not None:
+        msg.add(M.ARG_ROUND_IDX, tag)
+    if seq is not None:
+        msg.add(M.ARG_UPLOAD_SEQ, seq)
+    return msg
+
+
+def _core(wid=0, quant=None, max_staleness=4):
+    spec = make_fold_spec(LIKE, quant=quant)
+    return IngestWorkerCore(wid, spec, LIKE,
+                            max_staleness=max_staleness,
+                            staleness_alpha=0.5)
+
+
+def test_worker_admission_verdicts():
+    core = _core()
+    assert core.handle_upload(_upload(1, tag=0, seq=0)) == "accepted"
+    # transport re-delivery repeats the VERDICT, never the processing
+    assert core.handle_upload(_upload(1, tag=0, seq=0)) == \
+        "dropped_duplicate"
+    # fresh seq, same base version: an honest re-contribution
+    assert core.handle_upload(_upload(1, tag=0, seq=1)) == "accepted"
+    # future tag (worker lags the root by the pipe latency)
+    assert core.handle_upload(_upload(2, tag=7, seq=0)) == \
+        "dropped_future"
+    core.set_model(6, canned_update_tree(99, 64))
+    # ancient tag beyond the ring
+    assert core.handle_upload(_upload(2, tag=1, seq=1)) == \
+        "dropped_stale"
+    # non-finite decoded upload is rejected at the gate
+    bad = canned_update_tree(3, 64)
+    bad["params"]["dense"]["bias"][0] = np.nan
+    assert core.handle_upload(_upload(3, tag=6, seq=0, tree=bad)) == \
+        "dropped_nonfinite"
+    # broken FIELDS are a dropped upload, never a dead dispatch thread
+    assert core.handle_upload(_upload(4, tag=6, seq=0, n=float("nan"))) \
+        == "dropped_malformed"
+    nomsg = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, 5, 0)
+    nomsg.add(M.ARG_MODEL_PARAMS, canned_update_tree(5, 64))
+    nomsg.add(M.ARG_ROUND_IDX, 6)  # no num_samples at all
+    assert core.handle_upload(nomsg) == "dropped_malformed"
+    core.done = True
+    assert core.handle_upload(_upload(1, tag=6, seq=2)) == \
+        "dropped_after_done"
+    s = core.stats
+    assert s["received"] == sum(v for k, v in s.items()
+                                if k != "received")
+
+
+def test_worker_legacy_dedup_and_reregister_reset():
+    core = _core()
+    # legacy sender (no seq): at most one contribution per base version
+    assert core.handle_upload(_upload(1, tag=0)) == "accepted"
+    assert core.handle_upload(_upload(1, tag=0)) == "dropped_duplicate"
+    # a re-register (also how a connection migrates workers after a
+    # kill) resets the sender's dedup state, like the single-process
+    # server's restarted-process contract
+    core.handle_register(1)
+    assert core.handle_upload(_upload(1, tag=0)) == "accepted"
+
+
+def test_worker_entries_match_partial():
+    core = _core()
+    for c in range(1, 5):
+        assert core.handle_upload(_upload(c, tag=0, seq=0)) == "accepted"
+    payload = core.export_partial()
+    assert payload["count"] == 4
+    assert len(payload["entries"]) == 4
+    assert core.export_partial() is None  # swapped out clean
+    # the exported partial equals a single-process fold of the same
+    # decoded uploads at the same integer weights (tau=0: decode is a
+    # bitwise passthrough for dense pytrees)
+    spec = core.spec
+    entries = [(canned_update_tree(c, 64),
+                spec.weight_int(8.0, 0, 0.5)) for c in range(1, 5)]
+    ref = single_process_fold(entries, spec, LIKE)
+    for name, _ in model_sizes(LIKE):
+        np.testing.assert_array_equal(payload["slots"][name],
+                                      ref.totals[name])
+
+
+def test_worker_secure_frame_gate():
+    quant = QuantSpec.from_bits(32, 10, 3)
+    core = _core(quant=quant)
+    frame = encode_secure_quant(canned_update_tree(1, 64), 1.0, quant,
+                                np.random.default_rng(0))
+    assert core.handle_upload(_upload(1, tag=0, seq=0, tree=frame)) == \
+        "accepted"
+    # a dense pytree on the secure path is an invalid frame
+    assert core.handle_upload(_upload(2, tag=0, seq=0)) == \
+        "dropped_undecodable"
+    # spec mismatch (config skew) is named, not folded
+    other = encode_secure_quant(canned_update_tree(3, 64), 1.0,
+                                QuantSpec.from_bits(32, 8, 3),
+                                np.random.default_rng(1))
+    assert core.handle_upload(_upload(3, tag=0, seq=0, tree=other)) == \
+        "dropped_undecodable"
+
+
+# ---------------------------------------------------------------------------
+# (d) cached-sync reply contract (cross_silo client side)
+# ---------------------------------------------------------------------------
+
+
+def test_cached_sync_reuses_model_body():
+    from neuroimagedisttraining_tpu.distributed.cross_silo import (
+        FedAvgClientProc,
+    )
+
+    silo = object.__new__(FedAvgClientProc)
+    silo.rank = 1
+    silo._last_sync_params = None
+    silo._wire_spec = None
+    silo._wire_ef = None
+    silo.wire_masks = None
+    silo.fault_schedule = None
+    silo._upload_seq = 0
+    trained_from = []
+    silo.train_fn = lambda p, r: (trained_from.append(p) or p, 4.0)
+    sent = []
+    silo.send_message = sent.append
+
+    def sync(params, version):
+        msg = M.Message(M.MSG_TYPE_S2C_SYNC_MODEL, 0, 1)
+        if params is not None:
+            msg.add(M.ARG_MODEL_PARAMS, params)
+        msg.add(M.ARG_ROUND_IDX, version)
+        silo._on_sync(msg)
+
+    # body-less sync before any full sync: protocol error, dropped
+    sync(None, 0)
+    assert not sent and not trained_from
+    # full sync caches; the next body-less sync trains from the cache
+    tree = canned_update_tree(1, 8)
+    sync(tree, 0)
+    sync(None, 0)
+    assert len(sent) == 2 and len(trained_from) == 2
+    for a, b in zip(jax.tree.leaves(trained_from[0]),
+                    jax.tree.leaves(trained_from[1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # seq advanced per upload (the root's watermark dedup relies on it)
+    assert [m.get(M.ARG_UPLOAD_SEQ) for m in sent] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# (c) live multi-process runs — slow (spawned workers + asyncio fleet)
+# ---------------------------------------------------------------------------
+
+
+def _assert_green(res):
+    audit = res["upload_audit"]
+    assert audit["received_accounted"], audit
+    assert audit["accepted_accounted"], audit
+    assert res["frames_reconciled"], res
+    assert res["rounds_or_aggregations"] == res["target"], res
+
+
+@pytest.mark.slow
+def test_ingest_two_workers_end_to_end():
+    res = run_load(mode="ingest", num_clients=24, aggregations=5,
+                   buffer_k=8, ingest_workers=2, leaf_elems=64)
+    _assert_green(res)
+    assert res["lost_with_worker"] == 0
+    assert res["workers_live_at_end"] == []  # clean shutdown
+
+
+@pytest.mark.slow
+def test_ingest_kill_one_worker_audits_green():
+    """The chaos case: SIGKILL worker 0 mid-run. Its clients reconnect
+    onto the surviving SO_REUSEPORT listener, every aggregation still
+    lands, and the audit reconciles — uploads the dead worker accepted
+    but never shipped are counted lost_with_worker, never silently
+    vanished."""
+    res = run_load(mode="ingest", num_clients=24, aggregations=6,
+                   buffer_k=8, ingest_workers=2, ingest_kill_at=2,
+                   leaf_elems=64)
+    _assert_green(res)
+    audit = res["upload_audit"]
+    assert not audit["workers"][0]["alive"]
+    # worker 0's acceptances are all accounted: folded (merged or
+    # counted lost) — the invariant, not a specific loss count
+    w0 = audit["workers"][0]
+    assert w0["acc"] == w0["folded"]
+    assert res["client_stats"]["rejoins"] >= 1
+
+
+@pytest.mark.slow
+def test_ingest_secure_quant_end_to_end():
+    res = run_load(mode="ingest", num_clients=16, aggregations=4,
+                   buffer_k=6, ingest_workers=2,
+                   ingest_secure_quant=True, leaf_elems=64)
+    _assert_green(res)
+    assert res["secure_quant"] is True

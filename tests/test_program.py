@@ -91,6 +91,9 @@ def _assert_trees_ulp(a, b, rtol=ULP_RTOL, atol=ULP_ATOL):
 
 def _init_carry(eng):
     gs = eng.init_global_state()
+    if eng.name == "local":
+        per = eng.broadcast_states(gs, eng.num_clients)
+        return (per.params, per.batch_stats)
     if eng.name in ("ditto", "salientgrads"):
         per = eng.broadcast_states(gs, eng.num_clients)
         return (gs.params, gs.batch_stats, per.params, per.batch_stats)
@@ -118,6 +121,10 @@ def _one_round(eng, carry, r):
                                        jnp.asarray(M_np), rngs, lr,
                                        plan_arrays)
         return out[:2], out[4]
+    if eng.name == "local":
+        rngs = eng.per_client_rngs(r, np.arange(eng.num_clients))
+        out = eng._round_jit(*carry, eng.data, rngs, lr)
+        return out[:2], out[2]
     sampled = eng.client_sampling(r)
     rngs = eng.per_client_rngs(r, sampled)
     n = len(carry)
@@ -140,6 +147,13 @@ def _one_round(eng, carry, r):
     pytest.param("dpsgd", {"cs": "ring", "frac": 0.5},
                  marks=pytest.mark.slow),
     pytest.param("dpsgd", {"cs": "random", "frac": 0.5},
+                 marks=pytest.mark.slow),
+    # ROADMAP 1(a): the local engine's trivial carry on the builder
+    pytest.param("local", {}, marks=pytest.mark.slow),
+    # ROADMAP 1(b): the secure-quant codec-family stage composes with
+    # fused windows — the field fold rides the scan bitwise
+    pytest.param("fedavg", {"frac": 0.5, "secure_quant": True,
+                            "secure_quant_field_bits": 32},
                  marks=pytest.mark.slow),
 ])
 def test_fused_window_bitwise_equals_sequential(tmp_path,
@@ -219,6 +233,11 @@ def test_fused_driver_end_to_end_bitwise(tmp_path, synthetic_cohort,
 def _one_sharded_round(eng, r=0):
     carry = _init_carry(eng)
     lr = eng.round_lr(r)
+    if eng.name == "local":
+        # no sampling: the full (mesh-padded) cohort trains; _round_jit
+        # IS the sharded program when _cohort_on
+        rngs = eng.per_client_rngs(r, np.arange(eng.num_clients))
+        return eng._round_jit(*carry, eng.data, rngs, lr)
     if eng.name == "dpsgd":
         M_np = eng.mixing_matrix(r)
         plan, plan_arrays = eng.gossip_plan(M_np)
@@ -237,6 +256,7 @@ def _one_sharded_round(eng, r=0):
     pytest.param("ditto", 4, 1, marks=pytest.mark.slow),
     pytest.param("subavg", 3, 2, marks=pytest.mark.slow),
     pytest.param("dpsgd", 4, 1, marks=pytest.mark.slow),
+    pytest.param("local", 2, 1, marks=pytest.mark.slow),
 ])
 def test_sharded_round_vs_sequential_loop(tmp_path, synthetic_cohort,
                                           algorithm, loss_i, epochs):
@@ -276,7 +296,7 @@ def test_reason_table_has_no_orphans(tmp_path, synthetic_cohort):
     reason, and no key in the table is unreachable by construction (the
     lint rule round-program-reason rejects ad-hoc strings)."""
     declared = {"fedavg", "fedprox", "salientgrads", "ditto", "dpsgd",
-                "subavg"}
+                "subavg", "local"}
     seen = set()
     for name, cls in ENGINES.items():
         if name in ("sailentgrads", "sub-fedavg"):  # registry aliases
@@ -318,13 +338,20 @@ def test_fallback_counter_value_pinned(tmp_path, synthetic_cohort):
     _engine(tmp_path, synthetic_cohort, "fedfomo", K=4,
             val_fraction=0.25, tag="ctr")
     assert c.get(**labels) == before + 1.0
-    # and a sharding fallback announcement rides the same counter
-    sh_labels = dict(plane="sharding", engine="local",
+    # and a sharding fallback announcement rides the same counter —
+    # local now DECLARES its round (ROADMAP 1(a)) and ARMS sharding on
+    # the mesh-padded cohort, so the undeclared fedfomo carries this pin
+    sh_labels = dict(plane="sharding", engine="fedfomo",
                      reason="no-sharded-body")
     before_sh = c.get(**sh_labels)
-    _engine(tmp_path, synthetic_cohort, "local", K=1, client_mesh=8,
-            tag="ctr2")
+    eng = _engine(tmp_path, synthetic_cohort, "fedfomo", K=1,
+                  client_mesh=8, val_fraction=0.25, tag="ctr2")
+    assert not eng._cohort_on
     assert c.get(**sh_labels) == before_sh + 1.0
+    # the newly-declared local engine arms instead of announcing
+    eng_l = _engine(tmp_path, synthetic_cohort, "local", K=1,
+                    client_mesh=8, tag="ctr3")
+    assert eng_l._cohort_on
 
 
 def test_wire_codec_still_collapses_with_counted_reason(
@@ -335,5 +362,129 @@ def test_wire_codec_still_collapses_with_counted_reason(
     eng = _engine(tmp_path, synthetic_cohort, "fedavg", K=4,
                   wire_codec="delta+quant", tag="wck")
     assert eng.fused_fallback_key() == "wire-codec-host-bytes"
+
+
+# ---------------------------------------------------------------------------
+# (d) --secure_quant as an in-process CODEC-family stage (ROADMAP 1(b))
+# ---------------------------------------------------------------------------
+
+
+def _sq_host_fold(upload, ref, w, spec, scales, shift):
+    """THE reference the jitted stage is pinned against: integer fold
+    weights from the identical f32 formula, ``encode_secure_quant``
+    frames folded through a ``SlotAccumulator`` (privacy/secure_quant's
+    host fold — masks cancel exactly mod p), finalized and divided by
+    the integer mass in f32."""
+    from neuroimagedisttraining_tpu.privacy import (
+        SlotAccumulator, encode_secure_quant,
+    )
+
+    w = np.asarray(w, np.float32)
+    wn = w / np.float32(np.max(w))
+    wi = np.maximum(np.rint(wn * np.float32(1 << shift)),
+                    np.float32(1.0)).astype(np.int64)
+    denom = np.float32(wi.sum())
+    acc = SlotAccumulator(spec, like=ref)
+    C = int(wi.size)
+    for c in range(C):
+        u_c = jax.tree.map(lambda t: np.asarray(t)[c], upload)
+        frame = encode_secure_quant(u_c, 1.0, spec,
+                                    np.random.default_rng(1000 + c),
+                                    scales=scales)
+        acc.fold(frame, weight_int=int(wi[c]))
+    host = acc.finalize(like=ref, rescale=1.0, scales=scales)
+    return jax.tree.map(
+        lambda t: (np.asarray(t, np.float32) / denom).astype(t.dtype),
+        host)
+
+
+def test_secure_quant_stage_bitwise_vs_host_fold():
+    """The satellite's core pin: the jitted in-process secure-quant
+    stage (program.secure_quant_aggregate) produces BITWISE the
+    aggregate of privacy.secure_quant's host fold — SlotAccumulator
+    over encode_secure_quant frames at the same (p, frac_bits, scales,
+    integer weights). Exact field/integer algebra plus single
+    correctly-rounded f32 ops on both sides is what makes the equality
+    exact, not approximate. Includes a BatchNorm-magnitude leaf (the
+    leaf_scales path) and a NaN row (quantizes to the neutral zero
+    residue on both sides)."""
+    import types
+
+    from neuroimagedisttraining_tpu.privacy import QuantSpec, leaf_scales
+
+    rng = np.random.default_rng(7)
+    C = 5
+    upload = {
+        "params": {
+            "k": (3.0 * rng.standard_normal((C, 3, 4))).astype(
+                np.float32),
+            "b": rng.standard_normal((C, 7)).astype(np.float32)},
+        "batch_stats": {
+            "m": (40.0 * rng.standard_normal((C, 6))).astype(
+                np.float32)}}
+    upload["params"]["b"][2, 3] = np.nan  # neutral zero residue
+    ref = {
+        "params": {"k": rng.standard_normal((3, 4)).astype(np.float32),
+                   "b": rng.standard_normal(7).astype(np.float32)},
+        "batch_stats": {
+            "m": (50.0 * rng.standard_normal(6)).astype(np.float32)}}
+    w = np.asarray([8.0, 11.0, 9.0, 12.0, 10.0], np.float32)
+    losses = np.asarray([0.5, 0.6, 0.4, 0.7, 0.55], np.float32)
+    spec = QuantSpec.from_bits(32, 10, 3)
+    scales = leaf_scales(ref)
+    shift = 6
+    eng = types.SimpleNamespace(
+        cfg=types.SimpleNamespace(
+            fed=types.SimpleNamespace(defense_type="none")),
+        sq_spec=spec, sq_scales=scales, sq_weight_shift=shift)
+    params, bstats, mean_loss, n_bad = jax.jit(
+        lambda u, rf, ww, ls: round_program.secure_quant_aggregate(
+            eng, u, rf, ww, ls))(upload, ref, jnp.asarray(w),
+                                 jnp.asarray(losses))
+    host = _sq_host_fold(upload, ref, w, spec, scales, shift)
+    _assert_trees_bitwise({"params": params, "batch_stats": bstats},
+                          host)
+    assert int(n_bad) == 1  # counted, not gated — protocol-faithful
+
+
+def test_secure_quant_engine_round_near_plain(tmp_path,
+                                              synthetic_cohort):
+    """Wiring sanity: a fedavg round with --secure_quant armed agrees
+    with the plain round to quantization error (the per-leaf scale's
+    2^-frac_bits lattice), not more — the stage replaced the tail, it
+    did not corrupt it. The fused-window bitwise pin rides the slow
+    matrix above."""
+    pl = _engine(tmp_path, synthetic_cohort, "fedavg", K=1, frac=0.5,
+                 tag="sqp")
+    sq = _engine(tmp_path, synthetic_cohort, "fedavg", K=1, frac=0.5,
+                 secure_quant=True, secure_quant_field_bits=32,
+                 tag="sqs")
+    assert sq.sq_spec is not None and sq.sq_weight_shift >= 1
+    pcarry, _ = _one_round(pl, _init_carry(pl), 0)
+    scarry, _ = _one_round(sq, _init_carry(sq), 0)
+    for a, b in zip(jax.tree.leaves(scarry), jax.tree.leaves(pcarry)):
+        np.testing.assert_allclose(np.asarray(a, np.float64),
+                                   np.asarray(b, np.float64),
+                                   atol=0.05, rtol=0)
+
+
+def test_secure_quant_startup_rejections(tmp_path, synthetic_cohort):
+    """The privacy-plane matrix fails at STARTUP, never mid-round:
+    engines without the default tail, the wire codec, order-statistic
+    defenses, and a too-small field are all named errors."""
+    with pytest.raises(ValueError, match="does not simulate"):
+        _engine(tmp_path, synthetic_cohort, "dpsgd", secure_quant=True,
+                secure_quant_field_bits=32, tag="sjd")
+    with pytest.raises(ValueError, match="wire_codec"):
+        _engine(tmp_path, synthetic_cohort, "fedavg", secure_quant=True,
+                secure_quant_field_bits=32, wire_codec="delta+quant",
+                tag="sjw")
+    with pytest.raises(ValueError, match="clip family"):
+        _engine(tmp_path, synthetic_cohort, "fedavg", secure_quant=True,
+                secure_quant_field_bits=32, defense_type="trimmed_mean",
+                tag="sjt")
+    with pytest.raises(ValueError, match="field_bits 32"):
+        _engine(tmp_path, synthetic_cohort, "fedavg", secure_quant=True,
+                secure_quant_field_bits=16, tag="sjf")
 
 
