@@ -313,7 +313,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "Perfetto-loadable (obs/trace.py); with "
                              "--profile_dir each span also opens a "
                              "jax.profiler.TraceAnnotation so host "
-                             "spans line up with the XLA timeline")
+                             "spans line up with the XLA timeline. "
+                             "Multi-process planes (distributed/run.py "
+                             "--ingest_workers) treat the bare path as "
+                             "the MERGED trace and suffix per-process "
+                             "secondaries .wN (obs/fanin.py)")
     parser.add_argument("--metrics_port", type=int, default=0,
                         help="serve /metrics (Prometheus text "
                              "exposition of the obs registry: stat_info "

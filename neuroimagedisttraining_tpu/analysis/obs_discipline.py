@@ -24,6 +24,23 @@ too generic (``jnp.ndarray.at[...].set`` is the single most common call
 in the round programs). A gauge set inside a trace is still wrong; it
 is covered whenever it is spelled through the obs package
 (``obs_metrics.gauge(...)...``), which every shipped call site does.
+
+ISSUE 13 extensions (the federation-wide fan-in's own discipline):
+
+- ``obs-trace-ctx-key``: the wire trace context rides exactly ONE
+  frame key, ``distributed.message.ARG_TRACE_CTX``. A ``msg.add(
+  "trace_ctx", ...)``/``msg.get("trace_ctx")`` spelled with the string
+  literal works today and silently desyncs the day the constant
+  changes — the same reason the ``!Q`` framing collapsed into one
+  definition. Only the definition site (distributed/message.py) may
+  spell the literal.
+- ``obs-pipe-per-upload``: in ``asyncfl/ingest.py`` telemetry crosses
+  the worker->root pipe BATCHED ("vb" verdict batches, "beats"
+  heartbeat sets, "obs" telemetry payloads). A per-upload spelling —
+  ``conn.send(("v", ...))`` / ``conn.send(("beat", ...))`` — reverts
+  the measured fan-in win (one pipe syscall costs ~0.5-1 ms on this
+  box's sandboxed kernel) and is flagged wherever it appears in that
+  module.
 """
 
 from __future__ import annotations
@@ -104,5 +121,61 @@ class ObsDisciplineRule(Rule):
                 "never again — publish at host boundaries only")
 
 
+#: the single wire trace-context key (distributed.message.ARG_TRACE_CTX)
+TRACE_CTX_LITERAL = "trace_ctx"
+
+#: per-upload pipe-event spellings the batched protocol replaced
+UNBATCHED_PIPE_KINDS = {"v", "beat"}
+
+
+@register
+class ObsFanInRule(Rule):
+    """ISSUE 13: wire-trace-context key discipline + batched-pipe
+    telemetry discipline (module docstring)."""
+
+    rule_ids = ("obs-trace-ctx-key", "obs-pipe-per-upload")
+    description = (
+        "trace context must ride the single ARG_TRACE_CTX constant "
+        "(obs-trace-ctx-key: no 'trace_ctx' string literals in "
+        ".add()/.get() outside distributed/message.py), and "
+        "asyncfl/ingest.py telemetry pipe sends must be batched "
+        "(obs-pipe-per-upload: no ('v', ...)/('beat', ...) events)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        is_message_py = mod.path_parts[-2:] == ("distributed",
+                                                "message.py")
+        is_ingest_py = mod.path_parts[-2:] == ("asyncfl", "ingest.py")
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if (not is_message_py and node.func.attr in ("add", "get")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == TRACE_CTX_LITERAL):
+                yield Finding(
+                    mod.path, node.lineno, "obs-trace-ctx-key",
+                    "the wire trace context rides exactly ONE frame "
+                    "key — spell it M.ARG_TRACE_CTX "
+                    "(distributed/message.py), not the string literal "
+                    "(an ad-hoc key silently unlinks the flow chain)")
+            if (is_ingest_py and node.func.attr == "send" and node.args
+                    and isinstance(node.args[0], ast.Tuple)
+                    and node.args[0].elts
+                    and isinstance(node.args[0].elts[0], ast.Constant)
+                    and node.args[0].elts[0].value
+                    in UNBATCHED_PIPE_KINDS):
+                kind = node.args[0].elts[0].value
+                yield Finding(
+                    mod.path, node.lineno, "obs-pipe-per-upload",
+                    f"per-upload pipe event ({kind!r}) in the ingest "
+                    "telemetry path — batch it (verdicts ride 'vb', "
+                    "heartbeats 'beats', telemetry 'obs'): one pipe "
+                    "send costs ~0.5-1 ms on sandboxed kernels and "
+                    "per-upload sends were the measured fan-in choke")
+
+
 #: the analysis package imports this module for registration
-__all__ = ["ObsDisciplineRule", "CLOCK_DOTTED", "MUTATION_METHODS"]
+__all__ = ["ObsDisciplineRule", "ObsFanInRule", "CLOCK_DOTTED",
+           "MUTATION_METHODS", "TRACE_CTX_LITERAL",
+           "UNBATCHED_PIPE_KINDS"]

@@ -90,6 +90,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import multiprocessing as mp
+import sys
 import threading
 import time
 from typing import Any
@@ -107,8 +108,10 @@ from neuroimagedisttraining_tpu.distributed.comm import (
     Observer,
     QueueDispatchMixin,
 )
+from neuroimagedisttraining_tpu.obs import fanin as obs_fanin
 from neuroimagedisttraining_tpu.obs import flight as obs_flight
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
 
@@ -132,6 +135,10 @@ VERDICT_BATCH_MAX = 64
 #: the root's pending count (and the harvest trigger riding it) lags
 #: the workers by at most one poll tick
 VERDICT_BATCH_AGE_S = 0.05
+#: flow-END events emitted per merged aggregation (ISSUE 13): enough
+#: to causally link a representative set of uploads in the merged
+#: trace without the event volume scaling with buffer_k
+_FLOW_ENDS_MAX = 64
 
 
 # ---------------------------------------------------------------------------
@@ -446,6 +453,12 @@ class IngestWorkerCore:
         self.wire_masks = wire_masks
         self.sizes = model_sizes(init_params)
         self._ring: dict[int, PyTree] = {0: init_params}
+        #: upload-lifecycle stage latencies (ISSUE 13): queue/decode/
+        #: admit/fold observed here per upload, merge/aggregate at the
+        #: root per aggregation — the instrument that replaced the
+        #: ingest bench's hand-timed attribution
+        self._stage_hist = obs_fanin.stage_histogram()
+        self._stage_ns: dict[str, int] = {}
         self.partial = PartialAccumulator(spec, self.sizes)
         #: flat f32 cache of the ring (one flatten per VERSION, so the
         #: per-upload delta transport is three vector ops, not a
@@ -498,22 +511,55 @@ class IngestWorkerCore:
     def handle_upload(self, msg: M.Message) -> str:
         """One admission decision; returns the verdict key (a
         ``upload_stats`` key). Accepted uploads are folded into the
-        local partial before this returns."""
+        local partial before this returns. Stage latencies (queue /
+        decode / admit / fold) land in ``nidt_upload_stage_ms`` and,
+        when the tracer is armed, the whole decision is one span with
+        the upload's wire trace context rendered as a flow step."""
+        t0 = time.perf_counter_ns()
         self.stats["received"] += 1
         if self.done:
             self.stats["dropped_after_done"] += 1
             return "dropped_after_done"
+        self._stage_ns = {}
+        if obs_trace.TRACER.armed:
+            with obs_trace.span("ingest_upload", worker=self.wid,
+                                client=int(msg.sender_id)):
+                verdict = self._admit_guarded(msg)
+        else:
+            verdict = self._admit_guarded(msg)
+        self.stats[verdict] += 1
+        if verdict != "accepted":
+            # drops are rare and each is a control-plane decision the
+            # post-mortem wants; accepts are counted, not recorded
+            # (the hot path stays one ring append per anomaly). These
+            # ship to the root with worker provenance (obs/fanin.py).
+            obs_flight.record(verdict, worker=self.wid,
+                              client=int(msg.sender_id),
+                              version=self.version)
+        t1 = time.perf_counter_ns()
+        recv_ns = getattr(msg, "recv_ns", None)
+        if recv_ns is not None:
+            self._stage_hist.observe((t0 - recv_ns) / 1e6, stage="queue")
+        decode_ns = self._stage_ns.get("decode", 0)
+        fold_ns = self._stage_ns.get("fold", 0)
+        if decode_ns:
+            self._stage_hist.observe(decode_ns / 1e6, stage="decode")
+        if fold_ns:
+            self._stage_hist.observe(fold_ns / 1e6, stage="fold")
+        self._stage_hist.observe(
+            max(0, (t1 - t0) - decode_ns - fold_ns) / 1e6, stage="admit")
+        return verdict
+
+    def _admit_guarded(self, msg: M.Message) -> str:
         try:
-            verdict = self._admit(msg)
+            return self._admit(msg)
         except Exception as e:  # noqa: BLE001 — broken FIELDS are a
             # dropped upload, never a dead worker dispatch thread (the
             # single-process server's contract)
             log.warning("ingest worker %d: dropping malformed upload "
                         "from %s (%s: %s)", self.wid, msg.sender_id,
                         type(e).__name__, e)
-            verdict = "dropped_malformed"
-        self.stats[verdict] += 1
-        return verdict
+            return "dropped_malformed"
 
     def _admit(self, msg: M.Message) -> str:
         from neuroimagedisttraining_tpu.codec import wire as codec
@@ -550,10 +596,12 @@ class IngestWorkerCore:
         if not (np.isfinite(n) and n >= 0):
             raise ValueError(f"non-finite num_samples {n!r}")
         w_int = self.spec.weight_int(n, tau, self.staleness_alpha)
+        fid = obs_trace.flow_id_of(msg.get(M.ARG_TRACE_CTX))
         if self.spec.quant is not None:
             from neuroimagedisttraining_tpu.privacy import secure_quant as sq
 
             frame = msg.get(M.ARG_MODEL_PARAMS)
+            t_dec = time.perf_counter_ns()
             try:
                 sq._validate_frame(frame, self.spec.quant)
                 if sq.SlotAccumulator._frame_sizes(frame) != self.sizes:
@@ -563,12 +611,18 @@ class IngestWorkerCore:
                 log.warning("ingest worker %d: invalid secure frame "
                             "from %d: %s", self.wid, c, e)
                 return "dropped_undecodable"
+            finally:
+                self._stage_ns["decode"] = time.perf_counter_ns() - t_dec
             if seq is None:
                 self._contributed.setdefault(c, set()).add(v)
+            t_fold = time.perf_counter_ns()
             self.partial.fold_frame(frame, w_int)
-            self.entries.append((c, v, None, n, w_int, tau))
+            self._stage_ns["fold"] = time.perf_counter_ns() - t_fold
+            self.entries.append((c, v, None, n, w_int, tau, fid))
+            self._note_flow(fid, c)
             return "accepted"
         ref = self._ring[v]
+        t_dec = time.perf_counter_ns()
         try:
             decoded = codec.decode_update(msg.get(M.ARG_MODEL_PARAMS),
                                           like=self.params, reference=ref,
@@ -578,6 +632,8 @@ class IngestWorkerCore:
             log.warning("ingest worker %d: undecodable upload from %d "
                         "(base %d): %s", self.wid, c, v, e)
             return "dropped_undecodable"
+        finally:
+            self._stage_ns["decode"] = time.perf_counter_ns() - t_dec
         if not np.isfinite(flat_u).all():
             log.warning("ingest worker %d: REJECTING non-finite upload "
                         "from %d (base %d)", self.wid, c, v)
@@ -595,9 +651,20 @@ class IngestWorkerCore:
             # element-wise identical to the per-leaf tree walk
             flat_u = flat_u + (self._flat_ring[self.version]
                                - self._flat_ring[v])
+        t_fold = time.perf_counter_ns()
         self.partial.fold_flat(flat_u, w_int)
-        self.entries.append((c, v, anchor, n, w_int, tau))
+        self._stage_ns["fold"] = time.perf_counter_ns() - t_fold
+        self.entries.append((c, v, anchor, n, w_int, tau, fid))
+        self._note_flow(fid, c)
         return "accepted"
+
+    def _note_flow(self, fid: int | None, c: int) -> None:
+        """Flow STEP for an accepted upload (inside the
+        ``ingest_upload`` span ``handle_upload`` holds open) — the
+        worker hop of the client->worker->root flow chain."""
+        if fid is not None and obs_trace.TRACER.armed:
+            obs_trace.flow("upload", fid, "t", worker=self.wid,
+                           client=int(c))
 
     def export_partial(self) -> dict | None:
         """Swap the in-progress partial out for the root (None when
@@ -652,6 +719,19 @@ class _IngestWorkerProc(Observer):
         self._vb_counts: dict[str, int] = {}
         self._vb_taus: list[int] = []
         self._vb_n = 0
+        #: heartbeat batch (under _lock, ISSUE 13 satellite): per-client
+        #: beats fold into ONE "beats" pipe message per flush interval
+        #: — at cross-device scale the per-beat pipe events were the
+        #: next unbatched fan-in after the verdicts; repeats from the
+        #: same client within one interval are SUPPRESSED (counted)
+        self._beats_pending: set[int] = set()
+        self._obs_beats_suppressed = obs_metrics.gauge(
+            "nidt_ingest_heartbeats_suppressed",
+            "per-client heartbeats folded away by worker-side batching "
+            "(duplicates within one flush interval)")
+        #: telemetry shipper (ISSUE 13): registry snapshot + span/flight
+        #: chunks, one "obs" pipe message per interval — never per frame
+        self._shipper = obs_fanin.WorkerObsShipper()
         comm.add_observer(self)
         self._pipe_thread = threading.Thread(target=self._pipe_loop,
                                              daemon=True)
@@ -665,10 +745,25 @@ class _IngestWorkerProc(Observer):
             self._flush_verdicts_locked()
 
     def _flush_verdicts_locked(self) -> None:
+        if self._beats_pending:
+            # heartbeats ride the same flush cadence as the verdict
+            # batches but are ordering-independent of the audit (only
+            # vb-before-partial is an invariant)
+            self.conn.send(("beats", self.wid,  # nidt: allow[lock-send] -- every caller holds self._lock (the _locked suffix contract); the one pipe has no other writer thread outside it
+                            sorted(self._beats_pending)))
+            self._beats_pending.clear()
         if not self._vb_n:
             return
         self.conn.send(("vb", self.wid, self._vb_counts, self._vb_taus))  # nidt: allow[lock-send] -- every caller holds self._lock (the _locked suffix contract); the one pipe has no other writer thread outside it
         self._vb_counts, self._vb_taus, self._vb_n = {}, [], 0
+
+    def _ship_obs_locked(self, force: bool = False) -> None:
+        """Under ``_lock``: one batched telemetry payload per interval
+        (rate-limited by the shipper; ``force`` for the pre-bye final
+        ship so the root's merged artifacts include the tail)."""
+        payload = self._shipper.payload(force=force)
+        if payload is not None:
+            self.conn.send(("obs", self.wid, payload))  # nidt: allow[lock-send] -- caller holds self._lock (the _locked suffix contract); the one pipe has no other writer thread outside it
 
     def run(self) -> None:
         self._pipe_thread.start()
@@ -683,9 +778,12 @@ class _IngestWorkerProc(Observer):
             try:
                 if not self.conn.poll(VERDICT_BATCH_AGE_S):
                     # quiet tick: age out a partially-filled batch so
-                    # the root's pending count never lags for long
+                    # the root's pending count never lags for long;
+                    # the telemetry shipper rate-limits itself to one
+                    # payload per OBS_SHIP_INTERVAL_S on the same tick
                     with self._lock:
                         self._flush_verdicts_locked()
+                        self._ship_obs_locked()
                     continue
                 cmd = self.conn.recv()
             except (EOFError, OSError):
@@ -706,6 +804,13 @@ class _IngestWorkerProc(Observer):
                     payload = self.core.export_partial()
                     self.conn.send(("partial", self.wid, cmd[1], payload,
                                     dict(self.core.stats)))
+            elif kind == "clock":
+                # spawn-time clock handshake (obs/fanin.py): echo the
+                # root's t0 with this process's perf_counter reading;
+                # the root estimates the offset at the pipe's midpoint
+                with self._lock:
+                    self.conn.send(("clock_reply", self.wid, cmd[1],
+                                    time.perf_counter_ns()))
             elif kind == "finish":
                 self._finish()
                 return
@@ -722,10 +827,20 @@ class _IngestWorkerProc(Observer):
             drain(5.0)
         with self._lock:
             self._flush_verdicts_locked()
+            obs_flight.record("worker_finish", worker=self.wid,
+                              residual=self.core.partial.count,
+                              received=self.core.stats["received"])
+            # final telemetry ship BEFORE the bye (same pipe, FIFO):
+            # the root drains it while waiting on byes, so the merged
+            # artifacts include this worker's tail
+            self._ship_obs_locked(force=True)
             residual = self.core.partial.count
             self.conn.send(("bye", self.wid, dict(self.core.stats),
                             residual, self.comm.byte_stats(),
                             self.comm.peak_connections))
+        # the worker's LOCAL trace dump (the .wN-suffixed secondary
+        # artifact; the root's merged trace is the primary)
+        obs_trace.dump()
         self.comm.stop_receive_message()
 
     # ---- client frames (dispatch thread) ----
@@ -736,8 +851,17 @@ class _IngestWorkerProc(Observer):
         elif msg_type == M.MSG_TYPE_C2S_REGISTER:
             self._on_register(msg)
         elif msg_type == M.MSG_TYPE_C2S_HEARTBEAT:
+            # batched (ISSUE 13 satellite): the beat joins the pending
+            # set and crosses the pipe in ONE "beats" message at the
+            # next flush tick (<= VERDICT_BATCH_AGE_S away, far inside
+            # any sane heartbeat timeout); a repeat beat from the same
+            # client inside one interval carries no extra liveness
+            # information and is suppressed, counted in the gauge
             with self._lock:
-                self.conn.send(("beat", self.wid, msg.sender_id))
+                if msg.sender_id in self._beats_pending:
+                    self._obs_beats_suppressed.inc()
+                else:
+                    self._beats_pending.add(msg.sender_id)
         else:
             log.warning("ingest worker %d: dropping unexpected %s from "
                         "%s", self.wid, msg_type, msg.sender_id)
@@ -826,6 +950,21 @@ def _ingest_worker_main(wid: int, conn, wcfg: dict) -> None:
             "\n".join(f"{n} {s}" for s, n in samples.most_common(40))))
     from neuroimagedisttraining_tpu.asyncfl.loop import SelectorCommManager
 
+    # per-process obs plane (ISSUE 13): a spawned worker starts with a
+    # fresh registry/tracer/flight ring. Arm the tracer when the root's
+    # is armed; LOCAL artifact paths are .wN-suffixed so N workers
+    # inheriting one --trace_out/--flight_out never clobber one file —
+    # the root's MERGED artifacts at the bare paths are the primary ones
+    ocfg = wcfg.get("obs") or {}
+    if ocfg.get("trace"):
+        obs_trace.arm(
+            obs_fanin.suffixed_path(ocfg.get("trace_path", ""), wid)
+            or None,
+            tags={"role": "ingest-worker", "worker": wid})
+    obs_flight.configure(
+        capacity=ocfg.get("flight_capacity"),
+        path=obs_fanin.suffixed_path(ocfg.get("flight_path", ""), wid))
+
     core = IngestWorkerCore(
         wid, wcfg["spec"], wcfg["init_params"],
         max_staleness=wcfg["max_staleness"],
@@ -897,7 +1036,8 @@ class ShardedIngestServer(BufferedFedAvgServer):
                  ingest_weight_ref: float = 32.0,
                  heartbeat_timeout: float = 0.0, wire_masks=None,
                  host_map: dict[int, str] | None = None,
-                 spawn_timeout: float = 180.0, **kw):
+                 spawn_timeout: float = 180.0, trace_out: str = "",
+                 flight_out: str = "", **kw):
         if ingest_workers < 1:
             raise ValueError(
                 f"ingest_workers must be >= 1, got {ingest_workers}")
@@ -941,6 +1081,17 @@ class ShardedIngestServer(BufferedFedAvgServer):
             "nidt_ingest_worker_uploads_total",
             "per-worker upload verdict events at the root",
             labelnames=("worker", "outcome"))
+        # ---- federation-wide telemetry fan-in (ISSUE 13) ----
+        # workers ship registry snapshots / span chunks / flight events
+        # over the verdict pipes; this merges them into ONE exposition
+        # (metrics_view), ONE trace and ONE flight dump (dump_obs). The
+        # BARE --trace_out/--flight_out paths are the merged artifacts;
+        # workers write .wN-suffixed local secondaries.
+        self.trace_out = trace_out
+        self.flight_out = flight_out
+        self.fanin = obs_fanin.TelemetryFanIn()
+        self._stage_hist = obs_fanin.stage_histogram()
+        self._obs_dumped = False
         # ---- worker processes ----
         ctx = mp.get_context("spawn")
         wcfg = {"spec": self.fold_spec, "init_params": self.params,
@@ -949,7 +1100,11 @@ class ShardedIngestServer(BufferedFedAvgServer):
                 "wire_masks": wire_masks,
                 "host_map": host_map,
                 "world_size": world_size or num_clients + 1,
-                "base_port": self.base_port}
+                "base_port": self.base_port,
+                "obs": {"trace": bool(trace_out) or obs_trace.TRACER.armed,
+                        "trace_path": trace_out,
+                        "flight_path": flight_out,
+                        "flight_capacity": obs_flight.FLIGHT.capacity}}
         self._workers: dict[int, dict] = {}
         for wid in range(self.ingest_workers):
             parent, child = ctx.Pipe(duplex=True)
@@ -996,6 +1151,39 @@ class ShardedIngestServer(BufferedFedAvgServer):
         self._harvest_seq = 0
         self._staged: list[tuple[int, dict]] = []
         self._finishing = False
+        # spawn-time clock handshake: probe, then collect the replies
+        # HERE rather than on the event loop — run() may start seconds
+        # after this ctor returns (loadgen spawns its fleet shards in
+        # between), and a reply aging in the pipe would inflate t1 by
+        # that gap, so the estimated offset would absorb half of it
+        # and misalign every worker timeline in the merged trace
+        for wid, w in self._workers.items():
+            self.fanin.register_worker(wid)
+            try:
+                w["conn"].send(("clock", time.perf_counter_ns()))  # nidt: allow[lock-send] -- ctor is single-threaded: the event loop and monitor threads do not exist yet
+            except (BrokenPipeError, OSError):
+                pass
+        deadline = time.monotonic() + 2.0
+        pending = set(self._workers)
+        while pending and time.monotonic() < deadline:
+            for wid in sorted(pending):
+                w = self._workers[wid]
+                try:
+                    while w["conn"].poll(0.02):
+                        ev = w["conn"].recv()
+                        with self._rlock:
+                            # early frames (a fast client's reg/vb) are
+                            # dispatched normally, never dropped
+                            self._handle_event(wid, ev)
+                        if ev[0] == "clock_reply":
+                            pending.discard(wid)
+                            break
+                except (EOFError, OSError):
+                    pending.discard(wid)  # death surfaces in run()
+        if pending:
+            log.warning("ingest root: no clock reply from workers %s "
+                        "within 2s; their merged-trace timelines fall "
+                        "back to offset 0", sorted(pending))
         log.info("ingest root: %d workers ready on port %d",
                  self.ingest_workers, self.base_port)
 
@@ -1021,6 +1209,35 @@ class ShardedIngestServer(BufferedFedAvgServer):
                     out[k] += bs.get(k, 0)
         return out
 
+    def metrics_view(self):
+        """The MERGED registry view ``--metrics_port`` should serve
+        under ``--ingest_workers``: root samples + worker samples
+        (``worker`` label) + snapshot-staleness gauges. Pass as the
+        ``registry`` of ``obs.http.start_metrics_server``."""
+        return self.fanin.metrics_view()
+
+    def dump_obs(self, reason: str = "end of run"
+                 ) -> dict[str, str | None]:
+        """Write the MERGED obs artifacts at the bare configured paths
+        (idempotent; called at end of run and on the crash path with a
+        truthful ``reason``). The merged trace is the primary
+        ``--trace_out`` artifact — workers only write ``.wN``-suffixed
+        local secondaries."""
+        with self._rlock:
+            if self._obs_dumped:
+                return {}
+            self._obs_dumped = True
+        out: dict[str, str | None] = {}
+        if self.trace_out:
+            out["trace"] = self.fanin.dump_trace(self.trace_out)
+            log.info("ingest root: merged trace -> %s", out["trace"])
+        if self.flight_out:
+            out["flight"] = self.fanin.dump_flight(self.flight_out,
+                                                   reason=reason)
+            log.info("ingest root: merged flight dump -> %s (%s)",
+                     out["flight"], reason)
+        return out
+
     # ---- the root event loop ----
 
     def run(self) -> None:
@@ -1031,10 +1248,21 @@ class ShardedIngestServer(BufferedFedAvgServer):
             while not self._done.is_set():
                 self._poll_once()
         finally:
+            crashed = sys.exc_info()[0] is not None
             if not self._done.is_set():
                 # crashed out of the loop: leave no orphan processes
                 self._kill_workers()
                 self._done.set()
+            # merged obs artifacts even on the crash/all-workers-dead
+            # paths (idempotent: the clean path dumped in _finish_join)
+            self.dump_obs(reason="failure" if crashed else "end of run")
+            if crashed and self.flight_out:
+                # the caller's failure_context is about to dump the
+                # ROOT ring (with its "failure" event) to the default
+                # flight path — point it at a sibling so it cannot
+                # clobber the merged artifact with a root-only view;
+                # both post-mortems survive, truthfully labeled
+                obs_flight.configure(path=self.flight_out + ".root")
 
     def _poll_once(self, timeout: float = 0.1) -> None:
         conns = {w["conn"]: wid for wid, w in self._workers.items()
@@ -1115,6 +1343,23 @@ class ShardedIngestServer(BufferedFedAvgServer):
             c = ev[2]
             self._last_beat[c] = time.monotonic()
             self._suspect.discard(c)
+        elif kind == "beats":
+            # worker-side batched heartbeats (ISSUE 13 satellite): one
+            # pipe event per flush interval carrying every client that
+            # beat in it — liveness granularity is the flush interval,
+            # far inside any sane heartbeat timeout
+            now = time.monotonic()
+            for c in ev[2]:
+                self._last_beat[c] = now
+                self._suspect.discard(c)
+        elif kind == "obs":
+            # batched telemetry payload -> the fan-in (snapshots, span
+            # chunks, flight events); ordering-independent of the
+            # vb-before-partial audit invariant
+            self.fanin.ingest(wid, ev[2])
+        elif kind == "clock_reply":
+            self.fanin.note_clock(wid, ev[2], ev[3],
+                                  time.perf_counter_ns())
         elif kind == "partial":
             seq, payload, stats = ev[2], ev[3], ev[4]
             w["stats"] = stats
@@ -1188,11 +1433,14 @@ class ShardedIngestServer(BufferedFedAvgServer):
         self._harvest_waiting = None
         if not parts:
             return
+        t_merge = time.perf_counter_ns()
         acc = PartialAccumulator(self.fold_spec, model_sizes(self.params))
         entries: list[tuple] = []
         for wid, payload in parts:
             acc.merge_payload(payload)
             entries.extend(payload["entries"])
+        self._stage_hist.observe(
+            (time.perf_counter_ns() - t_merge) / 1e6, stage="merge")
         if acc.w_int_total > self.fold_spec.mass_bound():
             # int64 exactness no longer provable: discard the buffer
             # loudly (the secure path's aggregation_discarded contract),
@@ -1207,8 +1455,27 @@ class ShardedIngestServer(BufferedFedAvgServer):
                               error="ingest mass bound exceeded")
             return
         entries.sort(key=lambda e: (e[0], e[1]))
+        t_agg = time.perf_counter_ns()
         self.params = acc.finalize(self.params)
+        self._stage_hist.observe(
+            (time.perf_counter_ns() - t_agg) / 1e6, stage="aggregate")
         self.round_idx += 1
+        if obs_trace.TRACER.armed:
+            # flow ENDS for the merged uploads' wire trace contexts
+            # (entry element 6), inside an aggregate span so Perfetto
+            # has a slice to bind the arrows to; capped per merge
+            with obs_trace.span("aggregate", version=self.round_idx,
+                                clients=acc.count):
+                flows = 0
+                for e in entries:
+                    fid = e[6] if len(e) > 6 else None
+                    if fid is None:
+                        continue
+                    obs_trace.flow("upload", fid, "f",
+                                   version=self.round_idx)
+                    flows += 1
+                    if flows >= _FLOW_ENDS_MAX:
+                        break
         self._ring[self.round_idx] = self.params
         floor = self.round_idx - self.max_staleness
         for old in [k for k in self._ring if k < floor]:
@@ -1262,6 +1529,7 @@ class ShardedIngestServer(BufferedFedAvgServer):
         except (EOFError, OSError):
             pass
         w["alive"] = False
+        self.fanin.mark_dead(wid)  # last snapshot stays, marked stale
         lost = max(0, w["acc"] - w["folded"] - w["residual"])
         if lost and not w["bye"]:
             # accepted uploads that died WITH the worker: accounted
@@ -1302,6 +1570,9 @@ class ShardedIngestServer(BufferedFedAvgServer):
                     break
             time.sleep(0.05)
         self._kill_workers(join_first=True)
+        # every worker's final pre-bye obs payload has been drained by
+        # the event loop by now — write the merged artifacts
+        self.dump_obs()
         self._done.set()
         self.finish()
 

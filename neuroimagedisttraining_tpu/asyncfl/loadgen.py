@@ -58,6 +58,9 @@ from neuroimagedisttraining_tpu.faults.schedule import (
     FaultSchedule,
     parse_fault_spec,
 )
+from neuroimagedisttraining_tpu.obs import fanin as obs_fanin
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
 
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
@@ -183,8 +186,15 @@ async def _run_client(rank: int, port: int, update: dict,
         stats.syncs_seen += 1
         if t_sent is not None:
             if seq % 4 == 0:
-                stats.rtt_ms.append(
-                    1e3 * (time.monotonic() - t_sent))
+                rtt = 1e3 * (time.monotonic() - t_sent)
+                stats.rtt_ms.append(rtt)
+                # live registry mirror (ISSUE 13 satellite): the RTT
+                # percentiles used to exist only as ingest_bench.json
+                # notes. LIVE for the in-process fleet; spawned fleet
+                # shards observe into private registries no shipper
+                # sends home, so run_load backfills their samples at
+                # fleet-merge time instead (end-of-run visibility).
+                obs_fanin.rtt_histogram().observe(rtt)
             t_sent = None
         if schedule is not None and schedule.crashed(version, rank):
             # simulated SIGKILL: drop the connection, then wait out the
@@ -226,11 +236,23 @@ async def _run_client(rank: int, port: int, update: dict,
         out.add(M.ARG_NUM_SAMPLES, num_samples)
         out.add(M.ARG_ROUND_IDX, version)
         out.add(M.ARG_UPLOAD_SEQ, seq)
+        # wire trace context (ISSUE 13): the flow STARTS here; the
+        # worker's admission and the root's aggregate link to the same
+        # id, so the merged trace reads one upload end to end
+        ctx = obs_trace.make_trace_ctx(rank, seq)
+        out.add(M.ARG_TRACE_CTX, ctx)
         seq += 1
         buf = _frame(out)
         try:
-            writer.write(buf)
-            await writer.drain()
+            if obs_trace.TRACER.armed:
+                with obs_trace.span("client_upload", client=rank):
+                    obs_trace.flow("upload", obs_trace.flow_id_of(ctx),
+                                   "s", client=rank)
+                    writer.write(buf)
+                    await writer.drain()
+            else:
+                writer.write(buf)
+                await writer.drain()
         except (ConnectionError, OSError):
             if await _lost_connection():
                 continue
@@ -315,7 +337,10 @@ def run_load(mode: str = "async", num_clients: int = 200,
              ingest_workers: int = 2,
              ingest_kill_at: int = -1,
              ingest_secure_quant: bool = False,
-             fleet_procs: int = 1) -> dict:
+             fleet_procs: int = 1,
+             trace_out: str = "",
+             flight_out: str = "",
+             metrics_port: int = 0) -> dict:
     """Drive ``num_clients`` simulated clients against one server and
     return the metrics dict. ``mode="async"`` runs the buffered server
     for ``aggregations`` aggregations of ``buffer_k`` uploads each;
@@ -354,11 +379,18 @@ def run_load(mode: str = "async", num_clients: int = 200,
             from neuroimagedisttraining_tpu.privacy import QuantSpec
 
             quant = QuantSpec.from_bits(32, 10, 3)
+        if trace_out:
+            # the harness process hosts BOTH the in-process client
+            # fleet and the ingest root, so arming here captures the
+            # client flow starts AND the root merge/aggregate spans;
+            # workers arm their own tracers from the wcfg obs config
+            obs_trace.arm(trace_out, tags={"role": "loadgen-root"})
         server = ShardedIngestServer(
             init, aggregations, num_clients,
             ingest_workers=ingest_workers, buffer_k=k,
             staleness_alpha=staleness_alpha, max_staleness=max_staleness,
-            base_port=port, secure_quant=quant)
+            base_port=port, secure_quant=quant, trace_out=trace_out,
+            flight_out=flight_out)
         rounds = aggregations
     elif mode == "async":
         comm = SelectorCommManager(0, num_clients + 1, base_port=port,
@@ -452,6 +484,15 @@ def run_load(mode: str = "async", num_clients: int = 200,
             if not c.poll(300.0) or c.recv() != "ready":
                 raise RuntimeError("loadgen fleet shard failed to start")
 
+    msrv = None
+    if metrics_port and mode == "ingest":
+        # the MERGED view (root + worker-labeled samples + staleness
+        # gauges) — what a live scrape of the sharded plane should see
+        from neuroimagedisttraining_tpu.obs.http import MetricsServer
+
+        msrv = MetricsServer(max(0, int(metrics_port)),
+                             registry=server.metrics_view())
+
     t0 = time.monotonic()
     server_thread.start()
     if mode == "ingest" and ingest_kill_at >= 0:
@@ -492,6 +533,15 @@ def run_load(mode: str = "async", num_clients: int = 200,
         for f in dataclasses.fields(ClientStats):
             setattr(fleet, f.name,
                     getattr(fleet, f.name) + getattr(s, f.name))
+    if fleet_procs > 1 and fleet.rtt_ms:
+        # sharded fleets ran EVERY client in spawned processes whose
+        # registries never ship home — backfill their RTT samples into
+        # this process's histogram so the merged scrape still carries
+        # the distribution (in-process fleets observed live above, and
+        # run exactly one of the two paths, so no double count)
+        h = obs_fanin.rtt_histogram()
+        for v in fleet.rtt_ms:
+            h.observe(float(v))
     if mode in ("async", "ingest"):
         adv_t = [h["t"] for h in server.history]
         accepted = server.upload_stats["accepted"]
@@ -585,6 +635,34 @@ def run_load(mode: str = "async", num_clients: int = 200,
         result["secure_quant"] = bool(ingest_secure_quant)
         result["lost_with_worker"] = int(
             server.upload_stats["lost_with_worker"])
+        # ---- federation-wide obs summary (ISSUE 13) ----
+        result["obs_fanin"] = server.fanin.summary()
+        merged_text = server.fanin.prometheus_text()
+        import re as _re
+
+        result["merged_metrics"] = {
+            "port": msrv.port if msrv is not None else None,
+            "lines": len(merged_text.splitlines()),
+            "worker_labeled": sorted(
+                {int(m) for m in _re.findall(r'worker="(\d+)"',
+                                             merged_text)}),
+            "has_stage_samples":
+                "nidt_upload_stage_ms_bucket" in merged_text,
+            "has_rtt_samples": "nidt_client_rtt_ms_bucket" in merged_text,
+        }
+        if trace_out:
+            flows = obs_fanin.linked_flow_ids(
+                server.fanin.merged_trace_events())
+            result["merged_trace"] = {
+                "path": trace_out,
+                "flow_started": len(flows["s"]),
+                "flow_stepped": len(flows["t"]),
+                "flow_ended": len(flows["f"]),
+                "flow_linked": len(flows["linked"]),
+            }
+            obs_trace.disarm()
+    if msrv is not None:
+        msrv.close()
     return result
 
 
@@ -633,6 +711,20 @@ def main(argv=None) -> int:
     ap.add_argument("--ingest_secure_quant", action="store_true",
                     help="clients ship secure-quant field-element "
                          "frames; workers fold SlotAccumulator chunks")
+    ap.add_argument("--metrics_port", type=int, default=0,
+                    help="ingest modes: serve the MERGED /metrics "
+                         "(root + worker-labeled samples + staleness "
+                         "gauges, obs/fanin.py) on this port during "
+                         "the run; 0 = off")
+    ap.add_argument("--trace_out", type=str, default="",
+                    help="ingest modes: write the MERGED Chrome trace "
+                         "(client flow starts + worker admission spans "
+                         "+ root aggregate spans, clock-aligned) to "
+                         "this path; workers write .wN-suffixed local "
+                         "secondaries")
+    ap.add_argument("--flight_out", type=str, default="",
+                    help="ingest modes: write the MERGED flight dump "
+                         "(per-worker provenance) to this path")
     ap.add_argument("--fleet_procs", type=int, default=0,
                     help="shard the client fleet across N processes "
                          "(one asyncio loop is ~a core of syscalls on "
@@ -674,7 +766,10 @@ def main(argv=None) -> int:
             if mode == "ingest":
                 kw.update(ingest_workers=args.ingest_workers,
                           ingest_kill_at=args.ingest_kill_at,
-                          ingest_secure_quant=args.ingest_secure_quant)
+                          ingest_secure_quant=args.ingest_secure_quant,
+                          metrics_port=args.metrics_port,
+                          trace_out=args.trace_out,
+                          flight_out=args.flight_out)
             cells[mode] = run_load(mode=mode, **kw)
             print(json.dumps(cells[mode]), flush=True)
     bench_name = ("ingest_plane" if args.mode == "ingest_bench"

@@ -279,6 +279,11 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
                             self.rank, e)
                 continue
             self._count_recv(length + 8)
+            # queue-stage anchor (ISSUE 13): the nidt_upload_stage_ms
+            # "queue" stage is handler-start minus this read-completion
+            # stamp — with inline dispatch it measures the frame loop's
+            # own backlog, with queued dispatch the handoff wait
+            msg.recv_ns = time.perf_counter_ns()
             with self._send_lock:
                 conn.rank = msg.sender_id
                 if msg.get(ARG_CONN_PERSISTENT):

@@ -81,8 +81,13 @@ from neuroimagedisttraining_tpu.distributed.cross_silo import (
 )
 from neuroimagedisttraining_tpu.obs import flight as obs_flight
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
+
+#: flow-END events emitted per aggregation when the tracer is armed
+#: (ISSUE 13) — bounded so trace volume never scales with buffer_k
+_FLOW_ENDS_MAX = 64
 
 
 def staleness_weight(n: float, tau: int, alpha: float) -> float:
@@ -390,6 +395,7 @@ class BufferedFedAvgServer(FedAvgServer):
         one ``upload_stats`` counter and logs its reason."""
         c = msg.sender_id
         tag = msg.get(M.ARG_ROUND_IDX)
+        fid = obs_trace.flow_id_of(msg.get(M.ARG_TRACE_CTX))
         v = self.round_idx if tag is None else int(tag)
         tau = self.round_idx - v
         if tau < 0:
@@ -479,7 +485,7 @@ class BufferedFedAvgServer(FedAvgServer):
             # quantize maps a client-side NaN to the neutral zero
             # residue, never into the aggregate) — staleness is handled
             # by the down-weighting alone
-            self._buffer_put(c, tau, n, {"frame": frame})
+            self._buffer_put(c, tau, n, {"frame": frame, "fid": fid})
             return True
         ref = self._ring[v]  # present by construction: tau <= ring span
         try:
@@ -535,7 +541,7 @@ class BufferedFedAvgServer(FedAvgServer):
                 decoded, self.params, ref)
         if seq is None:  # the watermark already advanced at the gate
             self._contributed.setdefault(c, set()).add(v)
-        self._buffer_put(c, tau, n, {"tree": u_eff})
+        self._buffer_put(c, tau, n, {"tree": u_eff, "fid": fid})
         return True
 
     def _buffer_put(self, c: int, tau: int, n: float,
@@ -566,6 +572,13 @@ class BufferedFedAvgServer(FedAvgServer):
             "client": c, "n": n, "tau": tau,
             "weight": staleness_weight(n, tau, self.staleness_alpha),
             **payload})
+        if self._buffer[-1].get("fid") is not None \
+                and obs_trace.TRACER.armed:
+            # wire trace context (ISSUE 13): flow STEP at admission,
+            # inside its own slice so Perfetto binds the arrow
+            with obs_trace.span("upload_admit", client=int(c)):
+                obs_trace.flow("upload", self._buffer[-1]["fid"], "t",
+                               client=int(c))
         # accepted-upload observability: the staleness spectrum the
         # (1+tau)^-alpha weighting actually meets, live buffer depth,
         # and the accept decision in the flight ring
@@ -700,6 +713,15 @@ class BufferedFedAvgServer(FedAvgServer):
         version++, ring/dedup maintenance, history, finish."""
         self._buffer = []
         self.round_idx += 1
+        if obs_trace.TRACER.armed:
+            # flow ENDS for the aggregated uploads (ISSUE 13): one
+            # aggregate slice, the merged contexts' arrows land in it
+            with obs_trace.span("aggregate", version=self.round_idx,
+                                clients=len(senders)):
+                for e in entries[:_FLOW_ENDS_MAX]:
+                    if e.get("fid") is not None:
+                        obs_trace.flow("upload", e["fid"], "f",
+                                       version=self.round_idx)
         obs_flight.record("aggregate", version=self.round_idx,
                           clients=len(senders),
                           taus=[int(e["tau"]) for e in entries])

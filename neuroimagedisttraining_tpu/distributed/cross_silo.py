@@ -38,6 +38,7 @@ from neuroimagedisttraining_tpu.distributed.managers import (
 )
 from neuroimagedisttraining_tpu.obs import flight as obs_flight
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
 
 log = logging.getLogger("neuroimagedisttraining_tpu.cross_silo")
@@ -1461,8 +1462,20 @@ class FedAvgClientProc(ClientManager):
         out.add(M.ARG_NUM_SAMPLES, float(n))
         out.add(M.ARG_ROUND_IDX, round_idx)
         out.add(M.ARG_UPLOAD_SEQ, self._upload_seq)
+        # wire trace context (ISSUE 13): the client originates the flow
+        # — every downstream hop (worker admission, root aggregate)
+        # links its events to this id, so one upload reads as a
+        # causally-connected track in the merged trace
+        ctx = obs_trace.make_trace_ctx(self.rank, self._upload_seq)
+        out.add(M.ARG_TRACE_CTX, ctx)
         self._upload_seq += 1
-        self.send_message(out)
+        if obs_trace.TRACER.armed:
+            with obs_trace.span("client_upload", round=round_idx):
+                obs_trace.flow("upload", obs_trace.flow_id_of(ctx), "s",
+                               round=round_idx)
+                self.send_message(out)
+        else:
+            self.send_message(out)
 
     def _on_finish(self, msg: M.Message) -> None:
         self.final_params = None  # server holds the aggregate

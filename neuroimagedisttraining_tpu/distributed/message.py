@@ -71,6 +71,15 @@ ARG_EF_RESET = "ef_reset"
 #: synchronous server keys dedup on the round tag instead and ignores
 #: this; senders without it fall back to one-contribution-per-version.
 ARG_UPLOAD_SEQ = "upload_seq"
+#: wire trace context (ISSUE 13): ``{"trace_id": int, "span_id": int}``
+#: stamped by the CLIENT on every upload frame
+#: (``obs.trace.make_trace_ctx``) and propagated through admission ->
+#: fold -> partial merge -> aggregate as Perfetto flow events, so one
+#: upload's client->worker->root lifecycle reads as a causally-linked
+#: track in the merged trace (obs/fanin.py). THE single key — nidtlint
+#: ``obs-trace-ctx-key`` rejects ad-hoc spellings — and always
+#: optional: a frame without it is processed identically, just unlinked.
+ARG_TRACE_CTX = "trace_ctx"
 #: sender promise (ISSUE 7): "this connection stays open — route my
 #: replies back on it". The selector core maps rank -> connection only
 #: for frames carrying this flag; a legacy ``SocketCommManager`` peer

@@ -556,7 +556,13 @@ def main(argv=None) -> int:
                     help="write this process's host-span timeline as "
                          "Chrome trace-event JSON (obs/trace.py, "
                          "Perfetto-loadable) at exit; give each rank "
-                         "its own path")
+                         "its own path. Under --ingest_workers N the "
+                         "BARE path is the MERGED federation trace "
+                         "(root + clock-aligned worker timelines + "
+                         "upload flow links, obs/fanin.py) — the "
+                         "primary artifact; worker processes write "
+                         ".wN-suffixed local secondaries instead of "
+                         "clobbering one file")
     ap.add_argument("--flight_events", type=int, default=256,
                     help="flight-recorder ring capacity (obs/flight.py) "
                          "— the last N control-plane decisions kept for "
@@ -855,7 +861,9 @@ def main(argv=None) -> int:
                     staleness_alpha=args.staleness_alpha,
                     max_staleness=args.max_staleness,
                     base_port=args.base_port, host_map=host_map,
-                    heartbeat_timeout=args.heartbeat_timeout, **kw)
+                    heartbeat_timeout=args.heartbeat_timeout,
+                    trace_out=args.trace_out,
+                    flight_out=args.flight_out, **kw)
                 print(f"[server] sharded ingest plane on port "
                       f"{args.base_port}: {args.ingest_workers} "
                       f"selector workers (SO_REUSEPORT), buffer_k="
@@ -916,10 +924,19 @@ def main(argv=None) -> int:
 
         msrv = start_metrics_server(args.metrics_port,
                                     health_probe=_health,
+                                    # sharded plane: serve the MERGED
+                                    # view — root samples + worker-
+                                    # labeled samples + snapshot-
+                                    # staleness gauges (obs/fanin.py)
+                                    registry=(server.metrics_view()
+                                              if args.ingest_workers
+                                              else None),
                                     host=args.metrics_host)
         if msrv is not None:
             print(f"[server] obs: /metrics + /healthz on port "
-                  f"{msrv.port}", flush=True)
+                  f"{msrv.port}"
+                  + (" (merged across ingest workers)"
+                     if args.ingest_workers else ""), flush=True)
         clean_exit = False
         try:
             # failure_context dumps the flight ring before re-raising —
@@ -928,13 +945,20 @@ def main(argv=None) -> int:
                 server.run()
             clean_exit = True
         finally:
-            if args.flight_out and clean_exit:
-                # on failure the failure_context dump IS the artifact —
-                # re-dumping here would relabel the crash post-mortem
-                # as a normal end of run
-                obs_flight.dump(reason="end of run")
-            if args.trace_out:
-                obs_trace.dump()
+            if args.ingest_workers:
+                # the sharded root writes the MERGED artifacts at the
+                # bare paths itself (ShardedIngestServer.dump_obs,
+                # idempotent) — the per-process dumps below would
+                # clobber them with root-only views
+                pass
+            else:
+                if args.flight_out and clean_exit:
+                    # on failure the failure_context dump IS the
+                    # artifact — re-dumping here would relabel the
+                    # crash post-mortem as a normal end of run
+                    obs_flight.dump(reason="end of run")
+                if args.trace_out:
+                    obs_trace.dump()
             if msrv is not None:
                 msrv.close()
         if broker is not None:

@@ -16,6 +16,14 @@ Three host-side instruments, one import surface:
   audit failure (the chaos post-mortem).
 - :mod:`obs.http` — a stdlib-only ``/metrics`` + ``/healthz`` endpoint
   (``--metrics_port``).
+- :mod:`obs.fanin` — federation-wide fan-in (ISSUE 13): worker
+  processes ship registry snapshots, span chunks and flight events
+  over the ingest pipes; the root merges them into ONE worker-labeled
+  Prometheus exposition (with staleness gauges), ONE clock-aligned
+  Chrome trace, and ONE flight dump with per-worker provenance. The
+  wire trace context (``trace.make_trace_ctx`` riding
+  ``ARG_TRACE_CTX``) links one upload's client->worker->root lifecycle
+  as Perfetto flow events.
 
 THE HOST-BOUNDARY RULE: none of this may run inside a jitted/vmapped/
 shard_mapped body. Clocks (``time.monotonic``/``perf_counter``) and
@@ -33,7 +41,7 @@ bounded deque append, and the registry can be disarmed wholesale
 (bench.py ``obs_overhead`` cell).
 """
 
-from neuroimagedisttraining_tpu.obs import flight, metrics, trace  # noqa: F401
+from neuroimagedisttraining_tpu.obs import fanin, flight, metrics, trace  # noqa: F401
 from neuroimagedisttraining_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
 from neuroimagedisttraining_tpu.obs.metrics import (  # noqa: F401
     REGISTRY,
@@ -49,6 +57,7 @@ __all__ = [
     "TRACER",
     "SpanTracer",
     "span",
+    "fanin",
     "flight",
     "metrics",
     "trace",

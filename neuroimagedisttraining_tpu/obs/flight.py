@@ -49,6 +49,7 @@ class FlightRecorder:
         self._ring: collections.deque = collections.deque(
             maxlen=int(capacity))
         self._dropped = 0  # events the ring evicted (bounded-ring honesty)
+        self._seq = 0  # monotone per-event id (cross-process shipping)
         self._path = path
 
     def configure(self, capacity: int | None = None,
@@ -72,6 +73,13 @@ class FlightRecorder:
     def capacity(self) -> int:
         return self._ring.maxlen or 0
 
+    @property
+    def evicted(self) -> int:
+        """Events the bounded ring has dropped (the honesty counter a
+        merged dump must carry forward — obs/fanin.py)."""
+        with self._lock:
+            return self._dropped
+
     def record(self, kind: str, **fields: Any) -> None:
         """Append one event. ``fields`` must be JSON-serializable
         scalars/lists (the callers only pass ids, counts, reasons)."""
@@ -80,16 +88,29 @@ class FlightRecorder:
         with self._lock:
             if len(self._ring) == self._ring.maxlen:
                 self._dropped += 1
+            self._seq += 1
+            ev["seq"] = self._seq
             self._ring.append(ev)
 
     def events(self) -> list[dict]:
         with self._lock:
             return list(self._ring)
 
+    def events_from(self, after_seq: int) -> tuple[list[dict], int]:
+        """Incremental read for periodic shipping (obs/fanin.py): ring
+        events with ``seq > after_seq`` plus the new watermark. Events
+        the bounded ring already evicted between reads are gone — the
+        same honesty contract as the ring itself (``evicted`` counts
+        them in the dump)."""
+        with self._lock:
+            evs = [e for e in self._ring if e["seq"] > int(after_seq)]
+            return evs, self._seq
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self._dropped = 0
+            self._seq = 0
 
     def dump(self, path: str | None = None, *,
              reason: str = "") -> str | None:

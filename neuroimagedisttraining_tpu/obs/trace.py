@@ -37,8 +37,37 @@ import threading
 import time
 from typing import Any
 
-__all__ = ["SpanTracer", "TRACER", "span", "instant", "arm", "disarm",
-           "dump"]
+__all__ = ["SpanTracer", "TRACER", "span", "instant", "flow", "arm",
+           "disarm", "dump", "make_trace_ctx", "flow_id_of"]
+
+#: flow-event phases (Chrome trace-event format): start / step / end —
+#: Perfetto draws an arrow chain through the slices that enclose them
+FLOW_PHASES = ("s", "t", "f")
+
+
+def make_trace_ctx(rank: int, seq: int) -> dict:
+    """Wire trace context (ISSUE 13): the Dapper lesson is that per-hop
+    telemetry without PROPAGATED context cannot answer "where did this
+    upload's latency go" — so the client stamps one of these on every
+    upload frame (``distributed.message.ARG_TRACE_CTX``) and every hop
+    (worker admission, root merge/aggregate) emits a flow event carrying
+    the same id, turning one upload into a causally-linked Perfetto
+    track. ``trace_id`` is unique per (sender, upload); ``span_id``
+    names the sender's originating span."""
+    return {"trace_id": (int(rank) << 24) | (int(seq) & 0xFFFFFF),
+            "span_id": int(rank)}
+
+
+def flow_id_of(ctx) -> int | None:
+    """The Perfetto flow id of a wire trace context; None for a missing
+    or malformed context (a version-skewed client must never crash a
+    telemetry path)."""
+    if not isinstance(ctx, dict):
+        return None
+    tid = ctx.get("trace_id")
+    if isinstance(tid, bool) or not hasattr(tid, "__index__"):
+        return None  # ints only (msgpack may hand back numpy scalars)
+    return int(tid)
 
 
 class _NullSpan:
@@ -125,6 +154,13 @@ class SpanTracer:
     def armed(self) -> bool:
         return self._armed
 
+    @property
+    def epoch_ns(self) -> int:
+        """The ``perf_counter_ns`` instant event timestamps are relative
+        to — the rebase anchor the cross-process merge
+        (``obs/fanin.py``) aligns worker timelines with."""
+        return self._epoch_ns
+
     def arm(self, path: str | None = None, *, annotate: bool = False,
             tags: dict | None = None,
             max_events: int | None = None) -> None:
@@ -195,11 +231,47 @@ class SpanTracer:
                 return
             self._events.append(ev)
 
+    def flow(self, name: str, flow_id: int, phase: str,
+             **args: Any) -> None:
+        """One flow event (ISSUE 13): ``phase`` is "s" (start), "t"
+        (step) or "f" (end). Perfetto binds each to the "X" slice
+        enclosing its timestamp on that (pid, tid) and draws the arrow
+        chain through slices sharing ``flow_id`` — emit INSIDE a live
+        span. Flow ends carry ``bp: "e"`` (bind to enclosing slice)."""
+        if not self._armed:
+            return
+        if phase not in FLOW_PHASES:
+            raise ValueError(f"flow phase must be one of {FLOW_PHASES}, "
+                             f"got {phase!r}")
+        ts = (time.perf_counter_ns() - self._epoch_ns) / 1e3
+        ev = {"name": name, "ph": phase, "cat": "flow",
+              "id": int(flow_id), "ts": ts, "pid": os.getpid(),
+              "tid": threading.get_ident(),
+              "args": {**self._tags, **args}}
+        if phase == "f":
+            ev["bp"] = "e"
+        with self._lock:
+            if not self._armed:
+                return
+            if len(self._events) >= self._max_events:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
     # ---- output ----
 
     def events(self) -> list[dict]:
         with self._lock:
             return list(self._events)
+
+    def events_from(self, start: int) -> tuple[list[dict], int]:
+        """Incremental read for periodic shipping (obs/fanin.py):
+        events recorded since index ``start`` plus the new watermark.
+        ``arm()`` clears the buffer, so shippers must reset their
+        watermark when they re-arm."""
+        with self._lock:
+            evs = list(self._events[start:])
+            return evs, start + len(evs)
 
     def dump(self, path: str | None = None) -> str | None:
         """Write the Chrome trace JSON; returns the path written (None
@@ -238,6 +310,7 @@ TRACER = SpanTracer()
 #: ``with trace.span("eval", round=r): ...``)
 span = TRACER.span
 instant = TRACER.instant
+flow = TRACER.flow
 arm = TRACER.arm
 disarm = TRACER.disarm
 dump = TRACER.dump

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Produce fresh bench cells, then gate them against the committed
+``bench_matrix/`` artifacts (ISSUE 13).
+
+The thin driver around ``analysis/bench_gate.py`` for the common
+session shape: regenerate the cheap cells you touched, diff them
+against the committed matrix, get one machine-readable verdict.
+
+    scripts/bench_diff.py --produce ingest       # ~2-3 min on this box
+    scripts/bench_diff.py                        # pure diff of --fresh
+    scripts/bench_diff.py --fresh /tmp/mybench --strict
+
+``--produce ingest`` reruns the ingest-plane loadgen cells (the
+single-process async baseline + the 2-worker sharded cell) at the
+committed cohort AND window shape (1000 clients, buffer_k 50, 300
+aggregations — run_ingest_bench.sh's own warning applies: a short
+window is dominated by the 1k-client connection ramp and makes the
+sustained number incomparably low), writes a fresh
+``ingest_bench.json`` into ``--fresh`` and gates it: throughput cells
+judged at the gate's drift-tolerant ratio thresholds, audits exactly.
+Cells not regenerated (w1/w4) skip — that is the gate's contract, not
+a failure.
+
+Exit code: 0 green, 1 red, 2 usage error (bench_gate convention).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from neuroimagedisttraining_tpu.analysis import bench_gate  # noqa: E402
+
+
+def produce_ingest(fresh_dir: str, clients: int, aggregations: int,
+                   buffer_k: int, fleet_procs: int) -> str:
+    """Regenerate the ingest-plane cells loadgen-style: the committed
+    artifact's cohort/buffer shape, fewer aggregations (the sustained
+    window still dominates the connection ramp)."""
+    from neuroimagedisttraining_tpu.asyncfl.loadgen import run_load
+
+    common = dict(num_clients=clients, aggregations=aggregations,
+                  buffer_k=buffer_k, leaf_elems=256,
+                  fleet_procs=fleet_procs)
+    cells = {"async": run_load(mode="async", **common)}
+    print(json.dumps({"cell": "async",
+                      "uploads_per_s_sustained":
+                          cells["async"]["uploads_per_s_sustained"]}),
+          flush=True)
+    cells["ingest_w2"] = run_load(mode="ingest", ingest_workers=2,
+                                  **common)
+    print(json.dumps({"cell": "ingest_w2",
+                      "uploads_per_s_sustained":
+                          cells["ingest_w2"]["uploads_per_s_sustained"]}),
+          flush=True)
+    out = {
+        "bench": "ingest_plane",
+        **cells,
+        "summary": {
+            "audits_green": all(
+                c["upload_audit"]["received_accounted"]
+                and c["upload_audit"]["accepted_accounted"]
+                for c in cells.values()),
+            "produced_by": "scripts/bench_diff.py --produce ingest",
+            "aggregations": aggregations,
+        },
+    }
+    os.makedirs(fresh_dir, exist_ok=True)
+    path = os.path.join(fresh_dir, "ingest_bench.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True, default=str)
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="scripts/bench_diff.py",
+        description=__doc__.split("\n\n")[0])
+    ap.add_argument("--fresh", type=str, default="/tmp/nidt_bench_fresh")
+    ap.add_argument("--committed", type=str,
+                    default=bench_gate.DEFAULT_COMMITTED)
+    ap.add_argument("--produce", choices=("none", "ingest"),
+                    default="none",
+                    help="regenerate these cells into --fresh before "
+                         "gating (ingest = async baseline + w2 sharded "
+                         "cell via asyncfl/loadgen.py)")
+    ap.add_argument("--clients", type=int, default=1000,
+                    help="--produce ingest cohort (default matches the "
+                         "committed artifact)")
+    ap.add_argument("--aggregations", type=int, default=300,
+                    help="keep the committed window: short cells are "
+                         "ramp-dominated and gate red spuriously")
+    ap.add_argument("--buffer_k", type=int, default=50)
+    ap.add_argument("--fleet_procs", type=int, default=3)
+    ap.add_argument("--artifact", action="append", default=None)
+    ap.add_argument("--strict", action="store_true")
+    ap.add_argument("--json", type=str, default="")
+    args = ap.parse_args(argv)
+
+    artifacts = args.artifact
+    if args.produce == "ingest":
+        path = produce_ingest(args.fresh, args.clients,
+                              args.aggregations, args.buffer_k,
+                              args.fleet_procs)
+        print(f"[bench_diff] fresh cell -> {path}", flush=True)
+        if artifacts is None:
+            # gate what was produced; other artifacts have no fresh
+            # copy and would all read as skips anyway
+            artifacts = ["ingest_bench.json"]
+    try:
+        res = bench_gate.gate(args.fresh, committed_dir=args.committed,
+                              artifacts=artifacts, strict=args.strict)
+    except ValueError as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1, sort_keys=True)
+    print(json.dumps(res, indent=1, default=str))
+    return 0 if res["verdict"] != "red" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -271,12 +271,39 @@ import free_port_block; print(free_port_block(16))")
                   --base_port "$port" --force_cpu
                   --async_server --buffer_k 3 --max_staleness 8
                   --ingest_workers 2)
+    local metrics_port=$((port + 8))
+    local scrape_out="/tmp/chaos_smoke_ingest_metrics.txt"
+    rm -f "$scrape_out"
     echo "== chaos smoke (sharded ingest cell, port $port): real" \
-         "federation on 2 SO_REUSEPORT workers + merging root =="
+         "federation on 2 SO_REUSEPORT workers + merging root," \
+         "MERGED /metrics on $metrics_port =="
     local out="/tmp/chaos_smoke_ingest.log"
     $PY -m neuroimagedisttraining_tpu.distributed.run \
-        --role server "${common[@]}" > "$out" 2>&1 &
+        --role server "${common[@]}" \
+        --metrics_port "$metrics_port" > "$out" 2>&1 &
     local server_pid=$!
+    # obs fan-in cell (ISSUE 13): the scrape must be the MERGED
+    # exposition — worker-labeled samples from BOTH worker registries
+    # plus the snapshot-staleness gauges — captured MID-chaos
+    $PY - "$metrics_port" "$scrape_out" <<'PYEOF' &
+import sys, time, urllib.request
+port, out = int(sys.argv[1]), sys.argv[2]
+deadline = time.time() + 240
+while time.time() < deadline:
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2).read().decode()
+        if ('worker="0"' in body and 'worker="1"' in body
+                and "nidt_obs_worker_snapshot_age_s" in body
+                and "nidt_upload_stage_ms_bucket" in body):
+            open(out, "w").write(body)
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit(1)
+PYEOF
+    local scraper_pid=$!
     local pids=()
     for r in $(seq 1 "$CLIENTS"); do
         $PY -m neuroimagedisttraining_tpu.distributed.run \
@@ -286,14 +313,20 @@ import free_port_block; print(free_port_block(16))")
     done
     if ! wait "$server_pid"; then
         echo "FAIL(ingest): server exited non-zero"
+        kill "$scraper_pid" 2>/dev/null
         cat "$out"; return 1
     fi
     for p in "${pids[@]}"; do wait "$p" 2>/dev/null || true; done
+    if ! wait "$scraper_pid"; then
+        echo "FAIL(ingest/obs): mid-chaos MERGED /metrics scrape never "\
+"saw worker-labeled samples from both workers + staleness gauges"
+        return 1
+    fi
     local json
     json=$(grep -a -o '^{.*}' "$out" | tail -1)
     echo "$json"
-    $PY - "$json" <<EOF
-import json, math, sys
+    $PY - "$json" "$scrape_out" <<EOF
+import json, math, re, sys
 res = json.loads(sys.argv[1])
 assert res.get("ingest_workers") == 2, res
 assert res["rounds_completed"] == $ROUNDS, res
@@ -303,9 +336,22 @@ assert audit["accepted_accounted"], audit
 assert audit["lost_with_worker"] == 0, audit
 assert math.isfinite(res["final_param_norm"]), res
 assert res["frames_recv"] > 0, res
+# obs fan-in (ISSUE 13): the mid-chaos scrape is valid Prometheus text
+# carrying BOTH workers' registries (worker label) + staleness gauges +
+# the upload-stage histogram
+sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+                    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+scrape = open(sys.argv[2]).read()
+for line in scrape.strip().splitlines():
+    assert line.startswith("#") or sample.match(line), line
+workers = sorted(set(re.findall(r'worker="(\d+)"', scrape)))
+assert workers == ["0", "1"], workers
+assert "nidt_obs_worker_snapshot_age_s" in scrape
+assert "nidt_upload_stage_ms_bucket" in scrape
 print(f"OK(ingest/federation): {res['rounds_completed']} aggregations "
       f"over 2 workers, audits green, |params|="
-      f"{res['final_param_norm']:.3f}")
+      f"{res['final_param_norm']:.3f}; obs: MERGED /metrics scraped "
+      f"mid-chaos ({len(scrape.splitlines())} lines, workers {workers})")
 EOF
     local irc=$?
     [ $irc -ne 0 ] && return $irc

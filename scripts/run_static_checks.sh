@@ -36,6 +36,12 @@ fi
 # no clock reads (time.time/monotonic/perf_counter) and no metrics-
 # registry/flight/span mutation lexically inside functions handed to
 # jit/vmap/shard_map/lax combinators — telemetry at host boundaries only
+# the ISSUE 13 fan-in extensions ride along: obs-trace-ctx-key (the
+# wire trace context is spelled ONLY via ARG_TRACE_CTX — an ad-hoc
+# 'trace_ctx' string literal silently unlinks the flow chain) and
+# obs-pipe-per-upload (asyncfl/ingest.py telemetry crosses the
+# worker->root pipe batched: 'vb'/'beats'/'obs', never per-upload
+# 'v'/'beat' events — one pipe send is ~0.5-1 ms on sandboxed kernels)
 # the precision-discipline family (ISSUE 10) also rides the trace-safety
 # resolver: no bare float32 upcasts (.astype(jnp.float32) /
 # jnp.asarray(x, jnp.float32) / jnp.float32(x)) inside traced train-step
@@ -48,7 +54,7 @@ fi
 # engines/program.py, and *_fallback_key overrides must name keys from
 # the builder's REASONS table (the structured nidt_fallback_total
 # counter's single source of truth)
-echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline / precision-discipline / round-program-discipline) =="
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline incl. obs-trace-ctx-key + obs-pipe-per-upload / precision-discipline / round-program-discipline) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
 

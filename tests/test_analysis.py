@@ -282,7 +282,9 @@ def test_lock_and_async_rules_cover_ingest_module():
             def reply(self, conn, verdict):
                 conn.send(("v", verdict))
         """, path=ingest)
-    assert rules_of(fs) == ["lock-send"]
+    # the unlocked send fires lock-send; since ISSUE 13 the per-upload
+    # ("v", ...) spelling ALSO fires the batching rule — both real
+    assert rules_of(fs) == ["lock-send", "obs-pipe-per-upload"]
     fs = lint("""
         import time
 
@@ -948,6 +950,77 @@ def test_obs_indexed_set_and_host_mutation_pass():
             obs_metrics.gauge("g").set(2)
         """, rules=["obs-metrics-in-trace"])
     assert fs == []
+
+
+# ---------------- obs fan-in discipline (ISSUE 13) ----------------
+
+_INGEST_PATH = "neuroimagedisttraining_tpu/asyncfl/ingest.py"
+_MESSAGE_PATH = "neuroimagedisttraining_tpu/distributed/message.py"
+
+
+def test_trace_ctx_literal_in_add_get_flagged():
+    fs = lint("""
+        def stamp(msg, ctx):
+            msg.add("trace_ctx", ctx)
+
+        def read(msg):
+            return msg.get("trace_ctx")
+        """, rules=["obs-trace-ctx-key"])
+    assert rules_of(fs) == ["obs-trace-ctx-key", "obs-trace-ctx-key"]
+    assert "ARG_TRACE_CTX" in fs[0].message
+
+
+def test_trace_ctx_constant_spelling_and_definition_site_pass():
+    # spelled through the constant: clean
+    fs = lint("""
+        from neuroimagedisttraining_tpu.distributed import message as M
+
+        def stamp(msg, ctx):
+            msg.add(M.ARG_TRACE_CTX, ctx)
+            other = msg.get("round_idx")
+        """, rules=["obs-trace-ctx-key"])
+    assert fs == []
+    # the definition site itself may spell the literal
+    fs = lint("""
+        ARG_TRACE_CTX = "trace_ctx"
+
+        def demo(msg):
+            return msg.get("trace_ctx")
+        """, path=_MESSAGE_PATH, rules=["obs-trace-ctx-key"])
+    assert fs == []
+
+
+def test_unbatched_pipe_send_in_ingest_flagged():
+    fs = lint("""
+        class W:
+            def receive_message(self, msg):
+                self.conn.send(("beat", self.wid, msg.sender_id))
+
+            def per_upload(self, verdict):
+                self.conn.send(("v", self.wid, verdict))
+        """, path=_INGEST_PATH, rules=["obs-pipe-per-upload"])
+    assert rules_of(fs) == ["obs-pipe-per-upload",
+                            "obs-pipe-per-upload"]
+    assert "batch" in fs[0].message
+
+
+def test_batched_pipe_sends_and_other_modules_pass():
+    src = """
+        class W:
+            def _flush_locked(self):
+                self.conn.send(("beats", self.wid, sorted(self.pend)))
+                self.conn.send(("vb", self.wid, self.counts, self.taus))
+                self.conn.send(("obs", self.wid, payload))
+                self.conn.send(("reg", self.wid, c))
+        """
+    assert lint(src, path=_INGEST_PATH,
+                rules=["obs-pipe-per-upload"]) == []
+    # the rule is scoped to asyncfl/ingest.py — a ("beat", ...) tuple
+    # elsewhere (e.g. a test fixture) is not its business
+    assert lint("""
+        def elsewhere(conn):
+            conn.send(("beat", 0, 1))
+        """, rules=["obs-pipe-per-upload"]) == []
 
 
 # ---------------- precision-discipline (ISSUE 10) ----------------

@@ -1,0 +1,189 @@
+"""Bench regression gate (ISSUE 13, analysis/bench_gate.py).
+
+Covers the dotted-path extractor, every judgment kind (true /
+ratio_min / ratio_max / abs_max / eq), the skip-vs-fail contract for
+missing artifacts/paths (and --strict), the verdict aggregation +
+exit codes, the self-diff canary against the COMMITTED bench_matrix/
+(spec paths must keep matching the artifacts — schema drift fails
+here, not silently), and the bench_diff wrapper's artifact shaping.
+"""
+
+import json
+import os
+
+import pytest
+
+from neuroimagedisttraining_tpu.analysis import bench_gate
+from neuroimagedisttraining_tpu.analysis.bench_gate import (
+    Check,
+    extract,
+    gate,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(d, name, doc):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, name), "w") as f:
+        json.dump(doc, f)
+
+
+# ------------------------------------------------ extractor / judge
+
+
+def test_extract_dotted_paths():
+    doc = {"a": {"b": {"c": 3}}, "top": True}
+    assert extract(doc, "a.b.c") == 3
+    assert extract(doc, "top") is True
+    assert extract(doc, "a.missing") is None
+    assert extract(doc, "a.b.c.too_deep") is None
+
+
+def test_judge_kinds():
+    j = bench_gate._judge
+    assert j(Check("p", "true"), True, None)[0]
+    assert not j(Check("p", "true"), False, None)[0]
+    assert j(Check("p", "ratio_min", 0.5), 60.0, 100.0)[0]
+    assert not j(Check("p", "ratio_min", 0.5), 40.0, 100.0)[0]
+    assert j(Check("p", "ratio_max", 2.0), 150.0, 100.0)[0]
+    assert not j(Check("p", "ratio_max", 2.0), 250.0, 100.0)[0]
+    assert j(Check("p", "abs_max", 0.02), 0.01, None)[0]
+    assert not j(Check("p", "abs_max", 0.02), 0.05, None)[0]
+    assert j(Check("p", "eq"), 2.67, 2.67)[0]
+    assert not j(Check("p", "eq"), 2.67, 1.0)[0]
+    # malformed values fail with a reason, never raise
+    ok, detail = j(Check("p", "ratio_min", 0.5), "junk", 100.0)
+    assert not ok and "non-numeric" in detail
+    ok, detail = j(Check("p", "ratio_min", 0.5), 10.0, 0.0)
+    assert not ok and "ratio undefined" in detail
+
+
+# ------------------------------------------------ gate semantics
+
+
+@pytest.fixture()
+def spec_sandbox(monkeypatch):
+    monkeypatch.setattr(bench_gate, "SPECS", {
+        "cell.json": (
+            Check("speed", "ratio_min", 0.5),
+            Check("audits", "true"),
+            Check("optional.deep", "ratio_max", 2.0),
+        ),
+    })
+
+
+def test_gate_green_red_and_skips(tmp_path, spec_sandbox):
+    committed = str(tmp_path / "committed")
+    fresh = str(tmp_path / "fresh")
+    _write(committed, "cell.json",
+           {"speed": 100.0, "audits": True, "optional": {"deep": 1.0}})
+    _write(fresh, "cell.json", {"speed": 80.0, "audits": True})
+    res = gate(fresh, committed_dir=committed)
+    assert res["verdict"] == "green"
+    assert res["checked"] == 2  # optional.deep missing in fresh ->
+    assert res["skipped"] == 1  # skipped, not red
+    assert not res["self_diff"]
+    # strict upgrades the skip to a failure
+    assert gate(fresh, committed_dir=committed,
+                strict=True)["verdict"] == "red"
+    # a regressed cell goes red
+    _write(fresh, "cell.json", {"speed": 20.0, "audits": True})
+    res = gate(fresh, committed_dir=committed)
+    assert res["verdict"] == "red"
+    bad = next(c for c in res["cells"] if not c["ok"])
+    assert bad["path"] == "speed" and "0.200" in bad["detail"]
+
+
+def test_gate_missing_artifacts_skip(tmp_path, spec_sandbox):
+    committed = str(tmp_path / "committed")
+    fresh = str(tmp_path / "fresh")
+    _write(committed, "cell.json", {"speed": 100.0, "audits": True})
+    os.makedirs(fresh)
+    res = gate(fresh, committed_dir=committed)
+    assert res["verdict"] == "empty" and res["skipped"] == 1
+    assert res["skips"][0]["reason"] == "no fresh artifact"
+    # and the reverse: fresh exists, committed missing
+    _write(fresh, "cell.json", {"speed": 100.0, "audits": True})
+    res = gate(fresh, committed_dir=str(tmp_path / "nowhere"))
+    assert res["verdict"] == "empty"
+    assert res["skips"][0]["reason"] == "no committed artifact"
+
+
+def test_gate_unknown_artifact_raises(tmp_path):
+    with pytest.raises(ValueError, match="unknown artifacts"):
+        gate(str(tmp_path), artifacts=["nope.json"])
+
+
+def test_main_exit_codes(tmp_path, spec_sandbox, capsys):
+    committed = str(tmp_path / "committed")
+    fresh = str(tmp_path / "fresh")
+    _write(committed, "cell.json", {"speed": 100.0, "audits": True})
+    _write(fresh, "cell.json", {"speed": 90.0, "audits": True})
+    out_json = str(tmp_path / "verdict.json")
+    rc = bench_gate.main(["--fresh", fresh, "--committed", committed,
+                          "--json", out_json, "--quiet"])
+    assert rc == 0
+    assert json.load(open(out_json))["verdict"] == "green"
+    assert json.loads(capsys.readouterr().out)["verdict"] == "green"
+    _write(fresh, "cell.json", {"speed": 10.0, "audits": True})
+    assert bench_gate.main(["--fresh", fresh, "--committed", committed,
+                            "--quiet"]) == 1
+    assert bench_gate.main(["--artifact", "nope.json"]) == 2
+
+
+# ------------------------------------------------ committed canary
+
+
+def test_self_diff_of_committed_matrix_is_green():
+    """The spec-path canary the bare CLI runs: every SPECS path must
+    still resolve in the committed artifacts and self-compare green —
+    an artifact schema change must fail HERE, not silently skip
+    forever."""
+    res = gate(None, committed_dir=os.path.join(REPO, "bench_matrix"))
+    assert res["self_diff"] is True
+    assert res["verdict"] == "green", [c for c in res["cells"]
+                                       if not c["ok"]]
+    # every artifact named in SPECS is committed and fully resolved
+    assert res["skipped"] == 0, res["skips"]
+    assert res["checked"] == sum(len(v) for v in
+                                 bench_gate.SPECS.values())
+
+
+# ------------------------------------------------ bench_diff wrapper
+
+
+def test_bench_diff_gates_produced_artifact(tmp_path, monkeypatch):
+    """bench_diff with a pre-produced fresh dir (no --produce): the
+    wrapper must route through the same gate and exit green/red on the
+    same thresholds."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO, "scripts", "bench_diff.py"))
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+    committed = json.load(
+        open(os.path.join(REPO, "bench_matrix", "ingest_bench.json")))
+    fresh_doc = {
+        "bench": "ingest_plane",
+        "async": {"uploads_per_s_sustained":
+                  committed["async"]["uploads_per_s_sustained"]},
+        "ingest_w2": {"uploads_per_s_sustained":
+                      committed["ingest_w2"]["uploads_per_s_sustained"]},
+        "summary": {"audits_green": True},
+    }
+    fresh = str(tmp_path / "fresh")
+    _write(fresh, "ingest_bench.json", fresh_doc)
+    rc = bd.main(["--fresh", fresh,
+                  "--committed", os.path.join(REPO, "bench_matrix"),
+                  "--artifact", "ingest_bench.json"])
+    assert rc == 0
+    # halve the sharded throughput past the 0.5 tripwire -> red
+    fresh_doc["ingest_w2"]["uploads_per_s_sustained"] = (
+        0.3 * committed["ingest_w2"]["uploads_per_s_sustained"])
+    _write(fresh, "ingest_bench.json", fresh_doc)
+    rc = bd.main(["--fresh", fresh,
+                  "--committed", os.path.join(REPO, "bench_matrix"),
+                  "--artifact", "ingest_bench.json"])
+    assert rc == 1

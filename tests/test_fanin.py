@@ -1,0 +1,429 @@
+"""Federation-wide telemetry fan-in (ISSUE 13, obs/fanin.py).
+
+Covers the clock-offset handshake estimator (recovery within the
+rtt/2 bound), the per-process artifact path suffixing, the merged
+Prometheus exposition (worker labels, one TYPE block per name,
+cumulative histogram rendering, staleness gauges across a dead
+worker), the merged Chrome trace (clock rebase math, process
+metadata), the merged flight dump (per-worker provenance), the
+incremental shipper, the wire trace context roundtrip (worker-core
+flow step + buffered-server flow end linking to a client flow start),
+and the upload-stage histograms.
+"""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.asyncfl.ingest import (
+    IngestWorkerCore,
+    make_fold_spec,
+)
+from neuroimagedisttraining_tpu.asyncfl.loadgen import canned_update_tree
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.obs import fanin as obs_fanin
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import trace as obs_trace
+from neuroimagedisttraining_tpu.obs.fanin import (
+    TelemetryFanIn,
+    WorkerObsShipper,
+    estimate_clock_offset,
+    linked_flow_ids,
+    suffixed_path,
+)
+from neuroimagedisttraining_tpu.obs.flight import FlightRecorder
+from neuroimagedisttraining_tpu.obs.metrics import MetricsRegistry
+from neuroimagedisttraining_tpu.obs.trace import SpanTracer
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$")
+
+
+# ------------------------------------------------ clock handshake
+
+
+def test_clock_offset_recovered_within_rtt_bound():
+    """Synthetic handshake with a KNOWN worker-vs-root skew: the
+    estimator must land within rtt/2 of the truth for any placement of
+    the worker's reply inside the round trip."""
+    true_offset = 5_000_000  # worker clock runs 5 ms ahead
+    t0 = 1_000_000_000
+    rtt = 2_000_000
+    t1 = t0 + rtt
+    for frac in (0.0, 0.25, 0.5, 0.9, 1.0):
+        # the worker read its clock somewhere inside the round trip
+        t_read_root = t0 + int(frac * rtt)
+        t_worker = t_read_root + true_offset
+        off, err = estimate_clock_offset(t0, t_worker, t1)
+        assert err == rtt // 2
+        assert abs(off - true_offset) <= err, (frac, off)
+
+
+def test_clock_offset_zero_rtt_exact():
+    off, err = estimate_clock_offset(100, 350, 100)
+    assert off == 250 and err == 0
+
+
+# ------------------------------------------------ path suffixing
+
+
+def test_suffixed_path_inserts_before_extension():
+    assert suffixed_path("out/trace.json", 0) == "out/trace.w0.json"
+    assert suffixed_path("flight", 3) == "flight.w3"
+    assert suffixed_path("", 1) == ""
+
+
+# ------------------------------------------------ merged exposition
+
+
+def _worker_payload(wid, extra_metric=None):
+    reg = MetricsRegistry()
+    reg.counter("nidt_w_uploads_total", "uploads",
+                labelnames=("outcome",)).inc(10 + wid, outcome="accepted")
+    reg.histogram("nidt_w_lat_ms", "latency",
+                  buckets=(1.0, 5.0)).observe(2.0)
+    if extra_metric:
+        reg.gauge(extra_metric).set(wid)
+    t = SpanTracer()
+    t.arm(tags={"worker": wid})
+    with t.span("w_span"):
+        pass
+    fl = FlightRecorder(capacity=16)
+    fl.record("dropped_stale", client=1, worker=wid)
+    return WorkerObsShipper(registry=reg, tracer=t,
+                            flight=fl).payload(force=True)
+
+
+def _fanin_with_two_workers():
+    root_reg = MetricsRegistry()
+    root_reg.gauge("nidt_root_round").set(4)
+    root_t = SpanTracer()
+    root_t.arm()
+    with root_t.span("aggregate", version=1):
+        pass
+    root_fl = FlightRecorder(capacity=16)
+    root_fl.record("aggregate", version=1)
+    fi = TelemetryFanIn(registry=root_reg, tracer=root_t,
+                        flight=root_fl)
+    for wid in (0, 1):
+        fi.register_worker(wid)
+        fi.ingest(wid, _worker_payload(wid))
+    return fi
+
+
+def test_merged_exposition_labels_types_and_staleness():
+    fi = _fanin_with_two_workers()
+    fi.mark_dead(1)  # SIGKILL: snapshot stays, staleness reads it
+    text = fi.prometheus_text()
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or _SAMPLE_RE.match(line), line
+    # one TYPE block per metric name — duplicate blocks are invalid
+    # exposition and what a naive per-source concatenation produces
+    types = [ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE")]
+    names = [ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE")]
+    assert len(names) == len(set(names))
+    assert types  # non-empty
+    # BOTH workers' samples, worker-labeled; root sample unlabeled
+    assert re.search(r'nidt_w_uploads_total\{[^}]*worker="0"[^}]*\} 10',
+                     text)
+    assert re.search(r'nidt_w_uploads_total\{[^}]*worker="1"[^}]*\} 11',
+                     text)
+    assert "nidt_root_round 4" in text
+    # histograms render CUMULATIVE with worker labels
+    assert re.search(
+        r'nidt_w_lat_ms_bucket\{[^}]*worker="0"[^}]*le="5"[^}]*\} 1',
+        text) or re.search(
+        r'nidt_w_lat_ms_bucket\{[^}]*le="5"[^}]*worker="0"[^}]*\} 1',
+        text)
+    # staleness plane: ages for both, alive 1/0 across the kill
+    assert re.search(r'nidt_obs_worker_snapshot_age_s\{worker="0"\} ',
+                     text)
+    assert 'nidt_obs_worker_alive{worker="0"} 1' in text
+    assert 'nidt_obs_worker_alive{worker="1"} 0' in text
+    # the dead worker's LAST snapshot is still served
+    assert re.search(r'nidt_w_uploads_total\{[^}]*worker="1"', text)
+
+
+def test_merged_view_serves_over_http():
+    from neuroimagedisttraining_tpu.obs.http import MetricsServer
+    import urllib.request
+
+    fi = _fanin_with_two_workers()
+    srv = MetricsServer(0, registry=fi.metrics_view())
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+        assert 'worker="0"' in body and 'worker="1"' in body
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------ merged trace
+
+
+def test_merged_trace_rebases_worker_timelines():
+    root_t = SpanTracer()
+    root_t.arm()
+    with root_t.span("root_span"):
+        pass
+    fi = TelemetryFanIn(registry=MetricsRegistry(), tracer=root_t,
+                        flight=FlightRecorder())
+    fi.register_worker(0)
+    # synthetic worker: epoch 7 ms after the root's, clock 2 ms ahead
+    root_epoch = root_t.epoch_ns
+    w_epoch = root_epoch + 7_000_000
+    offset = 2_000_000
+    t0 = 10_000
+    fi.note_clock(0, t0, (t0 + t0) // 2 + offset, t0)  # rtt 0 -> exact
+    fi.ingest(0, {
+        "metrics": None, "pid": 4242, "epoch_ns": w_epoch,
+        "spans": [{"name": "w_span", "ph": "X", "ts": 100.0,
+                   "dur": 5.0, "pid": 4242, "tid": 1, "args": {}}],
+        "spans_dropped": 0, "flight": [], "t_wall": 0.0})
+    doc = fi.merged_trace_doc()
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    evs = doc["traceEvents"]
+    w = next(e for e in evs if e["name"] == "w_span")
+    # 100 µs past the worker epoch = root-relative
+    # 100 + (epoch_w - offset - epoch_root)/1e3 = 100 + 7000 - 2000
+    assert w["ts"] == pytest.approx(100.0 + 5000.0)
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert names == {"ingest-root", "ingest-worker-0"}
+    assert any(e["name"] == "root_span" for e in evs)
+
+
+def test_merged_trace_dump_and_drop_accounting(tmp_path):
+    fi = _fanin_with_two_workers()
+    fi.ingest(0, {"metrics": None, "spans": [], "spans_dropped": 3,
+                  "flight": [], "t_wall": 0.0})
+    out = fi.dump_trace(str(tmp_path / "merged.json"))
+    doc = json.load(open(out))
+    assert doc["nidtDroppedEvents"] == 3
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+
+# ------------------------------------------------ merged flight
+
+
+def test_merged_flight_carries_worker_provenance(tmp_path):
+    fi = _fanin_with_two_workers()
+    doc = fi.merged_flight_doc(reason="test")
+    procs = {e["proc"] for e in doc["events"]}
+    assert procs == {"root", "worker0", "worker1"}
+    w_ev = next(e for e in doc["events"] if e["proc"] == "worker0")
+    assert w_ev["worker"] == 0 and w_ev["kind"] == "dropped_stale"
+    # wall-clock ordered (the cross-process join key)
+    walls = [e.get("t_wall", 0.0) for e in doc["events"]]
+    assert walls == sorted(walls)
+    out = fi.dump_flight(str(tmp_path / "merged_flight.json"),
+                         reason="test")
+    assert json.load(open(out))["workers"]["1"]["alive"] is True
+
+
+# ------------------------------------------------ incremental shipper
+
+
+def test_shipper_ships_only_new_events_and_rate_limits():
+    reg = MetricsRegistry()
+    t = SpanTracer()
+    t.arm()
+    fl = FlightRecorder(capacity=8)
+    sh = WorkerObsShipper(interval_s=3600.0, registry=reg, tracer=t,
+                          flight=fl)
+    with t.span("a"):
+        pass
+    fl.record("x", i=1)
+    p1 = sh.payload(force=True)
+    assert [e["name"] for e in p1["spans"]] == ["a"]
+    assert [e["i"] for e in p1["flight"]] == [1]
+    # nothing new -> empty chunks; rate limit blocks unforced ships
+    assert sh.payload() is None
+    p2 = sh.payload(force=True)
+    assert p2["spans"] == [] and p2["flight"] == []
+    with t.span("b"):
+        pass
+    fl.record("y", i=2)
+    p3 = sh.payload(force=True)
+    assert [e["name"] for e in p3["spans"]] == ["b"]
+    assert [e["i"] for e in p3["flight"]] == [2]
+
+
+# ------------------------------------------------ trace-context flows
+
+
+LIKE = canned_update_tree(0, 64)
+
+
+def _upload_msg(c, seq, ctx=True):
+    msg = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, c, 0)
+    msg.add(M.ARG_MODEL_PARAMS, canned_update_tree(c, 64))
+    msg.add(M.ARG_NUM_SAMPLES, 8.0)
+    msg.add(M.ARG_ROUND_IDX, 0)
+    msg.add(M.ARG_UPLOAD_SEQ, seq)
+    if ctx:
+        msg.add(M.ARG_TRACE_CTX, obs_trace.make_trace_ctx(c, seq))
+    return msg
+
+
+def test_trace_ctx_helpers():
+    ctx = obs_trace.make_trace_ctx(3, 7)
+    assert obs_trace.flow_id_of(ctx) == (3 << 24) | 7
+    assert obs_trace.flow_id_of(None) is None
+    assert obs_trace.flow_id_of({"trace_id": "junk"}) is None
+    assert obs_trace.flow_id_of("nonsense") is None
+
+
+def test_worker_core_emits_flow_step_and_threads_ctx():
+    obs_metrics.reset()
+    obs_trace.arm()
+    try:
+        core = IngestWorkerCore(0, make_fold_spec(LIKE), LIKE,
+                                max_staleness=4, staleness_alpha=0.5)
+        msg = _upload_msg(3, 0)
+        assert core.handle_upload(msg) == "accepted"
+        fid = obs_trace.flow_id_of(msg.get(M.ARG_TRACE_CTX))
+        # ctx rides the entry (element 6) to the root's flow END
+        assert core.entries[-1][6] == fid
+        evs = obs_trace.TRACER.events()
+        steps = [e for e in evs if e.get("ph") == "t"]
+        assert steps and steps[0]["id"] == fid
+        # the step is INSIDE the ingest_upload span (Perfetto binding)
+        slab = next(e for e in evs if e["name"] == "ingest_upload")
+        assert slab["ts"] <= steps[0]["ts"] <= slab["ts"] + slab["dur"]
+        # a ctx-less upload processes identically, just unlinked
+        assert core.handle_upload(_upload_msg(4, 0, ctx=False)) == \
+            "accepted"
+        assert core.entries[-1][6] is None
+    finally:
+        obs_trace.disarm()
+
+
+class _CaptureComm:
+    def __init__(self):
+        self.sent = []
+
+    def send_message(self, msg, **kw):
+        self.sent.append(msg)
+
+    def add_observer(self, obs):
+        pass
+
+    def remove_observer(self, obs):
+        pass
+
+    def handle_receive_message(self):
+        pass
+
+    def stop_receive_message(self):
+        pass
+
+    def byte_stats(self):
+        return {}
+
+
+def test_flow_roundtrip_client_to_aggregate():
+    """The linkage oracle: a client flow start + the server's
+    admission step + the aggregation end share one id — what the
+    merged trace renders as a causally-linked upload."""
+    from neuroimagedisttraining_tpu.asyncfl.server import (
+        BufferedFedAvgServer,
+    )
+
+    obs_metrics.reset()
+    obs_trace.arm()
+    try:
+        srv = BufferedFedAvgServer(canned_update_tree(0, 12), 10, 3,
+                                   buffer_k=2, comm=_CaptureComm())
+
+        def up(c, seq):
+            m = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, c, 0)
+            m.add(M.ARG_MODEL_PARAMS, canned_update_tree(c, 12))
+            m.add(M.ARG_NUM_SAMPLES, 4.0)
+            m.add(M.ARG_ROUND_IDX, 0)
+            m.add(M.ARG_UPLOAD_SEQ, seq)
+            ctx = obs_trace.make_trace_ctx(c, seq)
+            m.add(M.ARG_TRACE_CTX, ctx)
+            with obs_trace.span("client_upload", client=c):
+                obs_trace.flow("upload", obs_trace.flow_id_of(ctx),
+                               "s", client=c)
+            return m
+
+        srv._on_model(up(1, 0))
+        srv._on_model(up(2, 0))
+        assert srv.round_idx == 1
+        flows = linked_flow_ids(obs_trace.TRACER.events())
+        assert len(flows["linked"]) == 2
+        ends = [e for e in obs_trace.TRACER.events()
+                if e.get("ph") == "f"]
+        assert all(e["bp"] == "e" for e in ends)
+    finally:
+        obs_trace.disarm()
+
+
+# ------------------------------------------------ stage histograms
+
+
+def test_upload_stage_histograms_observed():
+    import time
+
+    obs_metrics.reset()
+    core = IngestWorkerCore(0, make_fold_spec(LIKE), LIKE,
+                            max_staleness=4, staleness_alpha=0.5)
+    msg = _upload_msg(1, 0)
+    msg.recv_ns = time.perf_counter_ns()  # the loop.py stamp
+    assert core.handle_upload(msg) == "accepted"
+    snap = obs_metrics.snapshot()
+    by_stage = {v["labels"]["stage"]: v["value"]
+                for v in snap["nidt_upload_stage_ms"]["values"]}
+    assert set(by_stage) == {"queue", "decode", "admit", "fold"}
+    for stage, cell in by_stage.items():
+        assert cell["count"] == 1, stage
+    # a gate rejection before decode observes no decode/fold stage
+    stale = _upload_msg(1, 0)  # duplicate seq -> dropped at the gate
+    assert core.handle_upload(stale) == "dropped_duplicate"
+    snap = obs_metrics.snapshot()
+    by_stage = {v["labels"]["stage"]: v["value"]
+                for v in snap["nidt_upload_stage_ms"]["values"]}
+    assert by_stage["admit"]["count"] == 2
+    assert by_stage["decode"]["count"] == 1
+
+
+def test_rtt_histogram_registers_and_observes():
+    obs_metrics.reset()
+    h = obs_fanin.rtt_histogram()
+    h.observe(42.0)
+    snap = obs_metrics.snapshot()
+    cell = snap["nidt_client_rtt_ms"]["values"][0]["value"]
+    assert cell["count"] == 1
+    assert cell["buckets"]["50"] == 1
+
+
+# ------------------------------------------------ flight seq plumbing
+
+
+def test_flight_events_from_watermark():
+    fl = FlightRecorder(capacity=3)
+    for i in range(5):
+        fl.record("e", i=i)
+    evs, mark = fl.events_from(0)
+    # ring evicted 0 and 1 — bounded-ring honesty, not an error
+    assert [e["i"] for e in evs] == [2, 3, 4] and mark == 5
+    evs2, mark2 = fl.events_from(mark)
+    assert evs2 == [] and mark2 == 5
+    fl.record("e", i=5)
+    evs3, _ = fl.events_from(mark)
+    assert [e["i"] for e in evs3] == [5]
+
+
+def test_linked_flow_ids_groups_phases():
+    evs = [{"ph": "s", "id": 1}, {"ph": "t", "id": 1},
+           {"ph": "f", "id": 1}, {"ph": "s", "id": 2},
+           {"ph": "X", "name": "slice"}]
+    flows = linked_flow_ids(evs)
+    assert flows["linked"] == {1}
+    assert flows["s"] == {1, 2}

@@ -322,12 +322,31 @@ def _assert_green(res):
 
 
 @pytest.mark.slow
-def test_ingest_two_workers_end_to_end():
+def test_ingest_two_workers_end_to_end(tmp_path):
+    trace_out = str(tmp_path / "merged_trace.json")
     res = run_load(mode="ingest", num_clients=24, aggregations=5,
-                   buffer_k=8, ingest_workers=2, leaf_elems=64)
+                   buffer_k=8, ingest_workers=2, leaf_elems=64,
+                   trace_out=trace_out,
+                   flight_out=str(tmp_path / "merged_flight.json"))
     _assert_green(res)
     assert res["lost_with_worker"] == 0
     assert res["workers_live_at_end"] == []  # clean shutdown
+    # federation-wide obs (ISSUE 13): BOTH workers shipped registries
+    # (worker-labeled merged /metrics incl. stage + rtt histograms),
+    # and at least one upload's client->worker->root lifecycle is
+    # flow-linked in the MERGED, Perfetto-loadable trace
+    fan = res["obs_fanin"]
+    assert fan["0"]["has_metrics"] and fan["1"]["has_metrics"], fan
+    assert res["merged_metrics"]["worker_labeled"] == [0, 1]
+    assert res["merged_metrics"]["has_stage_samples"]
+    assert res["merged_metrics"]["has_rtt_samples"]
+    assert res["merged_trace"]["flow_linked"] >= 1, res["merged_trace"]
+    import json as _json
+
+    doc = _json.load(open(trace_out))
+    assert doc["traceEvents"], "merged trace dumped at the bare path"
+    fl = _json.load(open(str(tmp_path / "merged_flight.json")))
+    assert any(e["proc"].startswith("worker") for e in fl["events"])
 
 
 @pytest.mark.slow
@@ -348,6 +367,14 @@ def test_ingest_kill_one_worker_audits_green():
     w0 = audit["workers"][0]
     assert w0["acc"] == w0["folded"]
     assert res["client_stats"]["rejoins"] >= 1
+    # fan-in across the kill (ISSUE 13): the dead worker's LAST
+    # snapshot is still served (marked dead) and the survivor's
+    # samples stay worker-labeled — the merged /metrics never loses a
+    # worker silently
+    fan = res["obs_fanin"]
+    assert fan["0"]["alive"] is False
+    assert fan["0"]["has_metrics"], fan  # stale snapshot retained
+    assert 1 in res["merged_metrics"]["worker_labeled"]
 
 
 @pytest.mark.slow
