@@ -298,11 +298,16 @@ def cohort_sharding_cell(n_devices: int) -> dict:
 
 
 def obs_overhead_cell() -> dict:
-    """Obs overhead guard (ISSUE 9): the SAME smoke round loop timed
-    with the telemetry plane disarmed (tracer off, registry disabled)
-    and armed (tracer writing spans, registry enabled, stat_info
-    published per round — a HARSHER cadence than the shipped driver,
-    which publishes at eval boundaries only). Because instrumentation
+    """Obs overhead guard (ISSUE 9, extended by ISSUE 14): the SAME
+    smoke round loop timed with the telemetry plane disarmed (tracer
+    off, registry disabled) and armed (tracer writing spans, registry
+    enabled, stat_info published per round — a HARSHER cadence than the
+    shipped driver, which publishes at eval boundaries only). Since
+    ISSUE 14 every dispatch ALSO feeds the compute-plane profiler
+    (obs/compute.py: two clock reads + a nidt_dispatch_ms observe per
+    dispatch, an MFU boundary close per publish) — the armed leg
+    exercises the full dispatch-boundary instrumentation, so this cell
+    IS the profiler-armed overhead acceptance. Because instrumentation
     sits only at host dispatch boundaries, the per-round cost is a few
     microseconds against a multi-millisecond round — acceptance:
     overhead <= 2% (bench_matrix/obs_overhead.json).
@@ -383,28 +388,42 @@ def obs_overhead_cell() -> dict:
 
     run_rounds(False)  # compile + warm
     legs = {"disarmed": float("inf"), "armed": float("inf")}
+    ratios = []
     # legs INTERLEAVED per repeat: the shared-box load drifts on the
     # seconds scale, and back-to-back leg blocks would alias that drift
-    # into a fake (even negative) "overhead"
+    # into a fake (even negative) "overhead". The estimator is the
+    # MEDIAN of per-repeat armed/disarmed ratios — each repeat's pair
+    # runs temporally adjacent, so low-frequency drift cancels WITHIN
+    # the pair, where a best-of-each-leg quotient compares two
+    # different load windows and can swing past the ±2% bound on a
+    # drifty box (measured: best-of quotients ranged −5.7%..+17.8% on
+    # an idle sandbox while paired medians sit at the noise floor).
     for _ in range(reps):
+        pair = {}
         for name, armed in (("disarmed", False), ("armed", True)):
             set_leg(armed)
             t0 = time.perf_counter()
             run_rounds(armed)
-            legs[name] = min(legs[name], time.perf_counter() - t0)
+            pair[name] = time.perf_counter() - t0
+            legs[name] = min(legs[name], pair[name])
+        ratios.append(pair["armed"] / pair["disarmed"])
     obs_metrics.enable()
     obs_trace.disarm()
-    overhead = legs["armed"] / legs["disarmed"] - 1.0
+    overhead = float(np.median(ratios)) - 1.0
     return {
         "metric": "obs_overhead",
         "model": model_name, "shape": "x".join(map(str, shape)),
         "batch": batch, "clients": n_clients, "rounds_per_leg": rounds,
         "disarmed_s": round(legs["disarmed"], 4),
         "armed_s": round(legs["armed"], 4),
+        "per_rep_ratios": [round(r, 4) for r in ratios],
         "overhead_frac": round(overhead, 4),
         "acceptance": "overhead_frac <= 0.02 (armed = span per round + "
-                      "stat_info publish per round + tracer buffering)",
-        "timing": f"best of {reps} repeats x {rounds} rounds",
+                      "stat_info publish per round + tracer buffering + "
+                      "the ISSUE 14 dispatch profiler: nidt_dispatch_ms "
+                      "observe per dispatch, MFU boundary per publish)",
+        "timing": f"median of {reps} paired-repeat ratios x {rounds} "
+                  "rounds (legs best-of for reference)",
     }
 
 

@@ -329,6 +329,30 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="interface the metrics endpoint binds "
                              "(default all interfaces; pass 127.0.0.1 "
                              "on shared hosts)")
+    parser.add_argument("--profile_session", type=str, default="",
+                        help="run the declarative profile session "
+                             "(obs/probe.py: PROFILE.md's probe "
+                             "checklist as a manifest through the "
+                             "shipped driver with the dispatch-boundary "
+                             "profiler armed) and write the machine-"
+                             "readable artifact here instead of "
+                             "training; PROFILE_MODEL/PROFILE_SHAPE/"
+                             "PROFILE_BATCH env size the cells and "
+                             "--profile_manifest replaces the probe "
+                             "list. scripts/run_profile_session.sh is "
+                             "the push-button wrapper")
+    parser.add_argument("--profile_manifest", type=str, default="",
+                        help="JSON probe manifest for "
+                             "--profile_session (a [{name, cell}] "
+                             "array; default: obs/probe.py's declared "
+                             "list)")
+    parser.add_argument("--peak_flops", type=float, default=0.0,
+                        help="device peak flop/s for the nidt_mfu "
+                             "gauge's denominator (total across local "
+                             "devices); 0 = the obs/compute.py device-"
+                             "kind estimate (NIDT_PEAK_FLOPS env also "
+                             "overrides; unknown backends publish "
+                             "sustained TFLOP/s only)")
     parser.add_argument("--flight_events", type=int, default=256,
                         help="flight-recorder ring capacity "
                              "(obs/flight.py); the ring dumps to "
@@ -665,6 +689,25 @@ def main(argv: list[str] | None = None) -> int:
         )
         provision_virtual_devices(args.virtual_devices)
 
+    if args.profile_session:
+        # push-button profile session (ISSUE 14, obs/probe.py): the
+        # declarative probe manifest through the shipped driver with
+        # the dispatch-boundary profiler armed — replaces PROFILE.md's
+        # hand-run checklist; normal training is skipped
+        import jax
+
+        from neuroimagedisttraining_tpu.obs import compute as obs_compute
+        from neuroimagedisttraining_tpu.obs import probe as obs_probe
+
+        if args.peak_flops > 0:
+            obs_compute.PROFILER.set_peak_flops(args.peak_flops)
+        manifest = (obs_probe.load_manifest(args.profile_manifest)
+                    if args.profile_manifest
+                    else obs_probe.default_manifest(len(jax.devices())))
+        doc = obs_probe.run_session(manifest, args.profile_session,
+                                    trace_out=args.trace_out)
+        return 0 if obs_probe.session_ok(doc) else 1
+
     if args.multihost_coordinator:
         # join the pod-wide JAX runtime BEFORE any backend touch so the
         # mesh below spans every host's chips (SURVEY §2.9 DCN row; see
@@ -729,8 +772,16 @@ def main(argv: list[str] | None = None) -> int:
                       annotate=bool(args.profile_dir),
                       tags={"algorithm": cfg.algorithm,
                             "seed": cfg.seed})
-    msrv = start_metrics_server(cfg.metrics_port,
-                                host=args.metrics_host)
+    # compute-plane gauges (obs/compute.py, ISSUE 14): the dispatch
+    # profiler is always on; --peak_flops arms the MFU denominator and
+    # /healthz carries the compute block (wedged vs slow dispatch)
+    from neuroimagedisttraining_tpu.obs import compute as obs_compute
+
+    if args.peak_flops > 0:
+        obs_compute.PROFILER.set_peak_flops(args.peak_flops)
+    msrv = start_metrics_server(
+        cfg.metrics_port, host=args.metrics_host,
+        health_probe=lambda: {"compute": obs_compute.PROFILER.health()})
     try:
         with failure_context(name=cfg.identity()), \
                 profile_trace(args.profile_dir,

@@ -130,6 +130,49 @@ SPECS: dict[str, tuple[Check, ...]] = {
         Check("parity.bf16_vs_fp32_loss_abs_delta", "abs_max", 2e-3,
               "bf16 loss tolerance pin"),
     ),
+    # profile session (ISSUE 14, obs/probe.py): structural cells exact —
+    # the probe manifest fingerprint, the deterministic dispatch/compile
+    # counts, the live-scrape booleans — and every wall/TFLOPs cell at
+    # the standard drift-tolerant ratio tripwires. The XLA-vs-analytic
+    # FLOPs reconciliation is deterministic on a fixed backend, so its
+    # ratio band is tight (same-box schema canary, not a wall cell).
+    # The eq cells are deterministic AT THE COMMITTED CONFIG (counts
+    # follow PROFILE_ROUNDS, the fingerprint follows devices/manifest):
+    # a config-changing regeneration — the flagship TPU recipe replacing
+    # the CPU smoke baseline — legitimately differs, and
+    # scripts/run_profile_session.sh detects the meta mismatch and
+    # treats the verdict as informational while a SAME-config red
+    # blocks the install (the round_program.json eq cells carry the
+    # same config-pinned contract).
+    "profile_session.json": (
+        Check("session.structural_fingerprint", "eq",
+              note="the declared probe manifest (structural cells)"),
+        Check("session.probes_completed", "eq",
+              note="every declared probe ran (skips are structural)"),
+        Check("session.metrics_scrape_ok", "true",
+              note="live /metrics served nidt_dispatch_ms + "
+                   "nidt_mfu/nidt_sustained_tflops samples"),
+        Check("session.healthz_compute_ok", "true",
+              note="/healthz compute block (dispatch liveness)"),
+        Check("probes.fused_dispatch_k4.dispatches", "eq",
+              note="dispatch counts are deterministic compile facts"),
+        Check("probes.fused_dispatch_k4.compiles", "eq"),
+        Check("probes.fp32_baseline.compiles", "eq"),
+        Check("probes.fp32_baseline.round_ms", "ratio_max", 2.0,
+              "per-round wall tripwire (box drift tolerated)"),
+        Check("probes.bf16.round_ms", "ratio_max", 2.0),
+        Check("probes.fp32_baseline.sustained_tflops", "ratio_min", 0.5,
+              "sustained analytic TFLOP/s over the last boundary "
+              "window (the MFU numerator; a nidt_mfu ratio check "
+              "joins the spec when the first TPU-session artifact — "
+              "where the peak is known — replaces the CPU cell: the "
+              "committed-dir canary requires every spec path to "
+              "resolve, and mfu is null off-chip)"),
+        Check("xla.train_step.parity_ratio", "ratio_min", 0.9,
+              "XLA cost_analysis vs analytic ops/flops.py FLOPs — "
+              "deterministic on a fixed backend"),
+        Check("xla.train_step.parity_ratio", "ratio_max", 1.1),
+    ),
 }
 
 #: default committed-artifact directory (repo-relative)

@@ -19,6 +19,17 @@ jits, functions passed to tracers, lambdas, self-methods, and the
 transitive call closure), so an instrumented helper CALLED from a round
 body is caught just like a decorated one.
 
+ISSUE 14 extension (the dispatch profiler's own discipline):
+
+- ``obs-sync-in-trace``: the compute-plane profiler (obs/compute.py)
+  times dispatches with HOST wall around the enqueue and closes MFU
+  windows at already-synced host boundaries — its contract is ZERO
+  added device syncs. ``jax.block_until_ready(...)`` or
+  ``.block_until_ready()`` inside a traced body is at best a trace-time
+  no-op and at worst the hidden-sync bug class the profiler wiring
+  could smuggle in; ``jax.device_get`` in the same position is already
+  a trace-safety finding, this closes the block_until_ready gap.
+
 Lexical honesty: ``.set(...)`` is NOT flagged — the attribute name is
 too generic (``jnp.ndarray.at[...].set`` is the single most common call
 in the round programs). A gauge set inside a trace is still wrong; it
@@ -71,6 +82,17 @@ CLOCK_DOTTED = {
 #: Histogram.observe); Gauge.set is excluded — see the module docstring
 MUTATION_METHODS = {"inc", "observe"}
 
+#: device-sync spellings a dispatch timer must never smuggle into a
+#: traced body (ISSUE 14, obs-sync-in-trace): ``jax.block_until_ready``
+#: by dotted name plus the zero-arg ``.block_until_ready()`` method.
+#: ``jax.device_get`` is already a trace-safety finding
+#: (trace_safety.HOST_SYNC_DOTTED); this rule closes the
+#: block-until-ready gap the compute profiler's wiring could otherwise
+#: slip through — the profiler's contract is host wall around the
+#: ENQUEUE, never a sync inside the program.
+SYNC_DOTTED = {"jax.block_until_ready"}
+SYNC_METHODS = {"block_until_ready"}
+
 #: any call into the obs package is telemetry (metrics, flight ring,
 #: span tracer) and has no business inside a traced body
 OBS_PREFIX = "neuroimagedisttraining_tpu.obs"
@@ -78,11 +100,15 @@ OBS_PREFIX = "neuroimagedisttraining_tpu.obs"
 
 @register
 class ObsDisciplineRule(Rule):
-    rule_ids = ("obs-clock-in-trace", "obs-metrics-in-trace")
+    rule_ids = ("obs-clock-in-trace", "obs-metrics-in-trace",
+                "obs-sync-in-trace")
     description = (
-        "no wall/monotonic clock reads (obs-clock-in-trace) or metrics-"
-        "registry/flight/span mutation (obs-metrics-in-trace) lexically "
-        "inside functions handed to jit/vmap/shard_map/lax combinators")
+        "no wall/monotonic clock reads (obs-clock-in-trace), metrics-"
+        "registry/flight/span mutation (obs-metrics-in-trace), or "
+        "device syncs — jax.block_until_ready / .block_until_ready() "
+        "(obs-sync-in-trace: dispatch timers live at host boundaries "
+        "only) — lexically inside functions handed to "
+        "jit/vmap/shard_map/lax combinators")
 
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
         seen: set[int] = set()
@@ -102,6 +128,17 @@ class ObsDisciplineRule(Rule):
                 f"{name} inside a traced function bakes ONE trace-time "
                 "clock value into the compiled executable — time at "
                 "host boundaries only (obs/trace.py)")
+            return
+        if name in SYNC_DOTTED or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_METHODS and not node.args):
+            yield Finding(
+                mod.path, node.lineno, "obs-sync-in-trace",
+                "device sync (block_until_ready) inside a traced "
+                "function: at best a trace-time no-op, and exactly the "
+                "hidden-sync class of bug the dispatch profiler's "
+                "zero-sync contract forbids (obs/compute.py) — sync "
+                "and time at host boundaries only")
             return
         if name is not None and (name == OBS_PREFIX
                                  or name.startswith(OBS_PREFIX + ".")):
@@ -177,5 +214,5 @@ class ObsFanInRule(Rule):
 
 #: the analysis package imports this module for registration
 __all__ = ["ObsDisciplineRule", "ObsFanInRule", "CLOCK_DOTTED",
-           "MUTATION_METHODS", "TRACE_CTX_LITERAL",
-           "UNBATCHED_PIPE_KINDS"]
+           "MUTATION_METHODS", "SYNC_DOTTED", "SYNC_METHODS",
+           "TRACE_CTX_LITERAL", "UNBATCHED_PIPE_KINDS"]

@@ -289,6 +289,31 @@ class LocalTrainer:
         denom = jnp.maximum(epochs * my_steps, 1)
         return cs, jnp.sum(losses) / denom
 
+    def lower_train_step(self, input_shape: tuple[int, ...],
+                         batch_size: int):
+        """AOT-lower ONE training step (``loss_and_grad``: forward +
+        backward + BN update) at fully ABSTRACT shapes — params come
+        from an ``eval_shape`` of the model init, the batch is a
+        ``ShapeDtypeStruct``, so nothing is materialized, compiled or
+        executed even at the flagship 121x145x121 volume on the CPU
+        harness. The returned ``jax.stages.Lowered`` is the XLA
+        accounting surface: ``cost_analysis()`` reads FLOPs off the
+        unoptimized HLO, ``.compile().memory_analysis()`` adds the
+        temp/argument byte accounting (obs/compute.analyze_train_step
+        reconciles both against the analytic ops/flops.py counter)."""
+        x1 = jax.ShapeDtypeStruct((1, *input_shape), jnp.float32)
+        cs = jax.eval_shape(self.init_client_state, jax.random.key(0),
+                            x1)
+        xs = jax.ShapeDtypeStruct((batch_size, *input_shape),
+                                  jnp.float32)
+        ys = jax.ShapeDtypeStruct((batch_size,), jnp.int32)
+
+        def step(cs, x, y):
+            loss, grads, bstats, _ = self.loss_and_grad(cs, x, y)
+            return loss, grads, bstats
+
+        return jax.jit(step).lower(cs, xs, ys)
+
     def eval_grad(self, params: PyTree, batch_stats: PyTree, x, y) -> PyTree:
         """One-batch DENSE gradient probe in eval mode (no dropout, BN in
         inference mode) — DisPFL's ``screen_gradients``

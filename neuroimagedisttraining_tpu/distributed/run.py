@@ -552,6 +552,14 @@ def main(argv=None) -> int:
                          "(default all interfaces, the Prometheus-"
                          "exporter convention; pass 127.0.0.1 on "
                          "shared hosts)")
+    ap.add_argument("--peak_flops", type=float, default=0.0,
+                    help="device peak flop/s for the nidt_mfu gauge's "
+                         "denominator on SILO ranks (obs/compute.py; "
+                         "0 = device-kind estimate / NIDT_PEAK_FLOPS). "
+                         "The server rank's /healthz carries the "
+                         "compute block either way — a wedged-dispatch "
+                         "silo federation is distinguishable from a "
+                         "slow one at the liveness probe")
     ap.add_argument("--trace_out", type=str, default="",
                     help="write this process's host-span timeline as "
                          "Chrome trace-event JSON (obs/trace.py, "
@@ -578,6 +586,13 @@ def main(argv=None) -> int:
                          "(cohort sharding lives in the simulated "
                          "engines, parallel/cohort.py)")
     args = ap.parse_args(argv)
+    if args.peak_flops > 0:
+        # arm the MFU denominator on every rank (silo ranks dispatch
+        # the training programs; the server rank's /healthz compute
+        # block reports its own dispatch liveness either way)
+        from neuroimagedisttraining_tpu.obs import compute as obs_compute
+
+        obs_compute.PROFILER.set_peak_flops(args.peak_flops)
     quant_spec = None
     if args.secure_quant:
         args.secure = True  # the quantized path IS the secure protocol
@@ -908,12 +923,25 @@ def main(argv=None) -> int:
             # timeout must never conclude "dead" because the server is
             # busy doing its job — a timed-out acquire reports busy,
             # which IS a liveness signal
+            from neuroimagedisttraining_tpu.obs import (
+                compute as obs_compute,
+            )
+
             if not server._rlock.acquire(timeout=0.2):
-                return {"busy": True}
+                # the compute block rides even the busy report: its
+                # profiler state is lock-free w.r.t. _rlock, and a
+                # wedged dispatch is exactly when the probe matters
+                return {"busy": True,
+                        "compute": obs_compute.PROFILER.health()}
             try:
                 h = {"round": int(server.round_idx),
                      "registered": len(server._registered),
-                     "suspects": len(server._suspect)}
+                     "suspects": len(server._suspect),
+                     # compute block (ISSUE 14): last dispatch age /
+                     # MFU sample / recompile count — distinguishes a
+                     # WEDGED-dispatch federation (age grows, counts
+                     # stall) from a slow one at the liveness probe
+                     "compute": obs_compute.PROFILER.health()}
                 if args.async_server:
                     h["buffered"] = (server._pending()
                                      if args.ingest_workers

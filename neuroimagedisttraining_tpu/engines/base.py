@@ -31,6 +31,7 @@ from neuroimagedisttraining_tpu.faults.schedule import (
     FaultSchedule, parse_fault_spec,
 )
 from neuroimagedisttraining_tpu.engines import program as round_program
+from neuroimagedisttraining_tpu.obs import compute as obs_compute
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
@@ -872,6 +873,54 @@ class FederatedEngine:
             "nidt_engine_round",
             "last round index flushed at an engine host boundary",
         ).set(int(round_idx))
+        # compute-plane boundary (ISSUE 14): this is a host point where
+        # the driver ALREADY blocked on device results, so the profiler
+        # can close its MFU window (flops dispatched since the last
+        # boundary / synced wall) without adding any sync
+        obs_compute.PROFILER.boundary(self.name)
+
+    # ---------- compute-plane profiler (obs/compute.py, ISSUE 14) ----------
+
+    #: lazily armed on the first dispatch (engines/program.py wrapper):
+    #: one abstract eval_shape derives the analytic FLOPs-per-round the
+    #: MFU gauges divide by — no device work, no params materialized
+    _compute_armed = False
+
+    def _arm_compute_profiler(self) -> None:
+        """Arm the dispatch-boundary profiler's MFU accounting for this
+        engine: analytic training FLOPs of one NOMINAL round (per-sample
+        FLOPs x expected sampled sample mass x local epochs). The cohort
+        estimate is the sampling contract's expectation — exact under
+        full participation / equal-sized synthetic clients (the bench
+        and profile-session configs), an estimate under frac sampling
+        or fault schedules (MFU is a utilization gauge, not a parity
+        pin; obs/compute.py documents the contract). Models the
+        analytic counter cannot walk (no captured conv intermediates)
+        disarm with a logged reason instead of failing a dispatch."""
+        if self._compute_armed:
+            return
+        self._compute_armed = True
+        try:
+            if self.data is not None:
+                shape = tuple(self.data.X_train.shape[2:])
+            else:
+                shape = tuple(self.stream.sample_shape)
+            per_sample = obs_compute.analytic_sample_flops(self.trainer,
+                                                           shape)
+            total_n = float(np.sum(self._n_train_host))
+            cohort_frac = (min(self.cfg.fed.client_num_per_round,
+                               self.real_clients)
+                           / max(1, self.real_clients))
+            flops_per_round = (per_sample * total_n * cohort_frac
+                               * max(1, self.cfg.optim.epochs))
+            obs_compute.arm_model(self.name, flops_per_round)
+        except Exception as e:  # noqa: BLE001 — MFU is best-effort
+            # telemetry; an uncountable model must never fail a dispatch
+            self.log.info(
+                "compute profiler: analytic FLOPs unavailable for this "
+                "model (%s) — nidt_mfu/nidt_sustained_tflops stay "
+                "unpublished; dispatch/compile accounting is unaffected",
+                e)
 
     # ---------- helpers ----------
 

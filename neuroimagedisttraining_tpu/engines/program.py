@@ -49,6 +49,7 @@ grep-able.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable
 
 import jax
@@ -57,6 +58,7 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.faults import adversary
+from neuroimagedisttraining_tpu.obs import compute as obs_compute
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
@@ -576,6 +578,10 @@ class RoundProgram:
         self.stages = stages
         self.built = 0
         self.dispatches = 0
+        #: builds per exact plan-cache key — a key building TWICE is a
+        #: recompile (LRU thrash / shape leak), the storm the compute
+        #: profiler warns about (obs/compute.py)
+        self._build_counts: dict[tuple, int] = {}
 
     # ---------- fallback reporting ----------
 
@@ -830,14 +836,48 @@ class RoundProgram:
         return (*(new_carry[n] for n in st.carry), *epi,
                 *(outs[o] for o in st.outputs), *efs_tail)
 
-    def _count_dispatches(self, jitted):
+    def _note_build(self, label: str, key: tuple) -> None:
+        """One program compilation: ``built`` and the scrapeable
+        ``nidt_compiles_total{engine, program}`` counter move TOGETHER
+        (one measurement — tests/test_program.py pins them equal). A
+        rebuild of the same exact plan-cache ``key`` is a recompile
+        (warning-logged + flight-recorded by the profiler)."""
+        self.built += 1
+        n = self._build_counts[key] = self._build_counts.get(key, 0) + 1
+        obs_compute.note_compile(self.eng.name, label, recompile=n > 1)
+
+    def _count_dispatches(self, jitted, label: str = "round",
+                          rounds: int = 1):
         """Wrap a compiled program so invocations count toward
-        ``dispatches`` (the bench's per-engine dispatch evidence);
-        ``.jit``/``.lower`` expose the underlying executable for
-        compile-text tests."""
+        ``dispatches`` (the bench's per-engine dispatch evidence) and
+        feed the dispatch-boundary profiler (obs/compute.py): host
+        wall around the call — compile-dominated on the first
+        invocation (jit compiles at first call), enqueue thereafter —
+        plus ``rounds`` (K for fused windows) toward the MFU
+        numerator. No sync is added anywhere: the clock brackets the
+        ENQUEUE, and MFU divides by boundary-to-boundary wall where
+        the driver already blocked. ``.jit``/``.lower`` expose the
+        underlying executable for compile-text tests."""
+        state = {"first": True}
+        eng = self.eng
+
         def dispatch(*args):
             self.dispatches += 1
-            return jitted(*args)
+            eng._arm_compute_profiler()
+            # one span per dispatch (disarmed: a shared no-op) — under
+            # --profile_dir the span opens a jax.profiler
+            # TraceAnnotation, so this exact program invocation is the
+            # shared ruler between the host and XLA timelines
+            with obs_trace.span("dispatch_program", program=label,
+                                engine=eng.name, rounds=rounds):
+                t0 = time.perf_counter()
+                out = jitted(*args)
+                dur = time.perf_counter() - t0
+            obs_compute.note_dispatch(
+                eng.name, label, dur, rounds=rounds,
+                phase="compile" if state["first"] else "execute")
+            state["first"] = False
+            return out
 
         dispatch.jit = jitted
         dispatch.lower = jitted.lower
@@ -854,9 +894,11 @@ class RoundProgram:
         mesh-padded sampled set (static — fault-schedule cohort
         shrinkage re-specializes via the plan cache)."""
         shard = sharded if sharded is not None else (n_real is not None)
+        key = ("round", n_real, static_key, shard)
+        label = "round_sharded" if shard else "round"
 
         def build():
-            self.built += 1
+            self._note_build(label, key)
 
             def round_fn(carry, data, consts, idx, rngs, lr, efs=None,
                          byz=None, per_round=None):
@@ -872,11 +914,10 @@ class RoundProgram:
 
             return self._count_dispatches(jax.jit(
                 round_fn,
-                donate_argnums=self.eng._donate_argnums(0, 6)))
+                donate_argnums=self.eng._donate_argnums(0, 6)),
+                label=label)
 
-        return self.eng._plan_cached("_round_prog_cache",
-                                     ("round", n_real, static_key, shard),
-                                     build)
+        return self.eng._plan_cached("_round_prog_cache", key, build)
 
     def fused_jit(self, k: int, n_real: int | None = None,
                   static_key=None, sharded: bool | None = None):
@@ -889,9 +930,11 @@ class RoundProgram:
         ``_fused_round_jit_cache`` (the one-compiled-program-per-window
         pin reads it)."""
         shard = sharded if sharded is not None else (n_real is not None)
+        key = (k, n_real, static_key, shard)
+        label = (f"fused_sharded_k{k}" if shard else f"fused_k{k}")
 
         def build():
-            self.built += 1
+            self._note_build(label, key)
 
             def fused_round_fn(carry, data, consts, idx, rngs, lrs,
                                byz=None, per_round=None):
@@ -924,10 +967,10 @@ class RoundProgram:
 
             return self._count_dispatches(jax.jit(
                 fused_round_fn,
-                donate_argnums=self.eng._donate_argnums(0)))
+                donate_argnums=self.eng._donate_argnums(0)),
+                label=label, rounds=k)
 
-        return self.eng._plan_cached("_fused_round_jit_cache",
-                                     (k, n_real, static_key, shard),
+        return self.eng._plan_cached("_fused_round_jit_cache", key,
                                      build)
 
     def _reject_streamed_epilogue(self):
@@ -950,7 +993,7 @@ class RoundProgram:
         self._reject_streamed_epilogue()
 
         def build():
-            self.built += 1
+            self._note_build("stream", ("stream",))
 
             def stream_round_fn(carry, consts, Xs, ys, ns, idx, rngs, lr,
                                 efs=None, byz=None):
@@ -962,7 +1005,8 @@ class RoundProgram:
 
             return self._count_dispatches(jax.jit(
                 stream_round_fn,
-                donate_argnums=self.eng._donate_argnums(0)))
+                donate_argnums=self.eng._donate_argnums(0)),
+                label="stream")
 
         return self.eng._plan_cached("_round_prog_cache", ("stream",),
                                      build)
@@ -977,9 +1021,10 @@ class RoundProgram:
         warns and ignores it) and the buffers die at end of dispatch
         anyway."""
         self._reject_streamed_epilogue()
+        label = f"fused_stream_k{k}"
 
         def build():
-            self.built += 1
+            self._note_build(label, ("stream", k))
 
             def fused_stream_round_fn(carry, consts, Xs, ys, ns, rngs,
                                       lrs, byz=None):
@@ -1000,7 +1045,8 @@ class RoundProgram:
 
             return self._count_dispatches(jax.jit(
                 fused_stream_round_fn,
-                donate_argnums=self.eng._donate_argnums(0)))
+                donate_argnums=self.eng._donate_argnums(0)),
+                label=label, rounds=k)
 
         return self.eng._plan_cached("_fused_round_jit_cache",
                                      ("stream", k), build)

@@ -24,6 +24,18 @@ Three host-side instruments, one import surface:
   wire trace context (``trace.make_trace_ctx`` riding
   ``ARG_TRACE_CTX``) links one upload's client->worker->root lifecycle
   as Perfetto flow events.
+- :mod:`obs.compute` — the COMPUTE-plane profiler (ISSUE 14): host
+  wall per compiled-program dispatch (``nidt_dispatch_ms`` with the
+  compile-vs-execute phase split), the ``nidt_compiles_total``
+  recompile tripwire, live ``nidt_mfu``/``nidt_sustained_tflops``
+  gauges closed at already-synced host boundaries (zero added device
+  syncs), XLA cost/memory accounting reconciled against the analytic
+  ``ops/flops.py`` counter, and the ``/healthz`` compute block.
+- :mod:`obs.probe` — the declarative profile-session driver
+  (ISSUE 14): PROFILE.md's probe checklist as a manifest of config
+  cells run through the SHIPPED driver, emitting the bench-gated
+  ``bench_matrix/profile_session.json``
+  (``scripts/run_profile_session.sh`` / ``--profile_session``).
 
 THE HOST-BOUNDARY RULE: none of this may run inside a jitted/vmapped/
 shard_mapped body. Clocks (``time.monotonic``/``perf_counter``) and
@@ -41,7 +53,7 @@ bounded deque append, and the registry can be disarmed wholesale
 (bench.py ``obs_overhead`` cell).
 """
 
-from neuroimagedisttraining_tpu.obs import fanin, flight, metrics, trace  # noqa: F401
+from neuroimagedisttraining_tpu.obs import compute, fanin, flight, metrics, trace  # noqa: F401
 from neuroimagedisttraining_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
 from neuroimagedisttraining_tpu.obs.metrics import (  # noqa: F401
     REGISTRY,
@@ -57,6 +69,7 @@ __all__ = [
     "TRACER",
     "SpanTracer",
     "span",
+    "compute",
     "fanin",
     "flight",
     "metrics",

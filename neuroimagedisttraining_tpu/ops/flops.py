@@ -51,18 +51,23 @@ def count_inference_flops(model, params: PyTree, sample_x: jax.Array,
     variables = {"params": params}
     if batch_stats is not None and jax.tree.leaves(batch_stats):
         variables["batch_stats"] = batch_stats
+    # train=True so BatchNorm needs no pre-existing running stats when
+    # ``batch_stats`` is not supplied; shapes are identical either way.
+    train = "batch_stats" not in variables
 
-    def run():
-        # train=True so BatchNorm needs no pre-existing running stats when
-        # ``batch_stats`` is not supplied; shapes are identical either way.
-        train = "batch_stats" not in variables
+    def run(v, x):
         _, inter = model.apply(
-            variables, sample_x, train=train, capture_intermediates=True,
+            v, x, train=train, capture_intermediates=True,
             mutable=["intermediates", "batch_stats"],
             rngs={"dropout": jax.random.key(0)} if train else None)
         return inter
 
-    inter = jax.eval_shape(run)
+    # variables/sample_x ride as eval_shape ARGUMENTS (not closure
+    # constants) so the whole pass is abstract: callers may hand
+    # ``jax.eval_shape``-derived ShapeDtypeStruct params — the
+    # flagship-shape cost-model parity check (obs/compute.py) counts
+    # FLOPs without materializing a single activation
+    inter = jax.eval_shape(run, variables, sample_x)
 
     def walk(node, prefix):
         if isinstance(node, dict):

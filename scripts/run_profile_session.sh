@@ -1,0 +1,90 @@
+#!/usr/bin/env bash
+# Push-button profile session (ISSUE 14): run the declarative probe
+# manifest (obs/probe.py — PROFILE.md's hand-run checklist, declared)
+# through the SHIPPED driver with the dispatch-boundary profiler armed
+# (obs/compute.py), gate the FRESH artifact against the COMMITTED
+# baseline (analysis/bench_gate.py: structural cells exact, wall/TFLOPs
+# at drift-tolerant ratios), then install it as
+# bench_matrix/profile_session.json.
+#
+# Order matters: the session writes to a temp dir FIRST and gates
+# before installing — gating after overwriting the committed path would
+# compare the fresh artifact against itself and pass vacuously
+# (scripts/bench_diff.py's --fresh discipline).
+#
+# Config-mismatch regenerations: the eq cells (dispatch counts,
+# manifest fingerprint) are deterministic AT a config — a session run
+# at a different shape/rounds/device count (e.g. the flagship TPU
+# recipe below replacing the CPU smoke baseline) legitimately differs,
+# so when the fresh meta block != the committed meta block the gate
+# verdict is REPORTED but not fatal: the operator is establishing a new
+# baseline and reviews + commits it.
+#
+# Defaults are the CPU-harness smoke shape; a TPU session exports the
+# flagship recipe before running (PROFILE.md round 10):
+#
+#   PROFILE_MODEL=3DCNN PROFILE_SHAPE=121,145,121 \
+#   PROFILE_BATCH=128 PROFILE_LOCAL=512 PROFILE_CLIENTS=21 \
+#   PROFILE_ROUNDS=8 NIDT_PEAK_FLOPS=<chip bf16 peak * chips> \
+#   scripts/run_profile_session.sh
+#
+# Env:
+#   PROFILE_OUT       install path (default bench_matrix/profile_session.json)
+#   PROFILE_DEVICES   virtual CPU devices for the cohort_sharded probe
+#                     (default 2; ignored on real multi-device backends)
+#   PROFILE_MANIFEST  JSON manifest replacing the default probe list
+#   NIDT_PEAK_FLOPS   total device peak flop/s -> arms the nidt_mfu gauge
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PY="${PYTHON:-python}"
+OUT="${PROFILE_OUT:-bench_matrix/profile_session.json}"
+DEVICES="${PROFILE_DEVICES:-2}"
+MANIFEST="${PROFILE_MANIFEST:-}"
+
+fresh_dir="$(mktemp -d)"
+trap 'rm -rf "$fresh_dir"' EXIT
+fresh="$fresh_dir/profile_session.json"
+
+args=(--out "$fresh" --virtual_devices "$DEVICES")
+if [[ -n "$MANIFEST" ]]; then
+    args+=(--manifest "$MANIFEST")
+fi
+
+echo "== profile session (fresh) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" -m neuroimagedisttraining_tpu.obs.probe "${args[@]}"
+
+if [[ -f "$OUT" ]]; then
+    echo "== bench gate: fresh session vs committed baseline ($OUT) =="
+    same_config="$("$PY" - "$fresh" "$OUT" <<'EOF'
+import json, sys
+fresh = json.load(open(sys.argv[1]))
+committed = json.load(open(sys.argv[2]))
+print("1" if fresh.get("meta") == committed.get("meta")
+      and fresh["session"]["structural_fingerprint"]
+      == committed["session"]["structural_fingerprint"] else "0")
+EOF
+)"
+    gate_rc=0
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        "$PY" -m neuroimagedisttraining_tpu.analysis.bench_gate \
+        --fresh "$fresh_dir" --committed "$(dirname "$OUT")" \
+        --artifact profile_session.json --quiet || gate_rc=$?
+    if [[ "$same_config" == "1" && "$gate_rc" -ne 0 ]]; then
+        echo "profile session REGRESSED vs the committed baseline at" \
+             "the SAME config — not installing $OUT" >&2
+        exit "$gate_rc"
+    elif [[ "$same_config" != "1" ]]; then
+        echo "NOTE: session config differs from the committed baseline" \
+             "(new shape/rounds/devices/manifest) — gate verdict above" \
+             "is informational; installing as the NEW baseline." \
+             "Review the diff before committing."
+    fi
+else
+    echo "== no committed baseline at $OUT yet (first session) =="
+fi
+
+mkdir -p "$(dirname "$OUT")"
+cp "$fresh" "$OUT"
+echo "profile session complete: $OUT"

@@ -54,7 +54,13 @@ fi
 # engines/program.py, and *_fallback_key overrides must name keys from
 # the builder's REASONS table (the structured nidt_fallback_total
 # counter's single source of truth)
-echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline incl. obs-trace-ctx-key + obs-pipe-per-upload / precision-discipline / round-program-discipline) =="
+# the ISSUE 14 obs-discipline extension rides the same resolver:
+# obs-sync-in-trace — no jax.block_until_ready / .block_until_ready()
+# inside traced bodies; the dispatch-boundary profiler (obs/compute.py)
+# times the ENQUEUE and closes MFU windows at already-synced host
+# boundaries, and a sync smuggled into a round body is exactly the
+# hidden-cost bug its zero-sync contract forbids
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline incl. obs-trace-ctx-key + obs-pipe-per-upload + obs-sync-in-trace / precision-discipline / round-program-discipline) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
 

@@ -952,6 +952,59 @@ def test_obs_indexed_set_and_host_mutation_pass():
     assert fs == []
 
 
+# -- obs-sync-in-trace (ISSUE 14: the dispatch profiler's zero-sync rule)
+
+
+def test_obs_sync_in_jitted_body_flagged():
+    """block_until_ready inside a traced body — both the jax dotted
+    call and the zero-arg array method — is the hidden-sync class the
+    dispatch profiler's wiring must never introduce."""
+    fs = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            jax.block_until_ready(x)
+            return x.block_until_ready() + 1
+        """, rules=["obs-sync-in-trace"])
+    assert rules_of(fs) == ["obs-sync-in-trace", "obs-sync-in-trace"]
+    assert "zero-sync" in fs[0].message
+
+
+def test_obs_sync_transitive_callee_flagged():
+    fs = lint("""
+        import jax
+
+        def wait(x):
+            return jax.block_until_ready(x)
+
+        def f(xs):
+            return jax.vmap(lambda x: wait(x) + 1)(xs)
+        """, rules=["obs-sync-in-trace"])
+    assert rules_of(fs) == ["obs-sync-in-trace"]
+
+
+def test_obs_sync_at_host_boundary_passes():
+    """The blessed pattern: time around the ENQUEUE on the host, sync
+    only at host boundaries (what obs/compute.note_dispatch and the
+    bench cells do)."""
+    fs = lint("""
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        def driver(x):
+            t0 = time.perf_counter()
+            y = f(x)
+            jax.block_until_ready(y)
+            return y, time.perf_counter() - t0
+        """, rules=["obs-sync-in-trace"])
+    assert fs == []
+
+
 # ---------------- obs fan-in discipline (ISSUE 13) ----------------
 
 _INGEST_PATH = "neuroimagedisttraining_tpu/asyncfl/ingest.py"

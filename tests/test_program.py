@@ -34,6 +34,7 @@ from neuroimagedisttraining_tpu.data.federate import federate_cohort
 from neuroimagedisttraining_tpu.engines import ENGINES, create_engine
 from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.obs import compute as obs_compute
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
 from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
@@ -180,12 +181,18 @@ def test_fused_window_bitwise_equals_sequential(tmp_path,
     assert fz.fused_fallback_reason() is None
     fcarry = _init_carry(fz)
     built0 = fz.program.built
+    # the compiled-programs-per-window pin re-asserted through the
+    # scrapeable counter (ISSUE 14): nidt_compiles_total moves in the
+    # SAME increment as program.built — one measurement, not a second
+    # bookkeeping path
+    ctr0 = obs_compute.compiles_total(engine=algorithm)
     fcarry, _, outs, wi = fz.program.run_window(fcarry, 0, 4)
     assert wi.k == 4
     assert [float(x) for x in np.asarray(outs["loss"])] == losses
     _assert_trees_bitwise(carry, fcarry)
     # one compiled program, one dispatch, for the whole window
     assert fz.program.built - built0 == 1
+    assert obs_compute.compiles_total(engine=algorithm) - ctr0 == 1.0
     assert fz.program.dispatches == 1
     assert len(fz.__dict__["_fused_round_jit_cache"]) == 1
 
