@@ -358,6 +358,51 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "(obs/flight.py); the ring dumps to "
                              "LOG/<dataset>/<identity>.flight.json on "
                              "any fatal failure (failure_context)")
+    # training-health plane (obs/health.py + obs/rules.py, ISSUE 15)
+    parser.add_argument("--health_stats", action="store_true",
+                        help="arm the in-dispatch federation-"
+                             "statistics leg on every declared round "
+                             "program (engines/program.py): per-client "
+                             "update L2 norms, cosine-to-aggregate, "
+                             "update-norm dispersion, global param/"
+                             "update norms and mask health, computed "
+                             "INSIDE the jitted round and fetched only "
+                             "in the existing batched host-boundary "
+                             "device_get — armed rounds are bitwise-"
+                             "identical to disarmed ones, published as "
+                             "nidt_health_* on /metrics")
+    parser.add_argument("--health_rules", type=str, default="",
+                        help="JSON manifest of anomaly rules "
+                             "(obs/rules.py: metric selector, window, "
+                             "comparator, threshold, severity, "
+                             "for_rounds debounce) extending the "
+                             "built-in set (same-named rules "
+                             "override); unknown metric names fail at "
+                             "startup against the declared-name list "
+                             "(obs/names.py)")
+    parser.add_argument("--health_gate", action="store_true",
+                        help="exit nonzero when the run's WORST health "
+                             "status was not ok (any anomaly rule "
+                             "fired), after writing the machine-"
+                             "readable verdict to "
+                             "LOG/<dataset>/<identity>.health.json — "
+                             "the CI spelling of 'this run trained "
+                             "healthily'")
+    parser.add_argument("--metrics_out", type=str, default="",
+                        help="append one metrics-registry JSONL record "
+                             "per round at the engine host boundary, "
+                             "each with monotonic round/seq join keys "
+                             "(obs/metrics.py dump_jsonl) — the sink "
+                             "analysis/run_report.py joins with the "
+                             "flight dump and health verdict")
+    parser.add_argument("--dp_epsilon_budget", type=float, default=0.0,
+                        help="epsilon budget the built-in DP health "
+                             "rules judge against (obs/rules.py): "
+                             "dp-budget-exceeded fires critical once "
+                             "the running epsilon crosses it, "
+                             "dp-burn-rate warns when a round burns "
+                             "over 2x the uniform budget/comm_round "
+                             "rate; 0 = no budget rules")
     parser.add_argument("--compile_cache", "--compile_cache_dir",
                         dest="compile_cache_dir", type=str, default=None,
                         help="persistent XLA compile cache dir (repeat "
@@ -436,6 +481,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             secure_quant_frac_bits=args.secure_quant_frac_bits,
             dp_clip=args.dp_clip, dp_sigma=args.dp_sigma,
             dp_delta=args.dp_delta,
+            dp_epsilon_budget=args.dp_epsilon_budget,
             defense_type=args.defense_type,
             norm_bound=args.norm_bound, stddev=args.stddev,
             byz_f=args.byz_f, geomed_iters=args.geomed_iters,
@@ -460,7 +506,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         stream_chunk_clients=args.stream_chunk_clients,
         log_dir=args.log_dir,
         trace_out=args.trace_out, metrics_port=args.metrics_port,
-        flight_events=args.flight_events)
+        flight_events=args.flight_events,
+        health_stats=args.health_stats, health_rules=args.health_rules,
+        health_gate=args.health_gate, metrics_out=args.metrics_out)
 
 
 def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
@@ -610,6 +658,32 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--dp_sigma needs --dp_clip > 0 (the clip bound is "
                      "the sensitivity the noise multiplier is stated "
                      "against)")
+    # health-plane config dies AT ARGPARSE (ISSUE 15 satellite): a
+    # negative budget or a broken/unknown-metric rule manifest must
+    # fail here, never as a silently-never-firing rule mid-run
+    if args.dp_epsilon_budget < 0:
+        parser.error(f"--dp_epsilon_budget must be >= 0 (got "
+                     f"{args.dp_epsilon_budget})")
+    if args.dp_epsilon_budget > 0 and args.dp_sigma <= 0 \
+            and args.defense_type != "weak_dp":
+        parser.error(
+            "--dp_epsilon_budget needs an armed noise path to budget "
+            "(--dp_sigma/--dp_clip on a DP engine, or --defense "
+            "weak_dp): without one the accountant records nothing and "
+            "the budget rules can never fire")
+    if args.health_rules:
+        from neuroimagedisttraining_tpu.obs import names as obs_names
+        from neuroimagedisttraining_tpu.obs import rules as obs_rules
+
+        try:
+            for r in obs_rules.load_rules(args.health_rules):
+                # full validation (unknown metric names included), not
+                # just the schema — a typo'd rule must die HERE with
+                # the known-names list, not as a traceback after the
+                # data/model build
+                r.validate(obs_names.DECLARED)
+        except (OSError, ValueError, TypeError) as e:
+            parser.error(f"--health_rules: {e}")
     # precision-contract conflicts die AT ARGPARSE with the resolution
     # named (core/optim.validate_precision re-checks at trainer build)
     if args.loss_scale != 1.0 and args.precision != "bf16_mixed":
@@ -776,18 +850,41 @@ def main(argv: list[str] | None = None) -> int:
     # profiler is always on; --peak_flops arms the MFU denominator and
     # /healthz carries the compute block (wedged vs slow dispatch)
     from neuroimagedisttraining_tpu.obs import compute as obs_compute
+    from neuroimagedisttraining_tpu.obs import health as obs_health
+    from neuroimagedisttraining_tpu.obs import rules as obs_rules
 
     if args.peak_flops > 0:
         obs_compute.PROFILER.set_peak_flops(args.peak_flops)
+    # anomaly-rule engine (obs/rules.py, ISSUE 15): the built-in
+    # manifest parameterized by this run's budget/schedule, extended by
+    # --health_rules; evaluated at every engine host boundary
+    # (publish_stat_info) and reported on /healthz
+    hrules = obs_rules.configure(
+        manifest_path=args.health_rules,
+        dp_epsilon_budget=cfg.fed.dp_epsilon_budget,
+        comm_round=cfg.fed.comm_round,
+        max_staleness=cfg.fed.max_staleness)
     msrv = start_metrics_server(
         cfg.metrics_port, host=args.metrics_host,
-        health_probe=lambda: {"compute": obs_compute.PROFILER.health()})
+        health_probe=lambda: {
+            "compute": obs_compute.PROFILER.health(),
+            # fast-path coverage next to the compute block (ISSUE 15
+            # satellite): a run silently degraded to K=1 unsharded
+            # reads differently from a healthy one at the probe
+            "fallbacks": obs_health.fallback_block(),
+            "health": obs_rules.health_block()})
     try:
         with failure_context(name=cfg.identity()), \
                 profile_trace(args.profile_dir,
                               enabled=bool(args.profile_dir)):
             result = engine.train()
     finally:
+        # the rule engine's lifetime is the run's — disarm on EVERY
+        # exit path (tests drive several runs per process; a stale
+        # engine must not keep evaluating later runs' boundaries
+        # against this run's state). The local ``hrules`` handle below
+        # still reads the verdict after disarming.
+        obs_rules.disarm()
         if cfg.trace_out:
             out = obs_trace.dump()
             if out:
@@ -809,9 +906,35 @@ def main(argv: list[str] | None = None) -> int:
                              if not k.startswith("final_masks")}),
                   f, default=str)
 
+    # end-of-run health verdict (ISSUE 15): always written (the run
+    # report joins it); --health_gate additionally turns a non-ok WORST
+    # status into a nonzero exit — a run that diverged and recovered
+    # still failed its gate
+    verdict = hrules.verdict()
+    verdict_path = os.path.join(engine.log.dir,
+                                cfg.identity() + ".health.json")
+    with open(verdict_path, "w") as f:
+        json.dump(verdict, f, indent=1, default=str)
+
     final = {k: v for k, v in result.items()
              if k in ("final_global", "final_personal", "mask_density")}
-    print(json.dumps({"identity": cfg.identity(), **final}, default=float))
+    # ONE result line (the last stdout line IS the machine-readable
+    # result — tests/test_cli.py's contract); the health summary rides
+    # inside it rather than as a second line
+    print(json.dumps({
+        "identity": cfg.identity(), **final,
+        "health": {k: verdict[k] for k in
+                   ("status", "worst_status", "alerts_total",
+                    "rounds_evaluated")},
+        "health_verdict_path": verdict_path}, default=float))
+    if args.health_gate and verdict["worst_status"] != "ok":
+        # stderr: the LAST stdout line must stay the machine-readable
+        # result (tests/test_cli.py's contract)
+        print(f"[health] gate FAILED: worst status "
+              f"{verdict['worst_status']!r} "
+              f"({verdict['alerts_total']} alert(s); see "
+              f"{verdict_path})", file=sys.stderr, flush=True)
+        return 1
     return 0
 
 

@@ -33,6 +33,7 @@ from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
     determinism,
     donation,
     engine_contract,
+    health_discipline,
     lock_discipline,
     mesh_discipline,
     obs_discipline,

@@ -144,6 +144,29 @@ SPECS: dict[str, tuple[Check, ...]] = {
     # treats the verdict as informational while a SAME-config red
     # blocks the install (the round_program.json eq cells carry the
     # same config-pinned contract).
+    # training-health exemplar (ISSUE 15, scripts/run_health_report.sh):
+    # the seeded sign-flip divergence run vs its clean twin through the
+    # shipped CLI + analysis/run_report.py. Every cell is a
+    # deterministic verdict fact at the committed config (seeded tiny
+    # run, rule edges are debounced booleans), so the checks are exact
+    # — a regeneration that stops firing the divergence rule, or starts
+    # firing on the clean twin, is a broken health plane, not drift.
+    "health_report.json": (
+        Check("contrast.timelines_differ", "true",
+              note="byz vs clean alert timelines visibly differ "
+                   "(the acceptance criterion verbatim)"),
+        Check("clean.summary.schema_ok", "true"),
+        Check("byz.summary.schema_ok", "true"),
+        Check("contrast.clean_worst", "eq",
+              note="clean twin stays ok for the whole run"),
+        Check("contrast.byz_worst", "eq",
+              note="sign-flip run's worst status (critical)"),
+        Check("contrast.clean_alerts", "eq"),
+        Check("contrast.byz_alerts", "eq",
+              note="alert count at the committed seed/config"),
+        Check("byz.summary.rounds", "eq",
+              note="metrics JSONL rounds joined (the round/seq keys)"),
+    ),
     "profile_session.json": (
         Check("session.structural_fingerprint", "eq",
               note="the declared probe manifest (structural cells)"),

@@ -112,6 +112,8 @@ from neuroimagedisttraining_tpu.obs import fanin as obs_fanin
 from neuroimagedisttraining_tpu.obs import flight as obs_flight
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
+from neuroimagedisttraining_tpu.obs import names as obs_names
+from neuroimagedisttraining_tpu.obs import rules as obs_rules
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
 
@@ -726,7 +728,7 @@ class _IngestWorkerProc(Observer):
         #: same client within one interval are SUPPRESSED (counted)
         self._beats_pending: set[int] = set()
         self._obs_beats_suppressed = obs_metrics.gauge(
-            "nidt_ingest_heartbeats_suppressed",
+            obs_names.INGEST_HEARTBEATS_SUPPRESSED,
             "per-client heartbeats folded away by worker-side batching "
             "(duplicates within one flush interval)")
         #: telemetry shipper (ISSUE 13): registry snapshot + span/flight
@@ -1068,17 +1070,17 @@ class ShardedIngestServer(BufferedFedAvgServer):
         self.base_port = BASE_PORT if base_port is None else int(base_port)
         # ---- per-worker obs (ISSUE 9 labels) + merge flight events ----
         self._obs_pending = obs_metrics.gauge(
-            "nidt_ingest_pending_uploads",
+            obs_names.INGEST_PENDING_UPLOADS,
             "accepted uploads buffered at ingest workers, awaiting "
             "harvest")
         self._obs_workers = obs_metrics.gauge(
-            "nidt_ingest_workers_live", "ingest worker processes alive")
+            obs_names.INGEST_WORKERS_LIVE, "ingest worker processes alive")
         self._obs_partials = obs_metrics.counter(
-            "nidt_ingest_partials_total",
+            obs_names.INGEST_PARTIALS,
             "partials harvested per ingest worker",
             labelnames=("worker",))
         self._obs_worker_uploads = obs_metrics.counter(
-            "nidt_ingest_worker_uploads_total",
+            obs_names.INGEST_WORKER_UPLOADS,
             "per-worker upload verdict events at the root",
             labelnames=("worker", "outcome"))
         # ---- federation-wide telemetry fan-in (ISSUE 13) ----
@@ -1208,6 +1210,18 @@ class ShardedIngestServer(BufferedFedAvgServer):
                 for k in out:
                     out[k] += bs.get(k, 0)
         return out
+
+    def _observe_health_boundary(self) -> None:
+        """Anomaly rules on the sharded root evaluate the fan-in-MERGED
+        snapshot (obs/fanin.py): root cells plus every worker's cells
+        re-labeled ``worker="N"`` — a rule's label-subset selector fires
+        on a worker's series exactly as on a local one (ISSUE 15)."""
+        if obs_rules.active() is None:
+            # unarmed (loadgen soaks): skip the O(metrics x workers)
+            # merge on the aggregation hot path, not just the verdict
+            return
+        obs_rules.observe_boundary(self.round_idx,
+                                   snapshot=self.fanin.merged_snapshot())
 
     def metrics_view(self):
         """The MERGED registry view ``--metrics_port`` should serve

@@ -61,6 +61,7 @@ from neuroimagedisttraining_tpu.faults.schedule import (
 from neuroimagedisttraining_tpu.obs import fanin as obs_fanin
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
+from neuroimagedisttraining_tpu.obs import names as obs_names
 
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
@@ -647,8 +648,8 @@ def run_load(mode: str = "async", num_clients: int = 200,
                 {int(m) for m in _re.findall(r'worker="(\d+)"',
                                              merged_text)}),
             "has_stage_samples":
-                "nidt_upload_stage_ms_bucket" in merged_text,
-            "has_rtt_samples": "nidt_client_rtt_ms_bucket" in merged_text,
+                (obs_names.UPLOAD_STAGE_MS + "_bucket") in merged_text,
+            "has_rtt_samples": (obs_names.CLIENT_RTT_MS + "_bucket") in merged_text,
         }
         if trace_out:
             flows = obs_fanin.linked_flow_ids(

@@ -54,6 +54,7 @@ from neuroimagedisttraining_tpu.distributed.message import (
     frame_bytes,
 )
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import names as obs_names
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
 
@@ -136,15 +137,15 @@ class SelectorCommManager(QueueDispatchMixin, BaseCommManager):
         # version-advance number degrades on first)
         lab = dict(rank=str(rank))
         self._obs_conns = obs_metrics.gauge(
-            "nidt_selector_connections",
+            obs_names.SELECTOR_CONNECTIONS,
             "live connections registered with the selector loop",
             labelnames=("rank",)).labels(**lab)
         self._obs_wq_frames = obs_metrics.gauge(
-            "nidt_selector_write_queue_frames",
+            obs_names.SELECTOR_WRITE_QUEUE,
             "frames pending across every persistent write queue",
             labelnames=("rank",)).labels(**lab)
         self._obs_stalls = obs_metrics.counter(
-            "nidt_backpressure_stalls_total",
+            obs_names.BACKPRESSURE_STALLS,
             "sends that blocked on a full per-connection write queue",
             labelnames=("rank",)).labels(**lab)
         self._obs_last_tick = 0.0

@@ -82,6 +82,8 @@ from neuroimagedisttraining_tpu.distributed.cross_silo import (
 from neuroimagedisttraining_tpu.obs import flight as obs_flight
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
+from neuroimagedisttraining_tpu.obs import names as obs_names
+from neuroimagedisttraining_tpu.obs import rules as obs_rules
 
 log = logging.getLogger("neuroimagedisttraining_tpu.asyncfl")
 
@@ -289,18 +291,18 @@ class BufferedFedAvgServer(FedAvgServer):
         # buffer occupancy between aggregations. All on the dispatch
         # thread under _rlock — never inside a jitted program.
         self._obs_uploads = obs_metrics.counter(
-            "nidt_async_uploads_total",
+            obs_names.ASYNC_UPLOADS,
             "async-server upload verdicts (mirrors upload_stats)",
             labelnames=("outcome",))
         self._obs_staleness = obs_metrics.histogram(
-            "nidt_async_staleness",
+            obs_names.ASYNC_STALENESS,
             "staleness tau (versions) of accepted uploads",
             buckets=(0, 1, 2, 4, 8, 16, 32, 64))
         self._obs_buffer = obs_metrics.gauge(
-            "nidt_async_buffer_occupancy",
+            obs_names.ASYNC_BUFFER_OCCUPANCY,
             "uploads currently buffered toward the next aggregation")
         self._obs_k_eff = obs_metrics.gauge(
-            "nidt_async_buffer_k_eff",
+            obs_names.ASYNC_BUFFER_K_EFF,
             "effective aggregation trigger threshold (buffer_k shrunk "
             "by known-gone clients)")
         self._obs_k_eff.set(self._k_eff())
@@ -328,6 +330,13 @@ class BufferedFedAvgServer(FedAvgServer):
     def current_version(self) -> int:
         with self._rlock:
             return self.round_idx
+
+    def _observe_health_boundary(self) -> None:
+        """Evaluate the armed anomaly rules (obs/rules.py) at this
+        version boundary against the process registry; the sharded
+        ingest root overrides with the fan-in-MERGED snapshot so rules
+        fire on worker-labeled series too. No-op while unarmed."""
+        obs_rules.observe_boundary(self.round_idx)
 
     # ---- handlers (dispatch thread) ----
 
@@ -728,6 +737,11 @@ class BufferedFedAvgServer(FedAvgServer):
         self._obs_buffer.set(0)
         self._obs_round_gauge.set(self.round_idx)
         self._obs_k_eff.set(self._k_eff())
+        # training-health boundary (ISSUE 15): every version advance is
+        # a host boundary — evaluate the armed anomaly rules so a
+        # mid-run /metrics scrape carries nidt_alert samples (the chaos
+        # smoke asserts this); unarmed processes no-op
+        self._observe_health_boundary()
         self._ring[self.round_idx] = self.params
         floor = self.round_idx - self.max_staleness
         for old in [k for k in self._ring if k < floor]:

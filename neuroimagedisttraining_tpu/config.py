@@ -162,6 +162,12 @@ class FedConfig:
     dp_clip: float = 0.0
     dp_sigma: float = 0.0
     dp_delta: float = 1e-5
+    # Epsilon budget for the built-in DP health rules (obs/rules.py,
+    # ISSUE 15): > 0 arms dp-budget-exceeded (critical once the running
+    # epsilon crosses it) and dp-burn-rate (warn when a round burns
+    # over 2x the uniform budget/comm_round rate). Purely a verdict
+    # threshold — the accountant itself never stops at a budget.
+    dp_epsilon_budget: float = 0.0
     # Deterministic fault injection + tolerance (faults/, ISSUE 2).
     # fault_spec grammar: "crash:RANK@ROUND,crash_prob:P,straggle:P:MAX_S,
     # drop:P,dup:P,disconnect:P" (faults/schedule.parse_fault_spec); one
@@ -258,6 +264,22 @@ class ExperimentConfig:
     trace_out: str = ""            # Chrome trace-event JSON path; ""=off
     metrics_port: int = 0          # /metrics + /healthz port; 0 = off
     flight_events: int = 256       # flight-recorder ring capacity
+    # Training-health plane (ISSUE 15). health_stats arms the
+    # in-dispatch federation-statistics leg on every declared round
+    # program (engines/program.py -> obs/health.py): per-client update
+    # norms, cosine-to-aggregate, dispersion, global norms, mask health
+    # — computed inside the jitted round, fetched only in the existing
+    # batched host-boundary device_get (armed-vs-disarmed rounds are
+    # BITWISE identical; zero added syncs). health_rules names a JSON
+    # manifest extending the built-in anomaly rules (obs/rules.py);
+    # health_gate makes the CLI exit nonzero when the run's worst
+    # health status was not "ok". metrics_out appends one registry
+    # JSONL record per round (with monotonic round/seq join keys) for
+    # analysis/run_report.py.
+    health_stats: bool = False
+    health_rules: str = ""
+    health_gate: bool = False
+    metrics_out: str = ""
     # streaming mode: clients per host-fetched chunk for streamed eval /
     # phase-1 scoring / chunked DisPFL rounds; 0 = auto (mesh size or 4)
     stream_chunk_clients: int = 0

@@ -30,6 +30,7 @@ import numpy as np
 
 from neuroimagedisttraining_tpu.data.hdf5 import fetch_rows
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import names as obs_names
 from neuroimagedisttraining_tpu.utils import native
 
 
@@ -117,7 +118,7 @@ class StreamingFederation:
         totals; with several concurrent feeds in one process (tests) the
         last writer wins — a run owns one feed."""
         g = obs_metrics.gauge(
-            "nidt_stream_transfer",
+            obs_names.STREAM_TRANSFER,
             "cumulative streaming-feed totals (data/stream.py "
             "transfer_stats), one series per key",
             labelnames=("key",))

@@ -27,6 +27,7 @@ import threading
 from abc import ABC, abstractmethod
 
 from neuroimagedisttraining_tpu.distributed.message import Message
+from neuroimagedisttraining_tpu.obs import names as obs_names
 
 BASE_PORT = 50000  # parity: gRPC backend's 50000 + rank (grpc_server.py)
 
@@ -98,18 +99,18 @@ class QueueDispatchMixin:
         rank = str(getattr(self, "rank", getattr(self, "client_id", "?")))
         lab = dict(rank=rank)
         self._obs_bytes_sent = obs_metrics.counter(
-            "nidt_comm_bytes_sent_total",
+            obs_names.COMM_BYTES_SENT,
             "bytes put on the wire by this transport (frame incl. "
             "length prefix)", labelnames=("rank",)).labels(**lab)
         self._obs_bytes_recv = obs_metrics.counter(
-            "nidt_comm_bytes_recv_total",
+            obs_names.COMM_BYTES_RECV,
             "bytes received off the wire by this transport",
             labelnames=("rank",)).labels(**lab)
         self._obs_frames_sent = obs_metrics.counter(
-            "nidt_comm_frames_sent_total", "frames sent",
+            obs_names.COMM_FRAMES_SENT, "frames sent",
             labelnames=("rank",)).labels(**lab)
         self._obs_frames_recv = obs_metrics.counter(
-            "nidt_comm_frames_recv_total", "frames received",
+            obs_names.COMM_FRAMES_RECV, "frames received",
             labelnames=("rank",)).labels(**lab)
 
     def _count_sent(self, n: int) -> None:

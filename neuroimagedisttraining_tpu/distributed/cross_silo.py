@@ -40,6 +40,8 @@ from neuroimagedisttraining_tpu.obs import flight as obs_flight
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.utils.pytree import tree_weighted_mean
+from neuroimagedisttraining_tpu.obs import names as obs_names
+from neuroimagedisttraining_tpu.obs import rules as obs_rules
 
 log = logging.getLogger("neuroimagedisttraining_tpu.cross_silo")
 
@@ -321,29 +323,29 @@ class FedAvgServer(ServerManager):
         # DECISION (drop/strike/quarantine/deadline/rejoin/ef-reset);
         # the registry gets the numbers a scrape wants live.
         self._obs_uploads = obs_metrics.counter(
-            "nidt_sync_uploads_total",
+            obs_names.SYNC_UPLOADS,
             "sync-server upload admission verdicts",
             labelnames=("outcome",))
         self._obs_round_wall = obs_metrics.histogram(
-            "nidt_sync_round_wall_seconds",
+            obs_names.SYNC_ROUND_WALL,
             "wall time from a round's sync broadcast to its completion",
             buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                      120.0, 300.0))
         self._obs_quorum_wait = obs_metrics.histogram(
-            "nidt_sync_quorum_wait_seconds",
+            obs_names.SYNC_QUORUM_WAIT,
             "wall time from a round's FIRST accepted upload to its "
             "aggregation (how long the earliest silo waited on the "
             "barrier)",
             buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
                      60.0))
         self._obs_round_gauge = obs_metrics.gauge(
-            "nidt_server_round", "current server round/version index")
+            obs_names.SERVER_ROUND, "current server round/version index")
         self._obs_suspects = obs_metrics.gauge(
-            "nidt_server_suspects", "clients currently marked suspect")
+            obs_names.SERVER_SUSPECTS, "clients currently marked suspect")
         self._obs_strikes = obs_metrics.counter(
-            "nidt_byz_strikes_total", "value-anomaly strikes issued")
+            obs_names.BYZ_STRIKES, "value-anomaly strikes issued")
         self._obs_quarantines = obs_metrics.counter(
-            "nidt_byz_quarantines_total", "silo quarantines entered")
+            obs_names.BYZ_QUARANTINES, "silo quarantines entered")
         #: wall anchors for round_wall / quorum_wait (monotonic; None
         #: until the first broadcast / first upload of the round)
         self._round_t0: float | None = None
@@ -445,14 +447,29 @@ class FedAvgServer(ServerManager):
         step = acct.rdp_gaussian(1.0, z)
         eps = {}
         eps_gauge = obs_metrics.gauge(
-            "nidt_dp_epsilon_silo",
+            obs_names.DP_EPSILON_SILO,
             "running weak_dp epsilon per silo (server RDP ledger, "
             "privacy/accountant.py)", labelnames=("silo",))
+        # burn RATE alongside the running total (ISSUE 15 satellite):
+        # what THIS round cost each silo — the series a budget
+        # burn-rate rule can watch; label scheme matches the engine
+        # ledger's source-labeled registration (engines/base.py)
+        burn_gauge = obs_metrics.gauge(
+            obs_names.DP_EPSILON_PER_ROUND,
+            "epsilon spent by the last accounted round (the budget "
+            "burn rate --dp_epsilon_budget is judged against)",
+            labelnames=("source",))
         for c in senders:
+            prev = self._dp_rdp.get(c)
+            prev_eps = (acct.rdp_to_epsilon(prev,
+                                            delta=self.dp_delta)[0]
+                        if prev is not None else 0.0)
             self._dp_rdp[c] = self._dp_rdp.get(c, 0.0) + step
             eps[c] = acct.rdp_to_epsilon(self._dp_rdp[c],
                                          delta=self.dp_delta)[0]
             eps_gauge.labels(silo=c).set(float(eps[c]))
+            burn_gauge.labels(source=f"silo{c}").set(
+                float(eps[c] - prev_eps))
         return {"norm_bound": self.norm_bound, "stddev": self.stddev,
                 "noise_multiplier": round(z, 6), "delta": self.dp_delta,
                 "epsilon_per_silo": {c: round(e, 4)
@@ -822,6 +839,12 @@ class FedAvgServer(ServerManager):
         self.round_idx += 1
         self._obs_round_gauge.set(self.round_idx)
         self._obs_suspects.set(len(self._suspect))
+        # training-health boundary (ISSUE 15): every completed round is
+        # a host boundary — the armed anomaly rules must see ONE
+        # evaluation per round (debounce/window semantics are
+        # round-indexed), not whatever cadence a /healthz poller
+        # happens to scrape at; unarmed processes no-op
+        obs_rules.observe_boundary(self.round_idx)
         if self.round_idx >= self.comm_round:
             if self._timer is not None:
                 self._timer.cancel()

@@ -579,6 +579,21 @@ def main(argv=None) -> int:
                     help="flight-recorder dump path: written at end of "
                          "run on the server rank and on any fatal "
                          "failure (failure_context); empty = dumps off")
+    # training-health plane (obs/rules.py, ISSUE 15) — server rank only
+    ap.add_argument("--health_rules", type=str, default="",
+                    help="JSON anomaly-rule manifest extending the "
+                         "built-in set (obs/rules.py) on the SERVER "
+                         "rank; unknown metric names fail at startup "
+                         "against the declared-name list (obs/names.py)")
+    ap.add_argument("--health_gate", action="store_true",
+                    help="server rank exits nonzero when the run's "
+                         "WORST health status was not ok (any anomaly "
+                         "rule fired); the machine-readable verdict "
+                         "rides the end-of-run JSON either way")
+    ap.add_argument("--dp_epsilon_budget", type=float, default=0.0,
+                    help="epsilon budget the built-in DP health rules "
+                         "judge against (dp-budget-exceeded / "
+                         "dp-burn-rate); 0 = no budget rules")
     ap.add_argument("--client_mesh", type=int, default=0,
                     help="accepted for config parity with the main CLI; "
                          "each cross-silo rank trains only its own silo, "
@@ -586,6 +601,21 @@ def main(argv=None) -> int:
                          "(cohort sharding lives in the simulated "
                          "engines, parallel/cohort.py)")
     args = ap.parse_args(argv)
+    if args.dp_epsilon_budget < 0:
+        ap.error(f"--dp_epsilon_budget must be >= 0 (got "
+                 f"{args.dp_epsilon_budget})")
+    if args.health_rules:
+        # manifest errors (bad JSON, unknown metric names, bad
+        # comparators) die at argparse on every rank that was handed
+        # the flag — never as a silently-never-firing rule mid-run
+        from neuroimagedisttraining_tpu.obs import names as obs_names
+        from neuroimagedisttraining_tpu.obs import rules as obs_rules
+
+        try:
+            for r in obs_rules.load_rules(args.health_rules):
+                r.validate(obs_names.DECLARED)
+        except (OSError, ValueError, TypeError) as e:
+            ap.error(f"--health_rules: {e}")
     if args.peak_flops > 0:
         # arm the MFU denominator on every rank (silo ranks dispatch
         # the training programs; the server rank's /healthz compute
@@ -916,6 +946,20 @@ def main(argv=None) -> int:
             failure_context,
         )
 
+        # anomaly-rule engine on the server rank (obs/rules.py, ISSUE
+        # 15): built-ins parameterized by this federation's knobs +
+        # the --health_rules manifest; evaluated at every version
+        # advance (asyncfl) and at each liveness probe, reported in
+        # /healthz and gated at exit
+        from neuroimagedisttraining_tpu.obs import health as obs_health
+        from neuroimagedisttraining_tpu.obs import rules as obs_rules
+
+        hrules = obs_rules.configure(
+            manifest_path=args.health_rules,
+            dp_epsilon_budget=args.dp_epsilon_budget,
+            comm_round=args.comm_round,
+            max_staleness=args.max_staleness)
+
         def _health() -> dict:
             # scrape-thread probe with a BOUNDED lock wait: _rlock is
             # held across whole aggregations (first-round XLA compile
@@ -932,8 +976,12 @@ def main(argv=None) -> int:
                 # profiler state is lock-free w.r.t. _rlock, and a
                 # wedged dispatch is exactly when the probe matters
                 return {"busy": True,
-                        "compute": obs_compute.PROFILER.health()}
+                        "compute": obs_compute.PROFILER.health(),
+                        "health": obs_rules.health_block()}
             try:
+                # rules evaluate once per completed round at the
+                # servers' own boundaries (cross_silo round completion /
+                # asyncfl version advance); the probe only REPORTS
                 h = {"round": int(server.round_idx),
                      "registered": len(server._registered),
                      "suspects": len(server._suspect),
@@ -941,7 +989,15 @@ def main(argv=None) -> int:
                      # MFU sample / recompile count — distinguishes a
                      # WEDGED-dispatch federation (age grows, counts
                      # stall) from a slow one at the liveness probe
-                     "compute": obs_compute.PROFILER.health()}
+                     "compute": obs_compute.PROFILER.health(),
+                     # fast-path coverage (ISSUE 15 satellite): the
+                     # fallback totals next to the compute block — a
+                     # silently-degraded run reads differently from a
+                     # healthy one right at the probe
+                     "fallbacks": obs_health.fallback_block(
+                         server.fanin.merged_snapshot()
+                         if args.ingest_workers else None),
+                     "health": obs_rules.health_block()}
                 if args.async_server:
                     h["buffered"] = (server._pending()
                                      if args.ingest_workers
@@ -989,6 +1045,11 @@ def main(argv=None) -> int:
                     obs_trace.dump()
             if msrv is not None:
                 msrv.close()
+            if not clean_exit:
+                # crash path: the rule engine's lifetime is the run's
+                # (the success path disarms after the final boundary
+                # evaluation below)
+                obs_rules.disarm()
         if broker is not None:
             broker.stop()
         norm = float(np.sqrt(sum(
@@ -1015,6 +1076,22 @@ def main(argv=None) -> int:
             # run-end privacy audit: per-silo (epsilon, delta) from the
             # weak_dp RDP ledger (privacy/accountant.py)
             extra["dp"] = dp
+        # end-of-run health verdict (ISSUE 15): one final boundary
+        # evaluation at the last completed version, then the
+        # machine-readable verdict rides the result JSON (run_report
+        # joins it); --health_gate turns a non-ok WORST status into a
+        # nonzero exit
+        if args.async_server:
+            server._observe_health_boundary()
+        else:
+            obs_rules.observe_boundary(int(server.round_idx))
+        health_verdict = hrules.verdict()
+        obs_rules.disarm()
+        extra["health"] = {
+            k: health_verdict[k]
+            for k in ("status", "worst_status", "alerts_total",
+                      "rounds_evaluated")}
+        extra["health_timeline"] = health_verdict["timeline"]
         print(json.dumps({"rounds_completed": len(server.history),
                           "clients": args.num_clients,
                           "secure": bool(args.secure),
@@ -1029,6 +1106,14 @@ def main(argv=None) -> int:
                           "byz_stats": server.byz_stats,
                           "final_param_norm": round(norm, 6),
                           **extra, **stats}), flush=True)
+        if args.health_gate and health_verdict["worst_status"] != "ok":
+            # stderr: the last stdout line stays the result JSON the
+            # bench/smoke scripts parse
+            print(f"[health] gate FAILED: worst status "
+                  f"{health_verdict['worst_status']!r} "
+                  f"({health_verdict['alerts_total']} alert(s))",
+                  file=sys.stderr, flush=True)
+            return 1
         return 0
 
     train_fn, wire_masks = _make_train_fn(args)

@@ -32,7 +32,10 @@ from neuroimagedisttraining_tpu.faults.schedule import (
 )
 from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.obs import compute as obs_compute
+from neuroimagedisttraining_tpu.obs import health as obs_health
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import names as obs_names
+from neuroimagedisttraining_tpu.obs import rules as obs_rules
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
 from neuroimagedisttraining_tpu.utils import checkpoint as ckpt
@@ -289,6 +292,17 @@ class FederatedEngine:
         #: device-side non-finite-upload counts queued per round; synced
         #: in one batched device_get at host boundaries (_flush_nonfinite)
         self._nonfinite_pending: list = []
+        #: in-dispatch training-health stats queued per dispatch (ISSUE
+        #: 15): ``(k, stacked, {stat: device array})`` entries the
+        #: builder's dispatch wrapper appends; drained in the SAME
+        #: batched device_get as the non-finite counts — never a
+        #: per-round sync
+        self._health_pending: list = []
+        #: monotonic sequence / round watermark of the metrics JSONL
+        #: sink (ISSUE 15 satellite: every record carries a round +
+        #: seq so run_report joins series without timestamp heuristics)
+        self._metrics_seq = 0
+        self._metrics_last_round: int | None = None
         # cohort sharding (--client_mesh, ISSUE 6): hard config errors
         # fail here; engines/modes whose rounds cannot shard announce the
         # unsharded fallback ONCE, up front (the fused-dispatch pattern)
@@ -817,6 +831,61 @@ class FederatedEngine:
         in one batched transfer at the next host boundary."""
         self._nonfinite_pending.append(n_bad)
 
+    # ---------- training-health plane (obs/health.py, ISSUE 15) ----------
+
+    def _note_health(self, stats: dict, k: int = 1,
+                     stacked: bool = False) -> None:
+        """Queue one dispatch's health-stats pytree (device arrays —
+        the builder's dispatch wrapper calls this, never a driver).
+        ``k`` rounds per dispatch; ``stacked`` marks scan-fused values
+        with a leading [K] round axis. Drained at ``_flush_nonfinite``
+        in the same batched device_get as the non-finite counts."""
+        self._health_pending.append((int(k), bool(stacked), stats))
+
+    def _drain_health(self, entries: list, host_vals: list,
+                      round_idx: int) -> None:
+        """Publish the drained health stats round by round. Dispatches
+        between two host boundaries cover CONTIGUOUS rounds ending at
+        the flush round (the drivers' loop invariant), so the round
+        index of every entry is reconstructed backward from
+        ``round_idx`` — no per-dispatch round plumbing through the
+        legacy adapters. Each published round also lands one metrics
+        JSONL record and one rule-engine boundary evaluation."""
+        total = sum(k for k, _, _ in entries)
+        r = round_idx - total + 1
+        for (k, stacked, _), host in zip(entries, host_vals):
+            for i in range(k):
+                if stacked:
+                    row = {n: np.asarray(v)[i] for n, v in host.items()}
+                else:
+                    row = host
+                obs_health.publish_round_stats(self.name, r, row)
+                if r < round_idx:
+                    # the flush round itself dumps/evaluates in
+                    # publish_stat_info, AFTER the stat/DP gauges of
+                    # this boundary are set
+                    self._dump_metrics_jsonl(r)
+                    obs_rules.observe_boundary(r)
+                r += 1
+
+    def _dump_metrics_jsonl(self, round_idx: int) -> None:
+        """One metrics JSONL record per round (``--metrics_out``), each
+        carrying the monotonic ``round`` + ``seq`` join keys
+        (run_report joins series on them, never on timestamps).
+        Re-flushing an already-recorded round is a no-op — boundaries
+        and end-of-run paths may land on the same round."""
+        path = getattr(self.cfg, "metrics_out", "")
+        if not path:
+            return
+        if self._metrics_last_round is not None \
+                and round_idx <= self._metrics_last_round:
+            return
+        self._metrics_seq += 1
+        self._metrics_last_round = int(round_idx)
+        obs_metrics.REGISTRY.dump_jsonl(
+            path, round=int(round_idx), seq=self._metrics_seq,
+            engine=self.name)
+
     def _flush_nonfinite(self, round_idx: int) -> None:
         """Drain the queued counts (one batched device_get) and emit the
         counted warning when any upload was rejected. Call at host-sync
@@ -829,11 +898,18 @@ class FederatedEngine:
         records here instead of asking each engine for a second hook —
         and as the OBS boundary (ISSUE 9): the stat_info accumulators
         publish into the metrics registry here, where the driver already
-        blocks on device results, never from inside a dispatch."""
+        blocks on device results, never from inside a dispatch. The
+        training-health stats the round programs queued (ISSUE 15) ride
+        the SAME batched device_get — armed health adds zero sync
+        points to a run."""
         self.record_privacy(round_idx)
-        if self._nonfinite_pending:
+        if self._nonfinite_pending or self._health_pending:
+            health_entries = self._health_pending
+            self._health_pending = []
             with obs_trace.span("flush_nonfinite", round=round_idx):
-                counts = jax.device_get(self._nonfinite_pending)
+                counts, health_vals = jax.device_get(
+                    (self._nonfinite_pending,
+                     [e[2] for e in health_entries]))
             self._nonfinite_pending.clear()
             total = int(sum(np.sum(np.asarray(c)) for c in counts))
             if total:
@@ -844,6 +920,9 @@ class FederatedEngine:
                     "offending clients were zero-weighted for their "
                     "rounds (%d rejected so far this run)", round_idx,
                     total, int(self.stat_info["nonfinite_uploads"]))
+            if health_entries:
+                self._drain_health(health_entries, health_vals,
+                                   round_idx)
         self.publish_stat_info(round_idx)
 
     def publish_stat_info(self, round_idx: int) -> None:
@@ -854,7 +933,7 @@ class FederatedEngine:
         Host-boundary only: the callers are ``_flush_nonfinite`` and
         run-end paths, both already synced."""
         g = obs_metrics.gauge(
-            "nidt_stat", "engine stat_info accumulators "
+            obs_names.STAT, "engine stat_info accumulators "
             "(engines/base.py), one series per key",
             labelnames=("key",))
         for k, v in self.stat_info.items():
@@ -864,13 +943,26 @@ class FederatedEngine:
             d = self.stat_info.get(src)
             if isinstance(d, dict) and d.get("epsilon_per_round"):
                 obs_metrics.gauge(
-                    "nidt_dp_epsilon",
+                    obs_names.DP_EPSILON,
                     "running (epsilon, delta) privacy cost of the armed "
                     "noise path (privacy/accountant.py)",
                     labelnames=("source",)).labels(source=src).set(
                     float(d["epsilon"]))
+                # epsilon burn RATE (ISSUE 15 satellite): what the last
+                # accounted round cost — the built-in dp-burn-rate rule
+                # and the run report's epsilon ledger read this next to
+                # the running total
+                per = d["epsilon_per_round"]
+                burn = (per[-1] - per[-2]) if len(per) > 1 else per[-1]
+                obs_metrics.gauge(
+                    obs_names.DP_EPSILON_PER_ROUND,
+                    "epsilon spent by the last accounted round (the "
+                    "budget burn rate --dp_epsilon_budget is judged "
+                    "against)",
+                    labelnames=("source",)).labels(source=src).set(
+                    float(burn))
         obs_metrics.gauge(
-            "nidt_engine_round",
+            obs_names.ENGINE_ROUND,
             "last round index flushed at an engine host boundary",
         ).set(int(round_idx))
         # compute-plane boundary (ISSUE 14): this is a host point where
@@ -878,6 +970,12 @@ class FederatedEngine:
         # can close its MFU window (flops dispatched since the last
         # boundary / synced wall) without adding any sync
         obs_compute.PROFILER.boundary(self.name)
+        # training-health boundary (ISSUE 15): one metrics JSONL record
+        # + one rule-engine evaluation per boundary round — both no-ops
+        # when the drained health stats already covered this round (or
+        # when the sink / rule engine is unarmed)
+        self._dump_metrics_jsonl(round_idx)
+        obs_rules.observe_boundary(round_idx)
 
     # ---------- compute-plane profiler (obs/compute.py, ISSUE 14) ----------
 
@@ -1019,9 +1117,22 @@ class FederatedEngine:
         """Post-round diagnosability for the jitted mask-evolution paths
         (ADVICE r5): an all-False evolved mask — the footprint of a NaN
         poisoning fire/regrow's magnitude ranks — must be VISIBLE, not a
-        silent collapse of the comm metrics. Returns per-client nnz."""
+        silent collapse of the comm metrics. Returns per-client nnz.
+
+        Doubles as the mask-health boundary for engines whose masks
+        evolve OUTSIDE a declared round body (dispfl's chunked host
+        driver, ISSUE 15): the nnz fetch this call already makes IS the
+        density measurement, so ``nidt_health_mask_density`` publishes
+        here with no added sync."""
         nnz = np.asarray(jax.device_get(
             self._mask_nnz_jit(masks_stacked)))[: self.real_clients]
+        per_client = sum(
+            float(np.prod(x.shape[1:]))
+            for x in jax.tree.leaves(masks_stacked))
+        if per_client > 0 and nnz.size:
+            obs_health.publish_mask_density(
+                self.name, round_idx,
+                float(np.mean(nnz) / per_client))
         if (nnz == 0).any():
             dead = np.flatnonzero(nnz == 0).tolist()
             self.log.warning(

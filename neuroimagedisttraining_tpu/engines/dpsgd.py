@@ -445,7 +445,11 @@ class DPSGDEngine(FederatedEngine):
                             self.round_lr(round_idx), plan_arrays)
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
-                self.record_privacy(round_idx)
+                # the shared OBS/health boundary: record_privacy runs
+                # first inside the flush (the historic dpsgd call), and
+                # the stat/DP/health gauges + rule evaluation publish
+                # at this already-synced point (engines/base.py)
+                self._flush_nonfinite(round_idx)
                 mg = self._eval_g(g_params, g_bstats)
                 mp = self._eval_p(per_params, per_bstats)
                 self.stat_info["global_test_acc"].append(mg["acc"])

@@ -185,6 +185,9 @@ class LocalEngine(FederatedEngine):
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 m = self._eval_p(per_params, per_bstats)
+                # the shared OBS/health boundary (engines/base.py) —
+                # the eval above already synced
+                self._flush_nonfinite(round_idx)
                 self.stat_info["person_test_acc"].append(m["acc"])
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx,

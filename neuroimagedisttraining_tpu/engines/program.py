@@ -59,7 +59,9 @@ import numpy as np
 from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.faults import adversary
 from neuroimagedisttraining_tpu.obs import compute as obs_compute
+from neuroimagedisttraining_tpu.obs import health as obs_health
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import names as obs_names
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
 
@@ -143,7 +145,7 @@ def report_fallback(engine_name: str, key: str) -> str:
     ``nidt_fallback_total{plane, engine, reason}``."""
     plane, msg = REASONS[key]
     obs_metrics.counter(
-        "nidt_fallback_total",
+        obs_names.FALLBACK_TOTAL,
         "fast-path fallback announcements by plane (fused dispatch / "
         "cohort sharding / fused streaming), engine, and reason key "
         "(engines/program.py REASONS)",
@@ -225,6 +227,11 @@ class RoundStages:
     consume ``per_round`` operands, ``(round_idx, k) -> WindowInputs``.
     ``extra_hooked``: extra host-boundary predicate for the window
     planner (e.g. dpsgd's every-100-rounds fine-tune pass).
+    ``health``: engine-private health-stats stage for the in-dispatch
+    training-health leg (ISSUE 15), ``(ctx, tr, new_carry) -> dict`` of
+    scalar stats named by ``health_outputs`` (the masked engines emit
+    ``obs/health.py MASK_STAT_NAMES``); traced with the round, emitted
+    only when ``--health_stats`` arms the leg.
     """
 
     carry: tuple[str, ...]
@@ -241,6 +248,8 @@ class RoundStages:
     codec_masks: Callable | None = None
     window_extras: Callable | None = None
     extra_hooked: Callable | None = None
+    health: Callable | None = None
+    health_outputs: tuple[str, ...] = ()
 
 
 @dataclasses.dataclass
@@ -507,6 +516,98 @@ def secure_quant_aggregate(eng, upload, ref, w, losses, rngs=None):
     return agg["params"], agg["batch_stats"], mean_loss, n_bad
 
 
+def health_update_stats(upload, ref, new_params, w) -> dict:
+    """The builder's default in-dispatch training-health leg (ISSUE
+    15): per-client update L2 norms vs the round's broadcast params,
+    cosine similarity of each client update to the aggregated update,
+    update-norm dispersion, and the global param / aggregate-update
+    norms — all pure jnp on values the round body already holds, traced
+    with the round and threaded through the fused-K scan like any other
+    output. Names/semantics: ``obs/health.py UPDATE_STAT_NAMES`` (the
+    host-side publisher); batch_stats are running moments, not an
+    optimization direction, so the geometry is measured on params only.
+
+    ``upload`` is the post-attack/post-codec payload the aggregation
+    consumed — the wire's truth, which is exactly what a divergence
+    rule should judge.
+
+    The cosine is LEAVE-ONE-OUT: client i scores against the aggregate
+    minus its own weighted contribution. Against the raw aggregate, a
+    sign-flipping silo's own mass flips its cosine back toward +1
+    (measured: +0.09 for a 1/3-weight flipped client whose honest twin
+    reads -0.5), burying exactly the signal the divergence rule exists
+    for. The subtraction is exact for the weighted-mean tail and an
+    approximation under robust defenses — a diagnostic, not a parity
+    surface. Everything reduces to per-client dot products, so the
+    leave-one-out costs nothing extra."""
+    up = [x.astype(jnp.float32) for x in jax.tree.leaves(upload["params"])]
+    rf = [x.astype(jnp.float32) for x in jax.tree.leaves(ref["params"])]
+    nw = [x.astype(jnp.float32) for x in jax.tree.leaves(new_params)]
+    C = int(up[0].shape[0]) if up else 1
+    sq = jnp.zeros((C,), jnp.float32)
+    dots = jnp.zeros((C,), jnp.float32)
+    agg_sq = jnp.float32(0.0)
+    gsq = jnp.float32(0.0)
+    for u, r, n in zip(up, rf, nw):
+        du = (u - r[None]).reshape(C, -1)
+        da = (n - r).reshape(-1)
+        sq = sq + jnp.sum(du * du, axis=1)
+        dots = dots + du @ da
+        agg_sq = agg_sq + jnp.sum(da * da)
+        gsq = gsq + jnp.sum(n.reshape(-1) ** 2)
+    norms = jnp.sqrt(sq)
+    agg_norm = jnp.sqrt(agg_sq)
+    wf = w.astype(jnp.float32)
+    p = wf / jnp.maximum(jnp.sum(wf), jnp.float32(1e-12))
+    # leave-one-out: loo_i = agg - p_i * d_i (direction of everyone
+    # else's mass; the (W - w_i)/W scale cancels in the cosine)
+    loo_dot = dots - p * sq
+    loo_sq = jnp.maximum(agg_sq - 2.0 * p * dots + p * p * sq,
+                         jnp.float32(0.0))
+    cos = loo_dot / jnp.maximum(norms * jnp.sqrt(loo_sq),
+                                jnp.float32(1e-12))
+    med = jnp.median(norms)
+    return {
+        "h_up_norms": norms,
+        "h_up_max": jnp.max(norms),
+        "h_up_med": med,
+        "h_cos_min": jnp.min(cos),
+        "h_cos_mean": jnp.mean(cos),
+        "h_disp": jnp.max(norms) / jnp.maximum(med, jnp.float32(1e-12)),
+        "h_gnorm": jnp.sqrt(gsq),
+        "h_agg_up": agg_norm,
+    }
+
+
+def mask_health_stats(new_masks, old_masks) -> dict:
+    """Mask-health stats (``obs/health.py MASK_STAT_NAMES``) for a
+    masked engine's ``RoundStages.health`` hook: mean kept fraction,
+    round-over-round kept-weight overlap, and churn — computed over
+    congruent mask pytrees (client-stacked or global) inside the round
+    body. ``old_masks=None`` (a static mask) reads as overlap 1."""
+    kept = jnp.float32(0.0)
+    total = 0.0
+    both = jnp.float32(0.0)
+    was = jnp.float32(0.0)
+    old_leaves = (jax.tree.leaves(old_masks) if old_masks is not None
+                  else None)
+    for i, m in enumerate(jax.tree.leaves(new_masks)):
+        mb = m > 0
+        kept = kept + jnp.sum(mb)
+        total += float(np.prod(m.shape))
+        if old_leaves is not None:
+            ob = old_leaves[i] > 0
+            both = both + jnp.sum(mb & ob)
+            was = was + jnp.sum(ob)
+    density = kept / jnp.float32(max(total, 1.0))
+    if old_masks is None:
+        overlap = jnp.float32(1.0)
+    else:
+        overlap = both / jnp.maximum(was, jnp.float32(1.0))
+    return {"h_mask_density": density, "h_mask_overlap": overlap,
+            "h_mask_churn": jnp.float32(1.0) - overlap}
+
+
 def _codec_stage(eng, stages: RoundStages, ctx: RoundCtx, upload, efs):
     """The wire codec's lossy roundtrip over the whole upload payload
     (codec/device.py) — delta vs the round's broadcast reference,
@@ -576,6 +677,22 @@ class RoundProgram:
                 "feedback — declare one")
         self.eng = eng
         self.stages = stages
+        if stages is not None and (stages.health is None) \
+                != (not stages.health_outputs):
+            raise ValueError(
+                f"{type(eng).__name__}: RoundStages.health and "
+                "health_outputs must be declared together (the hook's "
+                "returned stat names ARE the flattened-output order)")
+        #: the in-dispatch training-health leg (ISSUE 15): stat names
+        #: appended after the declared outputs (and the EF tail) when
+        #: --health_stats arms it; the dispatch wrapper strips them
+        #: back off and queues the device values, so every legacy
+        #: driver/adapter arity is untouched
+        self.health_names: tuple[str, ...] = ()
+        if stages is not None and getattr(eng.cfg, "health_stats",
+                                          False):
+            self.health_names = obs_health.stat_names_for(
+                stages.carry, stages.health_outputs)
         self.built = 0
         self.dispatches = 0
         #: builds per exact plan-cache key — a key building TWICE is a
@@ -819,6 +936,31 @@ class RoundProgram:
             new_carry.update(st.update(ctx, tr, new_carry))
         missing = set(st.carry) - set(new_carry)
         assert not missing, f"stages left carry entries unset: {missing}"
+        if self.health_names:
+            # the in-dispatch training-health leg (ISSUE 15): pure jnp
+            # over values this body already computed, traced with the
+            # round and returned as trailing outputs — no host touch,
+            # no extra dispatch, and the carry math above is untouched
+            # (the armed-vs-disarmed bitwise pin, tests/test_health.py)
+            hs: dict = {}
+            if obs_health.UPDATE_STAT_NAMES[0] in self.health_names:
+                measured = upload
+                if measured is None and tr.state is not None:
+                    measured = {"params": tr.state.params,
+                                "batch_stats": tr.state.batch_stats}
+                if measured is None:
+                    raise ValueError(
+                        f"{type(eng).__name__}: health stats need an "
+                        "upload payload (or TrainOut.state) to measure "
+                        "— the declared train stage returned neither")
+                hs.update(health_update_stats(
+                    measured, ctx.upload_ref, new_carry["params"], w))
+            if st.health is not None:
+                hs.update(st.health(ctx, tr, new_carry))
+            missing_h = set(self.health_names) - set(hs)
+            assert not missing_h, \
+                f"health stage left stats unset: {missing_h}"
+            outs = dict(outs, **{n: hs[n] for n in self.health_names})
         efs_tail = ()
         if eng.wire_spec is not None:
             efs_tail = (new_efs, u0) if st.uses_ef else (u0,)
@@ -833,8 +975,12 @@ class RoundProgram:
     def _flat(self, new_carry: dict, epi: tuple, outs: dict,
               efs_tail: tuple) -> tuple:
         st = self.stages
+        # health stats ride LAST (after the EF tail) so the dispatch
+        # wrapper can strip a fixed-length suffix without knowing the
+        # program variant's tail shape
         return (*(new_carry[n] for n in st.carry), *epi,
-                *(outs[o] for o in st.outputs), *efs_tail)
+                *(outs[o] for o in st.outputs), *efs_tail,
+                *(outs[h] for h in self.health_names))
 
     def _note_build(self, label: str, key: tuple) -> None:
         """One program compilation: ``built`` and the scrapeable
@@ -847,7 +993,8 @@ class RoundProgram:
         obs_compute.note_compile(self.eng.name, label, recompile=n > 1)
 
     def _count_dispatches(self, jitted, label: str = "round",
-                          rounds: int = 1):
+                          rounds: int = 1,
+                          health_stacked: bool = False):
         """Wrap a compiled program so invocations count toward
         ``dispatches`` (the bench's per-engine dispatch evidence) and
         feed the dispatch-boundary profiler (obs/compute.py): host
@@ -857,9 +1004,18 @@ class RoundProgram:
         numerator. No sync is added anywhere: the clock brackets the
         ENQUEUE, and MFU divides by boundary-to-boundary wall where
         the driver already blocked. ``.jit``/``.lower`` expose the
-        underlying executable for compile-text tests."""
+        underlying executable for compile-text tests.
+
+        When the training-health leg is armed, the program's trailing
+        ``health_names`` outputs are stripped HERE and queued on the
+        engine as device arrays (``_note_health`` — drained in the
+        batched ``device_get`` at the next host boundary, never synced
+        per dispatch), so every legacy driver/adapter sees its historic
+        arity. ``health_stacked`` marks the scan-fused variants whose
+        health outputs carry a leading [K] round axis."""
         state = {"first": True}
         eng = self.eng
+        health_names = self.health_names
 
         def dispatch(*args):
             self.dispatches += 1
@@ -877,6 +1033,11 @@ class RoundProgram:
                 eng.name, label, dur, rounds=rounds,
                 phase="compile" if state["first"] else "execute")
             state["first"] = False
+            if health_names:
+                n_h = len(health_names)
+                eng._note_health(dict(zip(health_names, out[-n_h:])),
+                                 k=rounds, stacked=health_stacked)
+                out = out[:-n_h]
             return out
 
         dispatch.jit = jitted
@@ -953,7 +1114,8 @@ class RoundProgram:
                         static_key, n_real, shard)
                     return (tuple(new_carry[n]
                                   for n in self.stages.carry),
-                            tuple(outs[o] for o in self.stages.outputs))
+                            tuple(outs[o] for o in self.stages.outputs
+                                  + self.health_names))
 
                 xs = {"idx": idx, "rngs": rngs, "lr": lrs}
                 if byz is not None:
@@ -968,7 +1130,7 @@ class RoundProgram:
             return self._count_dispatches(jax.jit(
                 fused_round_fn,
                 donate_argnums=self.eng._donate_argnums(0)),
-                label=label, rounds=k)
+                label=label, rounds=k, health_stacked=True)
 
         return self.eng._plan_cached("_fused_round_jit_cache", key,
                                      build)
@@ -1035,7 +1197,8 @@ class RoundProgram:
                         None, None, False)
                     return (tuple(new_carry[n]
                                   for n in self.stages.carry),
-                            tuple(outs[o] for o in self.stages.outputs))
+                            tuple(outs[o] for o in self.stages.outputs
+                                  + self.health_names))
 
                 xs = {"X": Xs, "y": ys, "n": ns, "rngs": rngs, "lr": lrs}
                 if byz is not None:
@@ -1046,7 +1209,7 @@ class RoundProgram:
             return self._count_dispatches(jax.jit(
                 fused_stream_round_fn,
                 donate_argnums=self.eng._donate_argnums(0)),
-                label=label, rounds=k)
+                label=label, rounds=k, health_stacked=True)
 
         return self.eng._plan_cached("_fused_round_jit_cache",
                                      ("stream", k), build)

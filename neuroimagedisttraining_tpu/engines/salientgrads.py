@@ -33,6 +33,7 @@ from neuroimagedisttraining_tpu.core import robust
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.obs import health as obs_health
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import snip as snip_ops
@@ -183,7 +184,18 @@ class SalientGradsEngine(FederatedEngine):
             consts=("masks",),
             supports_attack=True,
             codec_masks=self._codec_masks,
+            health=self._health_stage,
+            health_outputs=obs_health.MASK_STAT_NAMES,
         )
+
+    def _health_stage(self, ctx, tr, new_carry) -> dict:
+        """Mask-health leg (ISSUE 15, armed under ``--health_stats``):
+        the phase-1 mask is a loop CONSTANT, so density is the whole
+        story (overlap pins at 1 — which is itself the signal: a
+        salientgrads run whose overlap moved would mean the const mask
+        was rebuilt mid-run)."""
+        return round_program.mask_health_stats(ctx.consts["masks"],
+                                               None)
 
     def _train_stage(self, ctx) -> round_program.TrainOut:
         """Masked local-train stage (post-step re-mask ``param *= mask``,

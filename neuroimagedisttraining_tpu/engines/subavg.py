@@ -49,6 +49,7 @@ from neuroimagedisttraining_tpu.core.losses import binary_auc
 from neuroimagedisttraining_tpu.core.trainer import ClientState
 from neuroimagedisttraining_tpu.engines import program as round_program
 from neuroimagedisttraining_tpu.engines.base import FederatedEngine
+from neuroimagedisttraining_tpu.obs import health as obs_health
 from neuroimagedisttraining_tpu.ops import flops as flops_ops
 from neuroimagedisttraining_tpu.ops import prune as P
 from neuroimagedisttraining_tpu.ops.masks import ones_mask
@@ -82,7 +83,18 @@ class SubFedAvgEngine(FederatedEngine):
             aggregate=self._aggregate_stage,
             update=self._update_stage,
             outputs=("loss", "mean_dist", "n_accept", "up_nnz"),
+            health=self._health_stage,
+            health_outputs=obs_health.MASK_STAT_NAMES,
         )
+
+    def _health_stage(self, ctx, tr, new_carry) -> dict:
+        """Mask-health leg (ISSUE 15, armed under ``--health_stats``):
+        density of the sampled cohort's ACCEPTED masks plus their
+        round-over-round overlap/churn vs the masks the cohort entered
+        the round with — the in-dispatch mirror of
+        ``warn_if_masks_collapsed``'s post-hoc nnz fetch."""
+        return round_program.mask_health_stats(tr.extra["new_m"],
+                                               tr.extra["Ms"])
 
     def _train_stage(self, ctx) -> round_program.TrainOut:
         """The per-client composite: masked epoch-1 train -> fake_prune
@@ -374,6 +386,12 @@ class SubFedAvgEngine(FederatedEngine):
             if round_idx % cfg.fed.frequency_of_the_test == 0 \
                     or round_idx == cfg.fed.comm_round - 1:
                 mp = self.eval_masked_global(params, bstats, mask_pers)
+                # the shared OBS/health boundary (engines/base.py): the
+                # eval above already synced, so the queued in-dispatch
+                # health stats drain here (subavg has no n_bad output —
+                # the flush is its health/stat boundary, not a
+                # non-finite one)
+                self._flush_nonfinite(round_idx)
                 self.stat_info["person_test_acc"].append(mp["acc"])
                 self.log.metrics(round_idx, train_loss=loss,
                                  personal=mp,
@@ -388,6 +406,7 @@ class SubFedAvgEngine(FederatedEngine):
                 "params": params, "batch_stats": bstats,
                 "mask_pers": mask_pers, "history": history})
             round_idx += 1
+        self._flush_nonfinite(cfg.fed.comm_round - 1)
         m_person = self.eval_masked_global(params, bstats, mask_pers)
         self.log.metrics(-1, personal=m_person)
         densities = np.asarray(jax.device_get(jax.vmap(

@@ -228,6 +228,17 @@ class ComputeProfiler:
                 self._storm_warnings += 1
                 n = self._total_recompiles
         if recompile:
+            # scrapeable storm evidence (ISSUE 15): the recompile-storm
+            # anomaly rule (obs/rules.py) judges this counter — the
+            # health() block alone is not a metric series a rule or a
+            # Prometheus alert can watch
+            obs_metrics.counter(
+                "nidt_recompiles_total",
+                "mid-run rebuilds of an already-built program variant "
+                "(plan-cache thrash / shape leak — the recompile "
+                "storm)",
+                labelnames=("engine", "program")).labels(
+                engine=engine, program=program).inc()
             obs_flight.record("recompile", engine=engine,
                               program=program, total=n)
             if warn:

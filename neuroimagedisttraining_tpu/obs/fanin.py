@@ -340,6 +340,34 @@ class TelemetryFanIn:
     def metrics_view(self) -> _MergedMetricsView:
         return _MergedMetricsView(self)
 
+    def merged_snapshot(self) -> dict:
+        """Snapshot-form merge (the ``registry.snapshot()`` schema):
+        root cells unchanged, worker cells re-labeled with
+        ``worker="N"`` — what the anomaly-rule engine (obs/rules.py)
+        evaluates on the sharded ingest root, so a rule's label-subset
+        selector fires on a WORKER's labeled series exactly as it
+        would on a local one."""
+        merged: dict[str, dict] = {}
+
+        def _fold(snapshot: dict, extra: dict[str, str]) -> None:
+            for name, m in snapshot.items():
+                slot = merged.setdefault(
+                    name, {"kind": m["kind"], "help": m["help"],
+                           "values": []})
+                if slot["kind"] != m["kind"]:
+                    continue  # version skew — same rule as the text merge
+                for v in m["values"]:
+                    slot["values"].append(
+                        {"labels": {**v["labels"], **extra},
+                         "value": v["value"]})
+
+        _fold(self.registry.snapshot(), {})
+        with self._lock:
+            for w in self._workers.values():
+                if w.snapshot is not None:
+                    _fold(w.snapshot, {"worker": str(w.wid)})
+        return merged
+
     # ---- merged Prometheus exposition ----
 
     def prometheus_text(self) -> str:

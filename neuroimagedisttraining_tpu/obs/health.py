@@ -1,0 +1,214 @@
+"""Training-health publication: the host side of the in-dispatch
+federation statistics (ISSUE 15).
+
+The statistics themselves are COMPUTED inside the jitted round body —
+``engines/program.py`` emits a small stats pytree as trailing round
+outputs (per-client update L2 norms, cosine of each client update
+against the aggregated update, update-norm dispersion, global
+param/aggregate-update norms, mask density/overlap/churn for the
+masked engines), threaded through the fused-K scan exactly like
+``loss``/``n_bad``. The driver queues the device arrays per dispatch
+(``FederatedEngine._note_health``) and drains them in the SAME batched
+``device_get`` as the non-finite counts at the existing
+``_flush_nonfinite`` host boundary — zero added device syncs, the PR 14
+discipline.
+
+This module is what happens AFTER the fetch: each drained round's host
+scalars become ``nidt_health_*`` gauges (and the per-client norm
+histogram), labeled by engine, at the host boundary where the driver
+already blocked. The name constants live in ``obs/names.py`` (the
+declared set the rule engine validates against); the anomaly rules that
+consume these series live in ``obs/rules.py``.
+
+HOST-BOUNDARY RULE: everything here mutates the registry — never call
+from inside a traced body (nidtlint ``obs-discipline``). The traced
+half deliberately lives in ``engines/program.py``: a jnp helper in this
+package would trip the same lint that protects it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import names as N
+
+__all__ = [
+    "UPDATE_STAT_NAMES", "MASK_STAT_NAMES", "publish_round_stats",
+    "publish_mask_density", "fallback_block", "health_gauge",
+    "UPDATE_NORM_BUCKETS",
+]
+
+#: stats the builder's default leg emits per round for engines whose
+#: carry holds the global model (``{"params", "batch_stats"}``); order
+#: is the flattened-output order (engines/program.py appends them after
+#: the declared outputs and the EF tail, and the dispatch wrapper
+#: strips them back off before the legacy-arity drivers see the tuple)
+UPDATE_STAT_NAMES: tuple[str, ...] = (
+    "h_up_norms",    # [C] per-client update L2 norms vs the broadcast
+    "h_up_max",      # max over clients
+    "h_up_med",      # median over clients
+    "h_cos_min",     # min leave-one-out cosine: client update vs the
+                     # aggregate minus its own weighted contribution
+                     # (self-mass would flip a sign-flipper back to +)
+    "h_cos_mean",    # mean leave-one-out cosine over the cohort
+    "h_disp",        # dispersion: max norm / median norm
+    "h_gnorm",       # L2 norm of the NEW global params
+    "h_agg_up",      # L2 norm of the aggregated update (the round's
+                     # pseudo-gradient — "global grad norm" at the
+                     # server, where per-example grads never exist)
+)
+
+#: stats a masked engine's ``RoundStages.health`` hook emits
+#: (salientgrads/subavg declare exactly these names)
+MASK_STAT_NAMES: tuple[str, ...] = (
+    "h_mask_density",   # mean kept fraction over clients
+    "h_mask_overlap",   # round-over-round kept-weight overlap
+    "h_mask_churn",     # 1 - overlap
+)
+
+#: buckets for the per-client update-norm histogram: spans collapsed
+#: (~1e-6) through diverged (~1e3) updates on the flagship models
+UPDATE_NORM_BUCKETS = (1e-6, 1e-4, 1e-3, 1e-2, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0, 25.0, 100.0, 1000.0)
+
+#: stat-name -> (metric name, help) for the scalar gauges
+_GAUGE_OF: dict[str, tuple[str, str]] = {
+    "h_up_max": (N.HEALTH_UPDATE_NORM_MAX,
+                 "max per-client update L2 norm of the round"),
+    "h_up_med": (N.HEALTH_UPDATE_NORM_MED,
+                 "median per-client update L2 norm of the round"),
+    "h_cos_min": (N.HEALTH_COSINE_MIN,
+                  "min leave-one-out cosine: each client's update vs "
+                  "the aggregated update minus its own contribution "
+                  "(a sign-flipping silo reads strongly negative "
+                  "here; self-inclusion would mask it)"),
+    "h_cos_mean": (N.HEALTH_COSINE_MEAN,
+                   "mean leave-one-out cosine of client updates to "
+                   "the aggregated update"),
+    "h_disp": (N.HEALTH_DIVERGENCE,
+               "update-norm dispersion: max / median client update "
+               "norm (non-IID divergence blows this up before the "
+               "loss shows it)"),
+    "h_gnorm": (N.HEALTH_PARAM_NORM,
+                "L2 norm of the aggregated global params"),
+    "h_agg_up": (N.HEALTH_AGG_UPDATE_NORM,
+                 "L2 norm of the aggregated update (the server-side "
+                 "pseudo-gradient)"),
+    "h_mask_density": (N.HEALTH_MASK_DENSITY,
+                       "mean kept fraction of the engine's "
+                       "pruning/saliency masks"),
+    "h_mask_overlap": (N.HEALTH_MASK_OVERLAP,
+                       "round-over-round kept-weight overlap of the "
+                       "engine's masks"),
+    "h_mask_churn": (N.HEALTH_MASK_CHURN,
+                     "round-over-round mask churn (1 - overlap); a "
+                     "NaN-poisoned fire/regrow shows as a churn spike "
+                     "then a dead mask"),
+}
+
+
+def health_gauge(name: str, help: str) -> obs_metrics.Gauge:
+    """An engine-labeled health gauge (idempotent registration)."""
+    return obs_metrics.gauge(name, help, labelnames=("engine",))
+
+
+def _finite(v: Any) -> float | None:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+def publish_round_stats(engine: str, round_idx: int,
+                        stats: Mapping[str, Any]) -> None:
+    """Publish ONE drained round's host-side stats into the registry.
+
+    ``stats`` maps stat names (``h_*``) to host numpy values — scalars
+    for the gauges, the ``[C]`` per-client norm vector for the
+    histogram. Values that came back non-finite (a diverged round) are
+    still published: NaN in a gauge is itself the signal the
+    ``update-norm-collapse``/divergence rules react to via their
+    comparator semantics (NaN fails every comparison, so a rule never
+    fires ON NaN — the non-finite guard's ``n_bad`` path carries that
+    story instead)."""
+    for key, v in stats.items():
+        if key == "h_up_norms":
+            h = obs_metrics.histogram(
+                N.HEALTH_UPDATE_NORM,
+                "per-client update L2 norms vs the round's broadcast "
+                "model (one observe per client per round)",
+                labelnames=("engine",), buckets=UPDATE_NORM_BUCKETS)
+            for x in np.ravel(np.asarray(v)):
+                fx = _finite(x)
+                if fx is not None:
+                    h.labels(engine=engine).observe(fx)
+            continue
+        meta = _GAUGE_OF.get(key)
+        if meta is None:
+            continue  # engine-private stat without a declared gauge
+        f = _finite(v)
+        health_gauge(*meta).labels(engine=engine).set(
+            f if f is not None else float("nan"))
+    obs_metrics.gauge(
+        N.HEALTH_ROUND,
+        "last round whose in-dispatch health stats were published",
+        labelnames=("engine",)).labels(engine=engine).set(int(round_idx))
+
+
+def publish_mask_density(engine: str, round_idx: int,
+                         density: float) -> None:
+    """Mask density for engines whose masks evolve OUTSIDE a declared
+    round body (dispfl's chunked host driver): published from the
+    already-existing ``warn_if_masks_collapsed`` host boundary — the
+    nnz fetch that call makes anyway is the measurement."""
+    f = _finite(density)
+    health_gauge(*_GAUGE_OF["h_mask_density"]).labels(
+        engine=engine).set(f if f is not None else float("nan"))
+    obs_metrics.gauge(
+        N.HEALTH_ROUND,
+        "last round whose in-dispatch health stats were published",
+        labelnames=("engine",)).labels(engine=engine).set(int(round_idx))
+
+
+def fallback_block(snapshot: dict | None = None) -> dict:
+    """The ``/healthz`` fast-path-coverage block (ISSUE 15 satellite):
+    ``nidt_fallback_total{plane, engine, reason}`` totals next to the
+    PR 14 compute block — a silently-degraded run (everything falling
+    back to K=1 unsharded) reads differently from a healthy one at the
+    probe. ``snapshot`` defaults to the process registry; pass a
+    fan-in-merged snapshot on the sharded ingest root."""
+    snap = (snapshot if snapshot is not None
+            else obs_metrics.REGISTRY.snapshot())
+    m = snap.get(N.FALLBACK_TOTAL) or {}
+    rows: list[dict] = []
+    by_plane: dict[str, float] = {}
+    for cell in m.get("values", ()):
+        lb = cell.get("labels", {})
+        n = float(cell.get("value", 0.0))
+        rows.append({"plane": lb.get("plane", ""),
+                     "engine": lb.get("engine", ""),
+                     "reason": lb.get("reason", ""), "count": n})
+        by_plane[lb.get("plane", "")] = (
+            by_plane.get(lb.get("plane", ""), 0.0) + n)
+    return {"total": sum(by_plane.values()), "by_plane": by_plane,
+            "announcements": rows}
+
+
+def stat_names_for(carry: Iterable[str],
+                   extra: tuple[str, ...] = ()) -> tuple[str, ...]:
+    """The health-output name tuple the builder appends for a declared
+    round: the default update-stats leg arms when the carry holds the
+    global model (the engines whose train stage produces an upload to
+    measure), plus the engine's declared extra stat names (mask
+    health). Engines without a global model in the carry (local,
+    dpsgd's per-client consensus) get only their declared extras —
+    there is no broadcast reference to measure updates against."""
+    names: tuple[str, ...] = ()
+    if {"params", "batch_stats"} <= set(carry):
+        names = UPDATE_STAT_NAMES
+    return names + tuple(extra)

@@ -88,13 +88,14 @@ class ExperimentLogger:
         flat namespace, nested dicts flattened with ``_`` (the same
         flattening the JSONL reader would do)."""
         from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+        from neuroimagedisttraining_tpu.obs import names as obs_names
 
         g = obs_metrics.gauge(
-            "nidt_exp_metric",
+            obs_names.EXP_METRIC,
             "per-round experiment metrics (ExperimentLogger.metrics)",
             labelnames=("key",))
         obs_metrics.gauge(
-            "nidt_exp_round",
+            obs_names.EXP_ROUND,
             "last round index ExperimentLogger.metrics recorded",
         ).set(int(round_idx))
 

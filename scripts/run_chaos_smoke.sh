@@ -139,7 +139,8 @@ while time.time() < deadline:
             f"http://127.0.0.1:{port}/metrics", timeout=2).read().decode()
         if ('nidt_async_uploads_total{outcome="accepted"}' in body
                 and "nidt_async_staleness_bucket" in body
-                and "nidt_async_buffer_occupancy" in body):
+                and "nidt_async_buffer_occupancy" in body
+                and "nidt_alert{" in body):
             open(out, "w").write(body)
             sys.exit(0)
     except Exception:
@@ -192,6 +193,11 @@ for line in scrape.strip().splitlines():
 assert "nidt_async_staleness_bucket" in scrape
 assert "nidt_async_buffer_occupancy" in scrape
 assert 'nidt_async_uploads_total{outcome="accepted"}' in scrape
+# training-health cell (ISSUE 15): the anomaly-rule engine evaluates
+# at every version advance, so the MID-chaos scrape carries nidt_alert
+# samples (one per built-in rule, 0 while not firing)
+assert "nidt_alert{" in scrape, "no nidt_alert samples mid-chaos"
+assert 'rule="staleness-runaway"' in scrape, "builtin rules missing"
 # and the kill-k run left a parseable flight-recorder post-mortem
 flight = json.load(open(sys.argv[3]))
 kinds = [e["kind"] for e in flight["events"]]

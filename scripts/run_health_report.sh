@@ -1,0 +1,114 @@
+#!/usr/bin/env bash
+# Training-health exemplar (ISSUE 15): the seeded divergence scenario
+# and its clean twin, end to end through the shipped CLI.
+#
+#   1. clean twin: tiny synthetic fedavg run with the in-dispatch
+#      health stats leg armed (--health_stats), the per-round metrics
+#      JSONL sink (--metrics_out) and the health gate — must exit 0
+#      with zero alerts;
+#   2. divergence run: identical config plus a 1-of-4 sign-flip
+#      Byzantine silo (--fault_spec byz:1@R:sign_flip) — the
+#      client-divergence rule must fire (nidt_alert sample, flight
+#      `alert` event, degraded worst status) and --health_gate must
+#      exit NONZERO;
+#   3. analysis/run_report.py joins each run's metrics JSONL + health
+#      verdict into run_report.json/md; the two reports must visibly
+#      differ in the alert timeline;
+#   4. the combined exemplar lands in bench_matrix/health_report.json,
+#      regression-gated by analysis/bench_gate.py (the health_report
+#      SPEC) like every other committed artifact.
+#
+# Tiny and CPU-safe (the tier-1 test suite pins the same scenario as a
+# pytest cell); this script is the push-button artifact regenerator.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PY=${PYTHON:-python}
+OUT_DIR=${1:-bench_matrix}
+WORK=$(mktemp -d /tmp/nidt_health.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+# 64 subjects: enough shared signal that honest site updates COHERE
+# (clean leave-one-out cosines ~ +0.2..+0.4); at 24 subjects the tiny
+# task saturates instantly and honest non-IID pulls genuinely oppose
+# each other, which is divergence the rule would rightly flag
+COMMON=(--algorithm fedavg --dataset synthetic --model 3dcnn_tiny
+        --synthetic_num_subjects 64 --synthetic_shape 12 14 12
+        --client_num_in_total 4 --comm_round 3 --batch_size 8
+        --epochs 1 --lr 1e-3 --seed 1024 --log_dir "$WORK/LOG"
+        --health_stats --health_gate)
+
+echo "== clean twin =="
+$PY -m neuroimagedisttraining_tpu "${COMMON[@]}" --tag health_clean \
+    --metrics_out "$WORK/clean.metrics.jsonl"
+rc_clean=$?
+if [ $rc_clean -ne 0 ]; then
+    echo "FAIL: clean twin exited $rc_clean (expected 0: a healthy run"\
+         "must pass its gate)" >&2
+    exit 1
+fi
+
+echo "== 1-of-4 sign-flip divergence run =="
+$PY -m neuroimagedisttraining_tpu "${COMMON[@]}" --tag health_byz \
+    --metrics_out "$WORK/byz.metrics.jsonl" \
+    --fault_spec "byz:1@0:sign_flip,byz:1@1:sign_flip,byz:1@2:sign_flip"
+rc_byz=$?
+if [ $rc_byz -eq 0 ]; then
+    echo "FAIL: sign-flip run exited 0 (expected nonzero: the" \
+         "client-divergence rule must fire and fail the gate)" >&2
+    exit 1
+fi
+
+clean_verdict=$(ls "$WORK"/LOG/synthetic/*health_clean*.health.json)
+byz_verdict=$(ls "$WORK"/LOG/synthetic/*health_byz*.health.json)
+
+echo "== run_report on both runs =="
+$PY -m neuroimagedisttraining_tpu.analysis.run_report \
+    --metrics "$WORK/clean.metrics.jsonl" --verdict "$clean_verdict" \
+    --out "$WORK/report_clean" || exit 1
+$PY -m neuroimagedisttraining_tpu.analysis.run_report \
+    --metrics "$WORK/byz.metrics.jsonl" --verdict "$byz_verdict" \
+    --out "$WORK/report_byz" || exit 1
+
+echo "== combined exemplar -> $OUT_DIR/health_report.json =="
+$PY - "$WORK" "$OUT_DIR" <<'EOF'
+import json, os, sys
+
+work, out_dir = sys.argv[1], sys.argv[2]
+clean = json.load(open(os.path.join(work, "report_clean",
+                                    "run_report.json")))
+byz = json.load(open(os.path.join(work, "report_byz",
+                                  "run_report.json")))
+contrast = {
+    "clean_worst": clean["summary"]["worst_status"],
+    "byz_worst": byz["summary"]["worst_status"],
+    "clean_alerts": clean["summary"]["alerts_total"],
+    "byz_alerts": byz["summary"]["alerts_total"],
+    "byz_rules_fired": sorted({e["rule"] for e in byz["alerts"]
+                               if e["kind"] == "alert"}),
+    # the acceptance criterion verbatim: both artifacts gate-pass,
+    # and the alert timelines visibly differ
+    "timelines_differ": clean["alerts"] != byz["alerts"]
+                        and byz["summary"]["alerts_total"] > 0
+                        and clean["summary"]["alerts_total"] == 0,
+}
+assert contrast["timelines_differ"], contrast
+assert "client-divergence" in contrast["byz_rules_fired"], contrast
+doc = {"note": ("seeded sign-flip divergence exemplar vs its clean "
+                "twin (scripts/run_health_report.sh); gated by "
+                "analysis/bench_gate.py health_report SPEC"),
+       "clean": clean, "byz": byz, "contrast": contrast}
+os.makedirs(out_dir, exist_ok=True)
+path = os.path.join(out_dir, "health_report.json")
+with open(path, "w") as f:
+    json.dump(doc, f, indent=1, sort_keys=True)
+print("wrote", path)
+print(json.dumps(contrast, indent=1))
+EOF
+rc=$?
+[ $rc -ne 0 ] && exit $rc
+
+echo "== bench gate (health_report cell) =="
+$PY -m neuroimagedisttraining_tpu.analysis.bench_gate \
+    --artifact health_report.json --quiet

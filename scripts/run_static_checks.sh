@@ -60,7 +60,14 @@ fi
 # times the ENQUEUE and closes MFU windows at already-synced host
 # boundaries, and a sync smuggled into a round body is exactly the
 # hidden-cost bug its zero-sync contract forbids
-echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline incl. obs-trace-ctx-key + obs-pipe-per-upload + obs-sync-in-trace / precision-discipline / round-program-discipline) =="
+# the health-rule-discipline family (ISSUE 15) keeps obs/names.py the
+# single source of truth for metric names: a full-match nidt_* string
+# literal outside obs/ is a finding (health-metric-literal) — the
+# anomaly-rule engine (obs/rules.py) validates every rule manifest
+# against that declared-name set at startup, and a literal spelling
+# elsewhere would let a renamed metric silently leave the set and turn
+# the rules watching it permanently dark
+echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline incl. obs-trace-ctx-key + obs-pipe-per-upload + obs-sync-in-trace / precision-discipline / round-program-discipline / health-rule-discipline) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
 
