@@ -54,6 +54,9 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--lr_decay", type=float, default=0.998)
     parser.add_argument("--wd", type=float, default=5e-4)
     parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--grad_clip", type=float, default=10.0,
+                        help="global-norm gradient clip (<= 0 disables); "
+                             "torch clip_grad_norm_ parity")
     parser.add_argument("--epochs", type=int, default=2)
     parser.add_argument("--batch_order", type=str, default="shuffle",
                         choices=["shuffle", "replacement"],
@@ -66,7 +69,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--frequency_of_the_test", type=int, default=1)
     parser.add_argument("--ci", type=int, default=0)
     parser.add_argument("--seed", type=int, default=1024)
+    parser.add_argument("--seed_split", type=int, default=42,
+                        help="per-site 80/20 train/val split seed "
+                             "(independent of --seed so reshuffling "
+                             "training noise keeps the split fixed)")
     parser.add_argument("--cs", type=str, default="random")
+    parser.add_argument("--neighbor_num", type=int, default=5,
+                        help="gossip fan-out when --cs random")
     parser.add_argument("--active", type=float, default=1.0)
     parser.add_argument("--fault_spec", type=str, default="",
                         help="deterministic fault schedule (faults/): "
@@ -132,7 +141,7 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "version more than this many aggregations "
                              "old (also bounds the codec delta-"
                              "reference ring)")
-    parser.add_argument("--tag", type=str, default="test")
+    parser.add_argument("--tag", type=str, default="exp")
     parser.add_argument("--num_classes", type=int, default=1)
     # sparsity family
     parser.add_argument("--dense_ratio", type=float, default=0.5)
@@ -453,10 +462,12 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             synthetic_num_subjects=args.synthetic_num_subjects,
             synthetic_shape=tuple(args.synthetic_shape),
             synthetic_signal=args.synthetic_signal,
-            val_fraction=args.val_fraction),
+            val_fraction=args.val_fraction,
+            seed_split=args.seed_split),
         optim=OptimConfig(
             client_optimizer=args.client_optimizer, lr=args.lr,
             lr_decay=args.lr_decay, wd=args.wd, momentum=args.momentum,
+            grad_clip=args.grad_clip,
             batch_size=args.batch_size, epochs=args.epochs,
             batch_order=args.batch_order,
             precision=args.precision, loss_scale=args.loss_scale,
@@ -464,6 +475,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         fed=FedConfig(
             client_num_in_total=args.client_num_in_total, frac=args.frac,
             comm_round=args.comm_round, cs=args.cs, active=args.active,
+            neighbor_num=args.neighbor_num,
             fault_spec=args.fault_spec,
             wire_codec=args.wire_codec,
             wire_topk_ratio=args.wire_topk_ratio,

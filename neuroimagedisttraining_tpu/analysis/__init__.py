@@ -8,9 +8,16 @@ write-lock protocol. ``nidtlint`` turns those from comments into
 machine-checked rules, run as a tier-1 gate (tests/test_analysis.py) and
 via ``scripts/run_static_checks.sh``.
 
+A second, whole-program pass (``--project``) checks the cross-file
+contracts the per-file rules cannot see — flag<->config lockstep,
+metric-name closure, the compatibility matrix as data, and
+interprocedural donation (analysis/project.py + analysis/contracts.py).
+
 CLI::
 
     python -m neuroimagedisttraining_tpu.analysis <paths> [--json]
+    python -m neuroimagedisttraining_tpu.analysis --project [--json]
+    python -m neuroimagedisttraining_tpu.analysis --regen-compat
     python -m neuroimagedisttraining_tpu.analysis --list-rules
 
 Suppression: ``# nidt: allow[rule-id] -- one-line justification`` on the
@@ -30,6 +37,7 @@ from neuroimagedisttraining_tpu.analysis.core import (  # noqa: F401
 # importing the rule modules registers every rule family
 from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
     async_discipline,
+    contracts,
     determinism,
     donation,
     engine_contract,
@@ -43,12 +51,17 @@ from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
     trace_safety,
 )
 
+from neuroimagedisttraining_tpu.analysis.project import (  # noqa: E402,F401
+    lint_project,
+)
+
 __all__ = [
     "Finding",
     "Rule",
     "RULE_REGISTRY",
     "all_rule_ids",
     "lint_paths",
+    "lint_project",
     "lint_source",
     "register",
 ]

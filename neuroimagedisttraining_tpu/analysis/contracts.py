@@ -1,0 +1,575 @@
+"""Whole-program contract families over the declarative surfaces.
+
+Four cross-file contracts ride the project model (analysis/project.py),
+run under ``python -m neuroimagedisttraining_tpu.analysis --project``:
+
+1. **flag<->config** — every CLI flag is consumed (mapped into a config
+   field by ``config_from_args`` or read as ``args.<dest>``), every
+   config field is constructible from the CLI, argparse and dataclass
+   defaults agree through the mapping's wrappers, and a flag declared
+   on BOTH CLIs agrees on type/default/choices/action.
+2. **metric-name closure** — every metric registration and every
+   ``names.<CONST>`` consumer resolves to ``obs/names.py``; a declared
+   name nothing references is an orphan finding.
+3. **compatibility matrix as data** — the startup-rejection sites
+   (``parser.error``/``ap.error`` guards, ctor ``ValueError`` guards
+   reading >= 2 knobs) are extracted and diffed against the committed
+   ``analysis/compat_matrix.py`` artifact and its ARCHITECTURE.md
+   markdown twin; drift in either direction is a finding, and the twin
+   must be regenerated, never hand-edited.
+4. **interprocedural donation** — module-level functions that forward
+   parameters into donated argument positions get per-function
+   summaries, propagated to a fixed point across imports; a caller in
+   ANOTHER module that rereads a buffer it passed into a summarized
+   donated position is flagged (the per-file rule only sees one file).
+
+Every family suppresses through the standard ``# nidt: allow[rule-id]
+-- why`` pragma on the flagged line. The REASONS and bench_gate SPECS
+closures ride family 2's spirit (names must resolve; orphans surface).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterator
+
+from neuroimagedisttraining_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    normalize,
+    register,
+)
+from neuroimagedisttraining_tpu.analysis.donation import (
+    DonationDisciplineRule,
+)
+from neuroimagedisttraining_tpu.analysis.project import (
+    MD_BEGIN,
+    UNEVAL,
+    FlagInfo,
+    ProjectModel,
+    ProjectRule,
+    apply_wrapper,
+    argparse_flags,
+    attr_reads,
+    bench_specs,
+    committed_matrix,
+    config_assigned_fields,
+    config_mapping,
+    dataclass_fields,
+    doc_matrix_block,
+    knob_vocabulary,
+    load_artifact,
+    metric_registrations,
+    names_attr_uses,
+    names_table,
+    reason_key_uses,
+    reasons_span,
+    reasons_table,
+    rejection_rows,
+    render_matrix_md,
+    string_literals,
+)
+from neuroimagedisttraining_tpu.analysis.trace_safety import (
+    _annotate_parents,
+    _DefIndex,
+)
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# ---------------------------------------------------------------------------
+# family 1: flag <-> config
+# ---------------------------------------------------------------------------
+
+def _fmt(value: object) -> str:
+    return "<uneval>" if value is UNEVAL else repr(value)
+
+
+@register
+class FlagConfigRule(ProjectRule):
+    rule_ids = ("flag-config-default-drift", "flag-config-unmapped-flag",
+                "flag-config-unmapped-field", "flag-config-cross-cli-drift")
+    description = (
+        "CLI flags and config dataclass fields must stay in lockstep: "
+        "every flag consumed, every field constructible, defaults equal "
+        "through the config_from_args wrappers, and flags shared by both "
+        "CLIs agree on type/default/choices")
+
+    def project_check(self, model: ProjectModel) -> Iterator[Finding]:
+        main_cli = model.module(f"{model.package}/__main__.py")
+        dist_cli = model.find("distributed/run.py")
+        cfg = model.module(f"{model.package}/config.py")
+        main_flags = argparse_flags(main_cli) if main_cli else {}
+        dist_flags = argparse_flags(dist_cli) if dist_cli else {}
+        if main_cli is not None:
+            yield from self._check_consumed(main_cli, main_flags)
+        if dist_cli is not None:
+            yield from self._check_consumed(dist_cli, dist_flags)
+        if main_cli is not None and cfg is not None:
+            yield from self._check_mapping(main_cli, cfg, main_flags)
+        if main_cli is not None and dist_cli is not None:
+            yield from self._check_cross_cli(main_flags, dist_flags,
+                                             dist_cli.path)
+
+    def _check_consumed(self, cli: ModuleInfo,
+                        flags: dict[str, FlagInfo]) -> Iterator[Finding]:
+        mapped = {m.dest for m in config_mapping(cli)}
+        read = attr_reads(cli, "args")
+        for dest, flag in flags.items():
+            if dest not in mapped and dest not in read:
+                yield Finding(
+                    cli.path, flag.lineno, "flag-config-unmapped-flag",
+                    f"flag {flag.options[0]} (dest {dest!r}) is declared "
+                    "but never consumed — neither mapped into a config "
+                    "field by config_from_args nor read as "
+                    f"args.{dest} anywhere in this CLI")
+
+    def _check_mapping(self, cli: ModuleInfo, cfg: ModuleInfo,
+                       flags: dict[str, FlagInfo]) -> Iterator[Finding]:
+        fields = dataclass_fields(cfg)
+        mappings = config_mapping(cli)
+        assigned = config_assigned_fields(cli)
+        # field coverage: every dataclass field is constructible from the
+        # CLI path (assigned SOMETHING in config_from_args)
+        for cls, cls_fields in fields.items():
+            covered = assigned.get(cls, set())
+            for name, info in cls_fields.items():
+                if name not in covered:
+                    yield Finding(
+                        cfg.path, info.lineno, "flag-config-unmapped-field",
+                        f"{cls}.{name} is not assigned by config_from_args "
+                        "— the field cannot be set from the CLI (add a "
+                        "flag + mapping, or pragma-justify why it is "
+                        "internal-only)")
+        # default agreement through the wrapper
+        for m in mappings:
+            flag = flags.get(m.dest)
+            field = fields.get(m.cls, {}).get(m.field)
+            if flag is None or field is None:
+                continue
+            if flag.default is UNEVAL or field.default is UNEVAL:
+                continue
+            expected = apply_wrapper(flag.default, m.wrapper)
+            if expected is UNEVAL:
+                continue
+            if expected != field.default:
+                yield Finding(
+                    cli.path, m.lineno, "flag-config-default-drift",
+                    f"default drift: {flag.options[0]} defaults to "
+                    f"{_fmt(flag.default)} (-> {_fmt(expected)} after "
+                    f"{m.wrapper or 'identity'} wrapper) but "
+                    f"{m.cls}.{m.field} defaults to {_fmt(field.default)} "
+                    "— a config built in code and one built from the CLI "
+                    "silently diverge")
+
+    def _check_cross_cli(self, main_flags: dict[str, FlagInfo],
+                         dist_flags: dict[str, FlagInfo],
+                         dist_path: str) -> Iterator[Finding]:
+        by_option = {opt: f for f in main_flags.values()
+                     for opt in f.options}
+        for flag in dist_flags.values():
+            for opt in flag.options:
+                twin = by_option.get(opt)
+                if twin is None:
+                    continue
+                drifts = []
+                if flag.type != twin.type:
+                    drifts.append(f"type {flag.type}!={twin.type}")
+                if flag.action != twin.action:
+                    drifts.append(f"action {flag.action}!={twin.action}")
+                if (flag.default is not UNEVAL and twin.default is not UNEVAL
+                        and flag.default != twin.default):
+                    drifts.append(f"default {_fmt(flag.default)}!="
+                                  f"{_fmt(twin.default)}")
+                if (flag.choices is not UNEVAL and twin.choices is not UNEVAL
+                        and flag.choices != twin.choices):
+                    drifts.append(f"choices {_fmt(flag.choices)}!="
+                                  f"{_fmt(twin.choices)}")
+                if drifts:
+                    yield Finding(
+                        dist_path, flag.lineno, "flag-config-cross-cli-drift",
+                        f"{opt} is declared on both CLIs but drifts: "
+                        + "; ".join(drifts)
+                        + " — the same flag spelling must mean the same "
+                        "thing everywhere (or pragma-justify the "
+                        "smoke-scale divergence)")
+                break  # one shared option string is enough to pair them
+
+
+# ---------------------------------------------------------------------------
+# family 2: metric-name closure (+ REASONS and bench SPECS closures)
+# ---------------------------------------------------------------------------
+
+_METRIC_LITERAL_RE = re.compile(r"nidt_[a-z0-9_]+\Z")
+
+
+@register
+class MetricClosureRule(ProjectRule):
+    rule_ids = ("metric-undeclared", "metric-orphan")
+    description = (
+        "every registered/consumed metric name must resolve to an "
+        "obs/names.py declaration (metric-undeclared); a declared name "
+        "with zero consumers anywhere is an orphan (metric-orphan)")
+
+    def project_check(self, model: ProjectModel) -> Iterator[Finding]:
+        names_mod = model.find("obs/names.py")
+        if names_mod is None:
+            return
+        table = names_table(names_mod)
+        values = {v for v, _ in table.values()}
+        # every top-level binding is a legal `names.X` attribute target
+        # (DECLARED, helper tuples, ...), not just the string constants
+        module_attrs = set(table)
+        for stmt in names_mod.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        module_attrs.add(t.id)
+            elif (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                module_attrs.add(stmt.target.id)
+            elif isinstance(stmt, _FUNCS):
+                module_attrs.add(stmt.name)
+
+        used: set[str] = set()  # declared CONSTs with at least one consumer
+
+        for rel, const, line in names_attr_uses(model):
+            if rel == names_mod.path:
+                continue
+            if const in table:
+                used.add(const)
+            elif const not in module_attrs:
+                yield Finding(
+                    rel, line, "metric-undeclared",
+                    f"names.{const} is not declared in obs/names.py — "
+                    "declare the metric name there (the single source of "
+                    "truth) before consuming it")
+
+        for reg in metric_registrations(model):
+            if reg.const is not None:
+                if reg.const in table:
+                    used.add(reg.const)
+                else:
+                    yield Finding(
+                        reg.relpath, reg.lineno, "metric-undeclared",
+                        f"{reg.kind}() registers names.{reg.const}, which "
+                        "obs/names.py does not declare")
+            elif reg.literal is not None:
+                if reg.literal in values:
+                    used |= {c for c, (v, _) in table.items()
+                             if v == reg.literal}
+                else:
+                    yield Finding(
+                        reg.relpath, reg.lineno, "metric-undeclared",
+                        f"{reg.kind}() registers literal "
+                        f"{reg.literal!r}, which obs/names.py does not "
+                        "declare — add the constant and spell it "
+                        "names.<CONST>")
+
+        # nidt_* literals inside obs/ (names.py exempt): the per-file
+        # health-metric-literal rule stops at the obs/ boundary; here the
+        # literal must at least resolve to a declared value
+        for rel, mod in model.modules.items():
+            if "/obs/" not in f"/{rel}" or rel == names_mod.path:
+                continue
+            for value, line in string_literals(mod):
+                if not _METRIC_LITERAL_RE.fullmatch(value):
+                    continue
+                if value in values:
+                    used |= {c for c, (v, _) in table.items() if v == value}
+                else:
+                    yield Finding(
+                        rel, line, "metric-undeclared",
+                        f"metric literal {value!r} does not resolve to any "
+                        "obs/names.py declaration")
+
+        # literal value matches anywhere else in the tree also count as
+        # consumption (manifests under tests/, script-built rule dicts)
+        for rel, mod in model.modules.items():
+            if rel == names_mod.path:
+                continue
+            for value, _line in string_literals(mod):
+                if value in values:
+                    used |= {c for c, (v, _) in table.items() if v == value}
+
+        for const, (value, line) in sorted(table.items()):
+            if const not in used:
+                yield Finding(
+                    names_mod.path, line, "metric-orphan",
+                    f"{const} = {value!r} is declared but nothing in the "
+                    "tree registers or consumes it — delete the "
+                    "declaration or wire up its consumer")
+
+
+@register
+class ReasonClosureRule(ProjectRule):
+    rule_ids = ("reason-unknown", "reason-orphan")
+    description = (
+        "every *_fallback_key return and report_fallback()/reason() "
+        "literal must name a key of the engines/program.py REASONS "
+        "table (reason-unknown); a key nothing references is an orphan "
+        "(reason-orphan)")
+
+    def project_check(self, model: ProjectModel) -> Iterator[Finding]:
+        table = reasons_table(model)
+        if not table:
+            return
+        used: set[str] = set()
+        for rel, key, line in reason_key_uses(model):
+            if key in table:
+                used.add(key)
+            else:
+                yield Finding(
+                    rel, line, "reason-unknown",
+                    f"fallback reason {key!r} is not a key of the "
+                    "engines/program.py REASONS table — the structured "
+                    "nidt_fallback_total counter would carry an "
+                    "unexplained label")
+        # loose consumption: the key literal spelled anywhere outside the
+        # table itself (program.py's own builder emits keys inline)
+        span = reasons_span(model)
+        prog = model.find("engines/program.py")
+        for rel, mod in model.modules.items():
+            for value, line in string_literals(mod):
+                if value not in table:
+                    continue
+                if (prog is not None and rel == prog.path
+                        and span[0] <= line <= span[1]):
+                    continue
+                used.add(value)
+        for key, line in sorted(table.items()):
+            if key not in used:
+                yield Finding(
+                    (prog.path if prog else "engines/program.py"), line,
+                    "reason-orphan",
+                    f"REASONS key {key!r} is declared but no fallback "
+                    "path ever reports it — delete the row or wire up "
+                    "the fallback")
+
+
+@register
+class BenchSpecClosureRule(ProjectRule):
+    rule_ids = ("bench-spec-closure",)
+    description = (
+        "every analysis/bench_gate.py SPECS cell path must resolve "
+        "inside its committed bench_matrix/*.json artifact")
+
+    def project_check(self, model: ProjectModel) -> Iterator[Finding]:
+        from neuroimagedisttraining_tpu.analysis.project import resolve_cell
+        gate = model.find("analysis/bench_gate.py")
+        if gate is None:
+            return
+        for artifact, cells in sorted(bench_specs(model).items()):
+            doc = load_artifact(model, artifact)
+            if doc is None:
+                line = cells[0][1] if cells else 1
+                yield Finding(
+                    gate.path, line, "bench-spec-closure",
+                    f"SPECS names bench_matrix/{artifact} but no such "
+                    "committed artifact parses as JSON — regenerate it "
+                    "(scripts/) or drop the spec")
+                continue
+            for path, line in cells:
+                if not resolve_cell(doc, path):
+                    yield Finding(
+                        gate.path, line, "bench-spec-closure",
+                        f"SPECS cell {path!r} does not resolve in "
+                        f"bench_matrix/{artifact} — the gate would fail "
+                        "on a missing cell, not a regression")
+
+
+# ---------------------------------------------------------------------------
+# family 3: compatibility matrix as data
+# ---------------------------------------------------------------------------
+
+@register
+class CompatMatrixRule(ProjectRule):
+    rule_ids = ("compat-matrix-drift", "compat-matrix-doc-stale")
+    description = (
+        "the committed analysis/compat_matrix.py must equal a fresh "
+        "extraction of the tree's startup-rejection sites "
+        "(compat-matrix-drift), and the ARCHITECTURE.md table between "
+        "the nidt:compat-matrix markers must be regenerated from it, "
+        "never hand-edited (compat-matrix-doc-stale); fix both with "
+        "--regen-compat")
+
+    def project_check(self, model: ProjectModel) -> Iterator[Finding]:
+        extracted = rejection_rows(model, knob_vocabulary(model))
+        committed = committed_matrix(model)
+        matrix_mod = model.find("analysis/compat_matrix.py")
+        matrix_path = (matrix_mod.path if matrix_mod
+                       else f"{model.package}/analysis/compat_matrix.py")
+        if committed is None and extracted:
+            yield Finding(
+                matrix_path, 1, "compat-matrix-drift",
+                f"{len(extracted)} startup-rejection site(s) extracted "
+                "but no committed compat matrix exists — run "
+                "`python -m neuroimagedisttraining_tpu.analysis "
+                "--regen-compat` and commit the artifact")
+            return
+        committed = committed or []
+        key = lambda r: (r["where"], tuple(r["knobs"]), r["message"])
+        committed_keys = {key(r) for r in committed}
+        extracted_keys = {key(r) for r in extracted}
+        for row in extracted:
+            if key(row) not in committed_keys:
+                yield Finding(
+                    row["where"], row.get("_line", 1),
+                    "compat-matrix-drift",
+                    "startup-rejection site (knobs: "
+                    + ", ".join(row["knobs"])
+                    + ") is missing from the committed compat matrix — "
+                    "run --regen-compat and commit "
+                    "analysis/compat_matrix.py + the ARCHITECTURE.md twin")
+        for row in committed:
+            if key(row) not in extracted_keys:
+                yield Finding(
+                    matrix_path, 1, "compat-matrix-drift",
+                    f"committed matrix row ({row['where']}, knobs: "
+                    + ", ".join(row["knobs"])
+                    + ") matches no rejection site in today's tree — "
+                    "stale row; run --regen-compat")
+        # the markdown twin must be byte-identical to a regeneration
+        # from the COMMITTED artifact (hand edits are findings even when
+        # the artifact itself is current)
+        block, line = doc_matrix_block(model)
+        expected = render_matrix_md(
+            [dict(r, knobs=tuple(r["knobs"])) for r in committed])
+        if block is None:
+            if committed:
+                yield Finding(
+                    "ARCHITECTURE.md", 1, "compat-matrix-doc-stale",
+                    "ARCHITECTURE.md has no nidt:compat-matrix marker "
+                    f"block ({MD_BEGIN!r}) — run --regen-compat to embed "
+                    "the generated table")
+        elif block != expected:
+            yield Finding(
+                "ARCHITECTURE.md", line, "compat-matrix-doc-stale",
+                "the compat-matrix table between the nidt:compat-matrix "
+                "markers does not match a regeneration from the "
+                "committed matrix — the twin is generated, never "
+                "hand-edited; run --regen-compat")
+
+
+# ---------------------------------------------------------------------------
+# family 4: interprocedural donation / use-after-donate across modules
+# ---------------------------------------------------------------------------
+
+def _module_dotted(relpath: str) -> str:
+    rel = relpath[:-3] if relpath.endswith(".py") else relpath
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+@register
+class XModuleDonationRule(ProjectRule):
+    rule_ids = ("donation-use-after-donate-xmodule",)
+    description = (
+        "cross-file upgrade of donation-use-after-donate: module-level "
+        "functions that forward parameters into donated argument "
+        "positions are summarized and propagated across imports; a "
+        "caller in another module that rereads a buffer it passed into "
+        "a summarized donated position is flagged")
+
+    def project_check(self, model: ProjectModel) -> Iterator[Finding]:
+        helper = DonationDisciplineRule()
+        indexes: dict[str, _DefIndex] = {}
+        fns: dict[str, dict[str, ast.FunctionDef]] = {}
+        for rel, mod in model.modules.items():
+            _annotate_parents(mod.tree)
+            indexes[rel] = _DefIndex(mod.tree)
+            table: dict[str, ast.FunctionDef] = {}
+            for stmt in mod.tree.body:
+                if isinstance(stmt, _FUNCS):
+                    table[stmt.name] = stmt
+            fns[rel] = table
+
+        # summaries: dotted function path -> donated PARAM indices
+        summaries: dict[str, tuple[int, ...]] = {}
+        changed = True
+        rounds = 0
+        while changed and rounds <= len(model.modules) + 1:
+            changed = False
+            rounds += 1
+            for rel, mod in model.modules.items():
+                dotted_mod = _module_dotted(rel)
+                for name, fn in fns[rel].items():
+                    fpath = f"{dotted_mod}.{name}"
+                    donated = self._donated_params(
+                        mod, fn, indexes[rel], summaries)
+                    if donated and summaries.get(fpath) != donated:
+                        summaries[fpath] = donated
+                        changed = True
+
+        if not summaries:
+            return
+        for rel, mod in model.modules.items():
+            for fn in (n for n in ast.walk(mod.tree)
+                       if isinstance(n, _FUNCS)):
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    if helper._enclosing_fn(call) is not fn:
+                        continue
+                    target = self._resolve_xmodule(mod, call, summaries,
+                                                   fns, rel)
+                    if target is None:
+                        continue
+                    callee, indices = target
+                    for f in helper._reads_after(mod, fn, call, indices,
+                                                 callee):
+                        yield dataclasses.replace(
+                            f, rule="donation-use-after-donate-xmodule")
+
+    @staticmethod
+    def _donated_params(mod: ModuleInfo, fn: ast.FunctionDef,
+                        index: _DefIndex,
+                        summaries: dict[str, tuple[int, ...]]
+                        ) -> tuple[int, ...]:
+        """Parameter positions of ``fn`` whose (bare-Name) values flow
+        into a donated argument position of a donating call in its
+        body — directly (via the per-file resolver) or through an
+        already-summarized import."""
+        helper = DonationDisciplineRule()
+        params = [a.arg for a in fn.args.args]
+        out: set[int] = set()
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            donated = helper._donating_call(call, index, mod.aliases)
+            indices: tuple[int, ...] = ()
+            if donated:
+                indices = donated[0]
+            else:
+                canon = normalize(dotted_name(call.func), mod.aliases)
+                if canon in summaries:
+                    indices = summaries[canon]
+            for i in indices:
+                if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                    if call.args[i].id in params:
+                        out.add(params.index(call.args[i].id))
+        return tuple(sorted(out))
+
+    @staticmethod
+    def _resolve_xmodule(mod: ModuleInfo, call: ast.Call,
+                         summaries: dict[str, tuple[int, ...]],
+                         fns: dict[str, dict[str, ast.FunctionDef]],
+                         rel: str) -> tuple[str, tuple[int, ...]] | None:
+        """(callee label, donated indices) when ``call`` resolves through
+        the import aliases to a summarized function defined in a
+        DIFFERENT module (same-module reads are the per-file rule's
+        job)."""
+        canon = normalize(dotted_name(call.func), mod.aliases)
+        if canon is None or canon not in summaries:
+            return None
+        mod_dotted, _, fname = canon.rpartition(".")
+        if mod_dotted == _module_dotted(rel):
+            return None
+        if fname in fns.get(rel, {}):
+            # the local def shadows; not a cross-module dispatch
+            return None
+        return canon, summaries[canon]

@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
+import json
 import os
 import re
 import tokenize
@@ -285,10 +287,68 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
             raise FileNotFoundError(p)
 
 
+_RULES_VERSION: str | None = None
+
+
+def _rules_version() -> str:
+    """Content hash of every module in analysis/ — a rule edit must
+    invalidate the whole finding cache, not just rerun changed files."""
+    global _RULES_VERSION
+    if _RULES_VERSION is None:
+        h = hashlib.sha256()
+        here = os.path.dirname(os.path.abspath(__file__))
+        for root, dirs, files in os.walk(here):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    with open(os.path.join(root, fn), "rb") as fh:
+                        h.update(fn.encode())
+                        h.update(fh.read())
+        _RULES_VERSION = h.hexdigest()
+    return _RULES_VERSION
+
+
+def _cache_key(path: str, source: str,
+               rules: Iterable[str] | None) -> str:
+    h = hashlib.sha256()
+    h.update(_rules_version().encode())
+    h.update(b"\0")
+    h.update((",".join(sorted(rules)) if rules is not None else "*")
+             .encode())
+    h.update(b"\0")
+    h.update(path.encode())
+    h.update(b"\0")
+    h.update(source.encode())
+    return h.hexdigest()
+
+
 def lint_paths(paths: Iterable[str],
-               rules: Iterable[str] | None = None) -> list[Finding]:
+               rules: Iterable[str] | None = None,
+               cache_dir: str | None = None) -> list[Finding]:
+    """Lint every ``.py`` under ``paths``. With ``cache_dir``, per-file
+    findings are memoized by content hash (key covers the source bytes,
+    the rule selection AND a hash of analysis/ itself, so editing a rule
+    invalidates everything); a hit skips the parse entirely. The cache
+    holds FINDINGS, not verdicts — a hit replays identical output."""
     findings: list[Finding] = []
     for fp in iter_py_files(paths):
         with open(fp, encoding="utf-8") as fh:
-            findings.extend(lint_source(fh.read(), path=fp, rules=rules))
+            source = fh.read()
+        if cache_dir is not None:
+            key = _cache_key(fp, source, rules)
+            cpath = os.path.join(cache_dir, key + ".json")
+            try:
+                with open(cpath, encoding="utf-8") as fh:
+                    findings.extend(Finding(**d) for d in json.load(fh))
+                continue
+            except (OSError, json.JSONDecodeError, TypeError):
+                pass  # miss or corrupt entry: lint and rewrite
+        file_findings = lint_source(source, path=fp, rules=rules)
+        findings.extend(file_findings)
+        if cache_dir is not None:
+            os.makedirs(cache_dir, exist_ok=True)
+            tmp = cpath + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump([f.as_json() for f in file_findings], fh)
+            os.replace(tmp, cpath)
     return findings

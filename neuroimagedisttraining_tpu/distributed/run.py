@@ -339,7 +339,7 @@ def main(argv=None) -> int:
                          "--mpc_n_shares; 0 = single-server degenerate "
                          "mode")
     ap.add_argument("--num_clients", type=int, required=True)
-    ap.add_argument("--comm_round", type=int, default=5)
+    ap.add_argument("--comm_round", type=int, default=5)  # nidt: allow[flag-config-cross-cli-drift] -- smoke-scale default; the multiprocess runner ships tiny CPU-safe cells
     ap.add_argument("--base_port", type=int, default=29500)
     ap.add_argument("--hosts", type=str, default="",
                     help="rank=ip,... (default: all localhost)")
@@ -491,17 +491,17 @@ def main(argv=None) -> int:
                          "(epsilon, delta) report (privacy/accountant.py)")
     ap.add_argument("--mpc_n_shares", type=int, default=3)
     ap.add_argument("--mpc_frac_bits", type=int, default=16)
-    ap.add_argument("--model", type=str, default="3dcnn_tiny")
+    ap.add_argument("--model", type=str, default="3dcnn_tiny")  # nidt: allow[flag-config-cross-cli-drift] -- smoke-scale default; the multiprocess runner ships tiny CPU-safe cells
     ap.add_argument("--num_classes", type=int, default=1)
     ap.add_argument("--dataset", type=str, default="synthetic",
-                    choices=("synthetic", "abcd_h5"))
-    ap.add_argument("--data_dir", type=str, default="")
-    ap.add_argument("--synthetic_num_subjects", type=int, default=64)
+                    choices=("synthetic", "abcd_h5"))  # nidt: allow[flag-config-cross-cli-drift] -- smoke default + the only datasets the socket runner feeds
+    ap.add_argument("--data_dir", type=str, default="")  # nidt: allow[flag-config-cross-cli-drift] -- smoke-scale default; the multiprocess runner ships tiny CPU-safe cells
+    ap.add_argument("--synthetic_num_subjects", type=int, default=64)  # nidt: allow[flag-config-cross-cli-drift] -- smoke-scale default; the multiprocess runner ships tiny CPU-safe cells
     ap.add_argument("--synthetic_shape", type=int, nargs=3,
-                    default=[12, 14, 12])
+                    default=[12, 14, 12])  # nidt: allow[flag-config-cross-cli-drift] -- smoke-scale default; the multiprocess runner ships tiny CPU-safe cells
     ap.add_argument("--synthetic_signal", type=float, default=12.0)
-    ap.add_argument("--batch_size", type=int, default=8)
-    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch_size", type=int, default=8)  # nidt: allow[flag-config-cross-cli-drift] -- smoke-scale default; the multiprocess runner ships tiny CPU-safe cells
+    ap.add_argument("--epochs", type=int, default=1)  # nidt: allow[flag-config-cross-cli-drift] -- smoke-scale default; the multiprocess runner ships tiny CPU-safe cells
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--lr_decay", type=float, default=0.998)
     # mixed-precision train step (ISSUE 10) — mirrors the simulated
@@ -517,7 +517,7 @@ def main(argv=None) -> int:
     ap.add_argument("--fused_update", action="store_true",
                     help="fused SGD clip/momentum/update/mask tail "
                          "(ops/fused_update.py; XLA fallback off-TPU)")
-    ap.add_argument("--remat", type=str, default="auto",
+    ap.add_argument("--remat", type=str, default="auto",  # nidt: allow[flag-config-cross-cli-drift] -- choices enforced here only; the simulated CLI validates via models/
                     choices=("auto", "none", "stem", "all"),
                     help="3D-model rematerialization policy (auto = "
                          "model-family default; PROFILE.md)")

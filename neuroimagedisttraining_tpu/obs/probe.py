@@ -220,13 +220,16 @@ def _scrape(port: int) -> tuple[bool, bool]:
     block actually serve (the CI smoke the ISSUE names)."""
     from urllib.request import urlopen
 
+    from neuroimagedisttraining_tpu.obs import names as obs_names
+
     try:
         body = urlopen(f"http://127.0.0.1:{port}/metrics",
                        timeout=5).read().decode()
-        metrics_ok = ("nidt_dispatch_ms_bucket" in body
-                      and "nidt_compiles_total" in body
-                      and ("nidt_sustained_tflops" in body
-                           or "nidt_mfu" in body))
+        # _bucket is the Prometheus exposition suffix of the histogram
+        metrics_ok = (obs_names.DISPATCH_MS + "_bucket" in body
+                      and obs_names.COMPILES_TOTAL in body
+                      and (obs_names.SUSTAINED_TFLOPS in body
+                           or obs_names.MFU in body))
         health = json.loads(urlopen(
             f"http://127.0.0.1:{port}/healthz", timeout=5).read())
         comp = health.get("compute") or {}

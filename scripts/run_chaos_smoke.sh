@@ -36,6 +36,14 @@ PY=${PYTHON:-python}
 ROUNDS=3
 CLIENTS=4
 
+# metric-closure gate (ISSUE 16): the shipped example health-rule
+# manifest must name only obs/names.py-declared metrics BEFORE any
+# federation boots — a drifted manifest would load into every silo and
+# watch a metric that no longer exists, permanently dark
+echo "== validate scripts/health_rules.example.json (metric-name closure) =="
+$PY -m neuroimagedisttraining_tpu.analysis \
+    --check-manifest scripts/health_rules.example.json || exit 1
+
 run_one() {
     local transport=$1 mode=$2
     local port

@@ -29,6 +29,14 @@ OUT_DIR=${1:-bench_matrix}
 WORK=$(mktemp -d /tmp/nidt_health.XXXXXX)
 trap 'rm -rf "$WORK"' EXIT
 
+# the manifest both runs load must be metric-closed against obs/names.py
+# BEFORE burning any training time (the --project metric-closure pass,
+# applied to manifests; ISSUE 16)
+RULES_MANIFEST=scripts/health_rules.example.json
+echo "== validate $RULES_MANIFEST (metric-name closure) =="
+$PY -m neuroimagedisttraining_tpu.analysis \
+    --check-manifest "$RULES_MANIFEST" || exit 1
+
 # 64 subjects: enough shared signal that honest site updates COHERE
 # (clean leave-one-out cosines ~ +0.2..+0.4); at 24 subjects the tiny
 # task saturates instantly and honest non-IID pulls genuinely oppose
@@ -37,7 +45,11 @@ COMMON=(--algorithm fedavg --dataset synthetic --model 3dcnn_tiny
         --synthetic_num_subjects 64 --synthetic_shape 12 14 12
         --client_num_in_total 4 --comm_round 3 --batch_size 8
         --epochs 1 --lr 1e-3 --seed 1024 --log_dir "$WORK/LOG"
-        --health_stats --health_gate)
+        --health_stats --health_gate
+        # manifest rules ride along with the builtins; its thresholds
+        # sit far above anything these tiny runs reach, so the clean
+        # twin's zero-alert contract is unchanged
+        --health_rules "$RULES_MANIFEST")
 
 echo "== clean twin =="
 $PY -m neuroimagedisttraining_tpu "${COMMON[@]}" --tag health_clean \

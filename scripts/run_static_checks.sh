@@ -68,7 +68,29 @@ fi
 # elsewhere would let a renamed metric silently leave the set and turn
 # the rules watching it permanently dark
 echo "== nidtlint (trace-safety / engine-contract / lock-discipline / determinism / donation-discipline / async-discipline / obs-discipline incl. obs-trace-ctx-key + obs-pipe-per-upload + obs-sync-in-trace / precision-discipline / round-program-discipline / health-rule-discipline) =="
+# --cache: content-hash per-file finding cache (.nidtlint_cache/,
+# gitignored; a rule edit invalidates everything) keeps this sub-10s
+# as the tree grows
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m neuroimagedisttraining_tpu.analysis neuroimagedisttraining_tpu || rc=1
+    python -m neuroimagedisttraining_tpu.analysis \
+    --cache .nidtlint_cache neuroimagedisttraining_tpu || rc=1
+
+# the whole-program contract pass (ISSUE 16): flag<->config lockstep
+# across both CLIs, metric-name/REASONS/bench-SPECS closure, the
+# generated compatibility matrix (analysis/compat_matrix.py + its
+# ARCHITECTURE.md twin, --regen-compat to refresh), and cross-module
+# donation summaries. JSON artifact for CI annotation, bench_gate-style
+# exit codes (0 clean / 1 findings / 2 usage error).
+CONTRACTS_OUT="${CONTRACTS_OUT:-/tmp/nidt_contracts.json}"
+echo "== nidtlint --project (flag<->config / metric closure / compat matrix / x-module donation) -> $CONTRACTS_OUT =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m neuroimagedisttraining_tpu.analysis --project --json \
+    > "$CONTRACTS_OUT" || { rc=1; cat "$CONTRACTS_OUT"; }
+
+# the example health-rule manifest must stay loadable and metric-closed
+echo "== nidtlint --check-manifest scripts/health_rules.example.json =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m neuroimagedisttraining_tpu.analysis \
+    --check-manifest scripts/health_rules.example.json || rc=1
 
 exit $rc

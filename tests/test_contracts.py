@@ -1,0 +1,542 @@
+"""Whole-program contract checker (analysis/project.py + contracts.py):
+one seeded violation per contract family against synthetic fixture
+trees, pragma mechanics on project findings, the finding cache, and the
+tier-1 gates — `--project` exits 0 on the shipped tree forever."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from neuroimagedisttraining_tpu.analysis import lint_paths
+from neuroimagedisttraining_tpu.analysis.cli import main as cli_main
+from neuroimagedisttraining_tpu.analysis.project import (
+    build_model,
+    lint_project,
+    regen_compat,
+    rejection_rows,
+    knob_vocabulary,
+    render_matrix_py,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "neuroimagedisttraining_tpu"
+
+
+def make_tree(tmp_path, files):
+    """Write a synthetic mini-package under tmp_path/pkg and return the
+    (root, package) pair lint_project takes."""
+    for rel, src in files.items():
+        fp = tmp_path / "pkg" / rel
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(textwrap.dedent(src))
+    return str(tmp_path), "pkg"
+
+
+def project_rules(tmp_path, files, rules=None):
+    root, pkg = make_tree(tmp_path, files)
+    return [(f.rule, f.path) for f in lint_project(root, pkg, rules=rules)]
+
+
+# ---------------- family 1: flag <-> config ----------------
+
+FLAG_CONFIG_TREE = {
+    "config.py": """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class OptimConfig:
+            lr: float = 0.01
+
+        @dataclasses.dataclass(frozen=True)
+        class ExperimentConfig:
+            tag: str = "exp"
+            hidden: int = 3
+    """,
+    "__main__.py": """
+        import argparse
+
+        from pkg.config import ExperimentConfig, OptimConfig
+
+        def add_args(parser):
+            parser.add_argument("--lr", type=float, default=0.01)
+            parser.add_argument("--tag", type=str, default="test")
+            parser.add_argument("--ghost", type=int, default=0)
+            return parser
+
+        def config_from_args(args):
+            return ExperimentConfig(
+                tag=args.tag,
+                optim=OptimConfig(lr=args.lr))
+    """,
+}
+
+
+def test_flag_config_catches_drifted_default_unmapped_flag_and_field(
+        tmp_path):
+    found = project_rules(tmp_path, FLAG_CONFIG_TREE)
+    rules = [r for r, _ in found]
+    assert "flag-config-default-drift" in rules     # tag: 'test' vs 'exp'
+    assert "flag-config-unmapped-flag" in rules     # --ghost never consumed
+    assert "flag-config-unmapped-field" in rules    # hidden not assignable
+
+
+def test_flag_config_clean_when_in_lockstep(tmp_path):
+    tree = dict(FLAG_CONFIG_TREE)
+    tree["__main__.py"] = """
+        import argparse
+
+        from pkg.config import ExperimentConfig, OptimConfig
+
+        def add_args(parser):
+            parser.add_argument("--lr", type=float, default=0.01)
+            parser.add_argument("--tag", type=str, default="exp")
+            parser.add_argument("--hidden", type=int, default=3)
+            return parser
+
+        def config_from_args(args):
+            return ExperimentConfig(
+                tag=args.tag, hidden=args.hidden,
+                optim=OptimConfig(lr=args.lr))
+    """
+    assert project_rules(tmp_path, tree) == []
+
+
+def test_flag_config_wrapper_aware_default_comparison(tmp_path):
+    """tuple()/not wrappers are applied to the argparse default before
+    comparing, so list-vs-tuple and inverted store_true flags agree."""
+    found = project_rules(tmp_path, {
+        "config.py": """
+            import dataclasses
+
+            @dataclasses.dataclass(frozen=True)
+            class ExperimentConfig:
+                mesh_shape: tuple = (1, 1)
+                snip: bool = True
+        """,
+        "__main__.py": """
+            import argparse
+
+            from pkg.config import ExperimentConfig
+
+            def add_args(parser):
+                parser.add_argument("--mesh_shape", type=int, nargs=2,
+                                    default=[1, 1])
+                parser.add_argument("--no_snip", action="store_true")
+                return parser
+
+            def config_from_args(args):
+                return ExperimentConfig(
+                    mesh_shape=tuple(args.mesh_shape),
+                    snip=not args.no_snip)
+        """,
+    })
+    assert found == []
+
+
+def test_cross_cli_drift_and_pragma_suppression(tmp_path):
+    run_py = """
+        import argparse
+
+        def main():
+            ap = argparse.ArgumentParser()
+            ap.add_argument("--lr", type=float, default=0.05)
+            args = ap.parse_args()
+            return args.lr
+    """
+    tree = dict(FLAG_CONFIG_TREE)
+    tree["distributed/run.py"] = run_py
+    found = project_rules(tmp_path, tree)
+    assert ("flag-config-cross-cli-drift", "pkg/distributed/run.py") \
+        in found
+    # the standard pragma on the flagged line suppresses it
+    tree["distributed/run.py"] = run_py.replace(
+        'default=0.05)',
+        'default=0.05)  '
+        '# nidt: allow[flag-config-cross-cli-drift] -- smoke-scale')
+    found2 = project_rules(tmp_path / "b", tree)
+    assert ("flag-config-cross-cli-drift", "pkg/distributed/run.py") \
+        not in found2
+
+
+# ---------------- family 2: metric-name closure ----------------
+
+METRIC_TREE = {
+    "obs/names.py": """
+        USED = "nidt_used_total"
+        ORPHAN = "nidt_orphan_total"
+
+        DECLARED = frozenset(
+            v for k, v in list(globals().items()) if k.isupper())
+    """,
+    "train.py": """
+        from pkg.obs import metrics as obs_metrics
+        from pkg.obs import names as obs_names
+
+        def arm():
+            obs_metrics.counter(obs_names.USED, "ok")
+            obs_metrics.counter(obs_names.MISSING, "undeclared attr")
+            obs_metrics.gauge("nidt_rogue_total", "undeclared literal")
+    """,
+}
+
+
+def test_metric_closure_catches_undeclared_and_orphan(tmp_path):
+    found = project_rules(tmp_path, METRIC_TREE)
+    undeclared = [(r, p) for r, p in found if r == "metric-undeclared"]
+    assert ("metric-undeclared", "pkg/train.py") in undeclared
+    # both the names.MISSING attr and the rogue literal are findings
+    assert len(undeclared) >= 2
+    assert ("metric-orphan", "pkg/obs/names.py") in found
+
+
+def test_metric_closure_clean_when_closed(tmp_path):
+    tree = dict(METRIC_TREE)
+    tree["train.py"] = """
+        from pkg.obs import metrics as obs_metrics
+        from pkg.obs import names as obs_names
+
+        def arm():
+            obs_metrics.counter(obs_names.USED, "ok")
+            obs_metrics.gauge(obs_names.ORPHAN, "now consumed")
+    """
+    assert project_rules(tmp_path, tree) == []
+
+
+# ---------------- family 2b: REASONS + bench SPECS closures ----------------
+
+def test_reason_closure_catches_unknown_and_orphan(tmp_path):
+    found = project_rules(tmp_path, {
+        "engines/program.py": """
+            REASONS = {
+                "used-key": ("host", "why"),
+                "orphan-key": ("host", "why"),
+            }
+
+            def reason(key):
+                return REASONS[key]
+        """,
+        "engines/base.py": """
+            def _report(report_fallback):
+                report_fallback("engine", "used-key")
+
+            def thing_fallback_key():
+                return "bogus-key"
+        """,
+    })
+    assert ("reason-unknown", "pkg/engines/base.py") in found
+    assert ("reason-orphan", "pkg/engines/program.py") in found
+    assert ("reason-unknown", "pkg/engines/program.py") not in found
+
+
+def test_bench_spec_closure_catches_unresolvable_cell(tmp_path):
+    root, pkg = make_tree(tmp_path, {
+        "analysis/bench_gate.py": """
+            SPECS = {
+                "art.json": (
+                    Check("summary.ok", "min", 1, "resolves"),
+                    Check("summary.gone", "min", 1, "does not"),
+                ),
+            }
+        """,
+    })
+    bm = tmp_path / "bench_matrix"
+    bm.mkdir()
+    (bm / "art.json").write_text(json.dumps({"summary": {"ok": 2}}))
+    found = [(f.rule, f.message) for f in lint_project(root, pkg)]
+    assert len(found) == 1
+    assert found[0][0] == "bench-spec-closure"
+    assert "summary.gone" in found[0][1]
+
+
+# ---------------- family 3: compat matrix as data ----------------
+
+MATRIX_CLI = {
+    "__main__.py": """
+        import argparse
+
+        def add_args(parser):
+            parser.add_argument("--a_flag", type=int, default=0)
+            parser.add_argument("--b_flag", type=int, default=0)
+            return parser
+
+        def main():
+            parser = argparse.ArgumentParser()
+            add_args(parser)
+            args = parser.parse_args()
+            if args.a_flag and args.b_flag:
+                parser.error("--a_flag does not compose with --b_flag")
+            return args
+    """,
+}
+
+
+def test_compat_matrix_missing_artifact_is_drift(tmp_path):
+    found = project_rules(tmp_path, MATRIX_CLI)
+    assert ("compat-matrix-drift", "pkg/analysis/compat_matrix.py") \
+        in found
+
+
+def test_compat_matrix_regen_round_trips_clean(tmp_path):
+    root, pkg = make_tree(tmp_path, MATRIX_CLI)
+    regen_compat(root, pkg)
+    assert lint_project(root, pkg) == []
+
+
+def test_compat_matrix_stale_row_and_hand_edited_doc(tmp_path):
+    root, pkg = make_tree(tmp_path, MATRIX_CLI)
+    regen_compat(root, pkg)
+    # a NEW rejection lands without regenerating -> drift at the site
+    main_py = tmp_path / "pkg" / "__main__.py"
+    main_py.write_text(main_py.read_text().replace(
+        "return args",
+        'if args.b_flag and not args.a_flag:\n'
+        '        parser.error("--b_flag requires --a_flag")\n'
+        '    return args'))
+    rules = [f.rule for f in lint_project(root, pkg)]
+    assert "compat-matrix-drift" in rules
+    regen_compat(root, pkg)
+    assert lint_project(root, pkg) == []
+    # hand-editing the generated markdown twin is a finding of its own
+    arch = tmp_path / "ARCHITECTURE.md"
+    arch.write_text(arch.read_text().replace("`a_flag`", "`tweaked`"))
+    rules = [f.rule for f in lint_project(root, pkg)]
+    assert rules == ["compat-matrix-doc-stale"]
+    # a REMOVED rejection makes the committed row stale in the other
+    # direction
+    regen_compat(root, pkg)
+    row_src = main_py.read_text()
+    main_py.write_text(row_src.replace(
+        'parser.error("--b_flag requires --a_flag")', "pass"))
+    found = [(f.rule, f.path) for f in lint_project(root, pkg)]
+    assert ("compat-matrix-drift", "pkg/analysis/compat_matrix.py") \
+        in found
+
+
+def test_extraction_requires_two_knobs(tmp_path):
+    """Single-knob range checks are validation, not compatibility."""
+    root, pkg = make_tree(tmp_path, {
+        "__main__.py": """
+            import argparse
+
+            def add_args(parser):
+                parser.add_argument("--a_flag", type=int, default=0)
+                return parser
+
+            def main():
+                parser = argparse.ArgumentParser()
+                add_args(parser)
+                args = parser.parse_args()
+                if args.a_flag < 0:
+                    parser.error("--a_flag must be >= 0")
+                return args
+        """,
+    })
+    model = build_model(root, pkg)
+    assert rejection_rows(model, knob_vocabulary(model)) == []
+
+
+def test_render_matrix_py_is_literal_eval_safe(tmp_path):
+    root, pkg = make_tree(tmp_path, MATRIX_CLI)
+    model = build_model(root, pkg)
+    rows = rejection_rows(model, knob_vocabulary(model))
+    assert rows, "fixture must extract at least one row"
+    src = render_matrix_py(rows)
+    import ast as ast_mod
+    tree = ast_mod.parse(src)
+    assign = next(n for n in tree.body
+                  if isinstance(n, (ast_mod.Assign, ast_mod.AnnAssign)))
+    parsed = ast_mod.literal_eval(assign.value)
+    assert [dict(r, knobs=tuple(r["knobs"])) for r in parsed] == [
+        {k: v for k, v in r.items() if not k.startswith("_")}
+        for r in rows]
+
+
+# ---------------- family 4: cross-module donation ----------------
+
+DONATION_TREE = {
+    "helpers.py": """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _round_step(state, batch):
+            return state + batch
+
+        def apply_round(state, batch):
+            return _round_step(state, batch)
+    """,
+    "driver.py": """
+        from pkg.helpers import apply_round
+
+        def drive(params, batch):
+            new = apply_round(params, batch)
+            return params + new
+    """,
+}
+
+
+def test_xmodule_donation_catches_read_through_helper(tmp_path):
+    found = project_rules(tmp_path, DONATION_TREE)
+    assert ("donation-use-after-donate-xmodule", "pkg/driver.py") in found
+
+
+def test_xmodule_donation_clean_when_rebound(tmp_path):
+    tree = dict(DONATION_TREE)
+    tree["driver.py"] = """
+        from pkg.helpers import apply_round
+
+        def drive(params, batch):
+            params = apply_round(params, batch)
+            return params
+    """
+    assert project_rules(tmp_path, tree) == []
+
+
+def test_xmodule_donation_propagates_through_two_hops(tmp_path):
+    """The summary fixed point follows helper -> helper -> jit."""
+    tree = dict(DONATION_TREE)
+    tree["middle.py"] = """
+        from pkg.helpers import apply_round
+
+        def relay(state, batch):
+            return apply_round(state, batch)
+    """
+    tree["driver.py"] = """
+        from pkg.middle import relay
+
+        def drive(params, batch):
+            new = relay(params, batch)
+            return params + new
+    """
+    found = project_rules(tmp_path, tree)
+    assert ("donation-use-after-donate-xmodule", "pkg/driver.py") in found
+
+
+# ---------------- finding cache + changed-files ----------------
+
+def test_cache_hit_equals_cold_run(tmp_path, monkeypatch):
+    src = ("import numpy as np\n"
+           "def f():\n"
+           "    return np.random.rand()\n")
+    target = tmp_path / "mod.py"
+    target.write_text(src)
+    cache = tmp_path / "cache"
+    cold = lint_paths([str(target)], cache_dir=str(cache))
+    assert [f.rule for f in cold] == ["determinism-global-random"]
+    assert list(cache.glob("*.json")), "cold run must populate the cache"
+
+    # the warm run must come from the cache: a parse now raises
+    import neuroimagedisttraining_tpu.analysis.core as core
+
+    def boom(*a, **k):
+        raise AssertionError("cache miss: lint_source was called")
+
+    monkeypatch.setattr(core, "lint_source", boom)
+    warm = lint_paths([str(target)], cache_dir=str(cache))
+    assert warm == cold
+    monkeypatch.undo()
+
+    # touching the content invalidates the entry
+    target.write_text(src + "np.random.seed(1)\n")
+    changed = lint_paths([str(target)], cache_dir=str(cache))
+    assert sorted(f.rule for f in changed) == [
+        "determinism-global-random", "determinism-global-random"]
+
+
+def test_cache_key_covers_rule_selection(tmp_path):
+    src = "import numpy as np\nnp.random.seed(1)\n"
+    target = tmp_path / "mod.py"
+    target.write_text(src)
+    cache = tmp_path / "cache"
+    full = lint_paths([str(target)], cache_dir=str(cache))
+    narrowed = lint_paths([str(target)], cache_dir=str(cache),
+                          rules=["determinism-unseeded-rng"])
+    assert [f.rule for f in full] == ["determinism-global-random"]
+    assert narrowed == []  # selection change must not replay 'full'
+
+
+def test_cli_cache_and_changed_files_flags(tmp_path, capsys):
+    target = tmp_path / "mod.py"
+    target.write_text("import numpy as np\nnp.random.seed(1)\n")
+    cache = tmp_path / "cache"
+    assert cli_main([str(target), "--cache", str(cache)]) == 1
+    capsys.readouterr()
+    assert cli_main([str(target), "--cache", str(cache)]) == 1
+    capsys.readouterr()
+    # --changed-files outside any git checkout falls back to everything
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = cli_main([str(target), "--changed-files"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 1
+
+
+# ---------------- manifest validation (CLI) ----------------
+
+def test_check_manifest_accepts_shipped_example(capsys):
+    path = os.path.join(REPO_ROOT, "scripts", "health_rules.example.json")
+    assert cli_main(["--check-manifest", path]) == 0
+
+
+def test_check_manifest_rejects_undeclared_metric(tmp_path, capsys):
+    bad = tmp_path / "rules.json"
+    bad.write_text(json.dumps([{
+        "name": "ghost", "metric": "nidt_ghost_metric",
+        "op": ">", "threshold": 1}]))
+    assert cli_main(["--check-manifest", str(bad)]) == 1
+    err = capsys.readouterr().err
+    assert "nidt_ghost_metric" in err
+
+
+# ---------------- tier-1 gates on the shipped tree ----------------
+
+def test_shipped_tree_project_pass_is_clean():
+    """THE tier-1 gate: every cross-file contract holds (or carries a
+    justified pragma) across the whole package, forever."""
+    findings = lint_project(REPO_ROOT, PACKAGE)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_shipped_tree_project_clean_via_cli_subprocess():
+    """Acceptance criterion verbatim: `--project` exits 0 on the tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "neuroimagedisttraining_tpu.analysis",
+         "--project"],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_committed_matrix_matches_fresh_extraction():
+    """The committed artifact is diff-gated: a fresh extraction of
+    today's tree must equal analysis/compat_matrix.py exactly."""
+    from neuroimagedisttraining_tpu.analysis.compat_matrix import MATRIX
+
+    model = build_model(REPO_ROOT, PACKAGE)
+    rows = rejection_rows(model, knob_vocabulary(model))
+    assert [
+        {k: v for k, v in r.items() if not k.startswith("_")}
+        for r in rows
+    ] == [dict(r, knobs=tuple(r["knobs"])) for r in MATRIX]
+    assert len(MATRIX) > 10, "the real tree has many rejection sites"
+
+
+def test_project_rules_do_not_change_per_file_pass():
+    """Registering the project families must not add per-file findings:
+    a ProjectRule's check() is a no-op by contract."""
+    from neuroimagedisttraining_tpu.analysis import RULE_REGISTRY
+    from neuroimagedisttraining_tpu.analysis.project import ProjectRule
+
+    project_families = [cls for cls in RULE_REGISTRY.values()
+                        if issubclass(cls, ProjectRule)]
+    assert len(project_families) >= 5
+    mod_stub = object()
+    for cls in project_families:
+        assert list(cls().check(mod_stub)) == []
