@@ -167,6 +167,37 @@ SPECS: dict[str, tuple[Check, ...]] = {
         Check("byz.summary.rounds", "eq",
               note="metrics JSONL rounds joined (the round/seq keys)"),
     ),
+    # serving plane (ISSUE 17, scripts/run_serve_bench.sh): the
+    # loadgen serve fleet (1k open-loop clients) against a 2-worker
+    # SO_REUSEPORT serving cell on a real ditto bundle. Structural
+    # cells exact — the shutdown accounting, the one-program-per-
+    # (model, bucket) compile pin, the per-site routing distinctness —
+    # and the wall cells (requests/s, client p99) at the standard
+    # drift-tolerant ratio tripwires.
+    "serve_bench.json": (
+        Check("summary.audits_green", "true",
+              note="client-side exactness + root/bye verdict "
+                   "reconciliation (zero unaccounted requests)"),
+        Check("serve.compile_pin_ok", "true",
+              note="ONE compiled program per (model, bucket); zero "
+                   "recompiles (the tripwire counter)"),
+        Check("serve.routing.distinct_site_models", "true",
+              note="two sites observed two DIFFERENT personalized "
+                   "bundle digests"),
+        Check("serve.merged_metrics.has_serve_latency", "true",
+              note="merged scrape carries nidt_serve_latency_ms "
+                   "samples"),
+        Check("serve.merged_metrics.has_rtt_samples", "true",
+              note="client-observed nidt_client_rtt_ms published "
+                   "through the shared fleet path"),
+        Check("serve.serve_workers", "eq",
+              note="the committed cell is the 2-worker config"),
+        Check("serve.requests_per_s", "ratio_min", 0.5,
+              "client-confirmed serving throughput"),
+        Check("serve.rtt_ms_p99", "ratio_max", 2.0,
+              "client-observed p99 RTT tripwire (box drift "
+              "tolerated)"),
+    ),
     "profile_session.json": (
         Check("session.structural_fingerprint", "eq",
               note="the declared probe manifest (structural cells)"),

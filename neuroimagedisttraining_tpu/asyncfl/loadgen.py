@@ -315,6 +315,396 @@ def _fleet_proc_main(conn, ranks, port, leaf_elems, secure, seed,
     conn.close()
 
 
+# ---------------------------------------------------------------------------
+# serve mode (ISSUE 17): seeded open-loop request fleet against the
+# sharded serving plane
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ServeClientStats:
+    """Per-client serving-fleet counters; every request attempt lands in
+    exactly ONE of ok/rejected/errors (the client half of the
+    zero-unaccounted-requests audit)."""
+
+    sent: int = 0
+    ok: int = 0
+    rejected: int = 0
+    errors: int = 0
+    reconnects: int = 0
+    #: client-observed request RTTs (ms), published through the SAME
+    #: ``nidt_client_rtt_ms`` path as the ingest fleet at merge
+    rtt_ms: list = dataclasses.field(default_factory=list)
+    #: site -> {model digest -> count} from /predict replies — the
+    #: routing proof (two sites must observe different digests)
+    routes: dict = dataclasses.field(default_factory=dict)
+
+
+def _merge_serve_stats(all_stats) -> ServeClientStats:
+    m = ServeClientStats()
+    for s in all_stats:
+        m.sent += s.sent
+        m.ok += s.ok
+        m.rejected += s.rejected
+        m.errors += s.errors
+        m.reconnects += s.reconnects
+        m.rtt_ms.extend(s.rtt_ms)
+        for site, digests in s.routes.items():
+            dst = m.routes.setdefault(site, {})
+            for d, n in digests.items():
+                dst[d] = dst.get(d, 0) + n
+    return m
+
+
+def _publish_fleet_rtt(rtt_ms) -> None:
+    """ONE ``nidt_client_rtt_ms`` publication path for every fleet
+    (ISSUE 17 satellite): spawned shards (and the serve clients, which
+    never observe live) collected their samples in ``rtt_ms`` lists —
+    backfill them into THIS process's histogram so the merged scrape
+    carries the distribution without re-measuring."""
+    if not rtt_ms:
+        return
+    h = obs_fanin.rtt_histogram()
+    for v in rtt_ms:
+        h.observe(float(v))
+
+
+async def _read_http_response(reader) -> tuple[int, bytes]:
+    """Minimal HTTP/1.1 keep-alive response read (status, body)."""
+    line = await reader.readline()
+    if not line:
+        raise ConnectionError("server closed connection")
+    status = int(line.split()[1])
+    clen = 0
+    while True:
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n", b""):
+            break
+        if h.lower().startswith(b"content-length:"):
+            clen = int(h.split(b":", 1)[1])
+    body = await reader.readexactly(clen) if clen else b""
+    return status, body
+
+
+async def _serve_client(rank: int, port: int, shape: tuple,
+                        n_requests: int, site: str | None,
+                        stats: ServeClientStats, pace_s: float,
+                        seed: int, start_stagger: float) -> None:
+    """One serving client: a persistent keep-alive connection sending
+    ``n_requests`` raw-array /predict POSTs with seeded pacing gaps; on
+    a transport error (e.g. its SO_REUSEPORT listener was SIGKILLed) it
+    counts the attempt as an error and reconnects — the kernel lands
+    the new connection on a surviving listener."""
+    await asyncio.sleep(start_stagger)
+    rng = np.random.default_rng(100003 * seed + rank)
+    body = rng.standard_normal(shape).astype(np.float32).tobytes()
+    head = (f"POST /predict HTTP/1.1\r\nHost: nidt\r\n"
+            f"Content-Type: application/octet-stream\r\n"
+            f"X-NIDT-Shape: {','.join(str(d) for d in shape)}\r\n"
+            + (f"X-NIDT-Site: {site}\r\n" if site is not None else "")
+            + f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+    reader = writer = None
+    for i in range(n_requests):
+        stats.sent += 1
+        t0 = time.perf_counter()
+        try:
+            if writer is None:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                if i:
+                    stats.reconnects += 1
+            writer.write(head)
+            await writer.drain()
+            status, payload = await _read_http_response(reader)
+            stats.rtt_ms.append((time.perf_counter() - t0) * 1e3)
+            if status == 200:
+                stats.ok += 1
+                reply = json.loads(payload)
+                key = site if site is not None else ""
+                digests = stats.routes.setdefault(key, {})
+                digests[reply["digest"]] = \
+                    digests.get(reply["digest"], 0) + 1
+            elif 400 <= status < 500:
+                stats.rejected += 1
+            else:
+                stats.errors += 1
+        except (OSError, ConnectionError, ValueError,
+                asyncio.IncompleteReadError):
+            stats.errors += 1
+            if writer is not None:
+                try:
+                    writer.close()
+                except OSError:
+                    pass
+            reader = writer = None
+            await asyncio.sleep(0.02)
+        if pace_s > 0:
+            await asyncio.sleep(float(rng.exponential(pace_s)))
+    if writer is not None:
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except (OSError, ConnectionError):
+            pass
+
+
+def _serve_fleet_proc_main(conn, ranks, port, shape, n_by_rank, sites,
+                           seed, pace_s, ready_go) -> None:
+    """Spawned serving fleet shard (same scale-out rationale as
+    ``_fleet_proc_main``): run the rank slice's clients, ship their
+    ``ServeClientStats`` home over the pipe."""
+    stats = {r: ServeClientStats() for r in ranks}
+
+    async def fleet():
+        tasks = [asyncio.create_task(_serve_client(
+            r, port, tuple(shape), n_by_rank[r],
+            sites[r % len(sites)] if sites else None, stats[r], pace_s,
+            seed, start_stagger=r * 0.002))
+            for r in ranks]
+        await asyncio.gather(*tasks)
+
+    conn.send("ready")  # nidt: allow[lock-send] -- the shard's end of the pipe has exactly one writer: this function, sequentially
+    ready_go.wait()
+    asyncio.run(fleet())
+    conn.send([dataclasses.asdict(s) for s in stats.values()])  # nidt: allow[lock-send] -- same single sequential writer
+    conn.close()
+
+
+def _run_serve_load(*, num_clients: int, serve_bundle: str,
+                    serve_workers: int, serve_requests: int,
+                    serve_kill_at: int, batch_buckets, max_queue_ms,
+                    serve_precision: str, seed: int, fleet_procs: int,
+                    base_port, metrics_port: int, trace_out: str,
+                    flight_out: str) -> dict:
+    """``mode="serve"``: drive a seeded open-loop request fleet against
+    the sharded serving plane (serve/server.py) and return the bench
+    cell. ``serve_kill_at >= 0`` SIGKILLs serve worker 0 once that many
+    requests were served (the chaos cell — clients reconnect onto the
+    surviving SO_REUSEPORT listeners; the admission audit plus the
+    client-side accounting bound every request)."""
+    from neuroimagedisttraining_tpu.serve.bundle import read_manifest
+    from neuroimagedisttraining_tpu.serve.server import ShardedServeServer
+
+    if not serve_bundle:
+        raise ValueError(
+            "mode='serve' requires serve_bundle: a bundle directory "
+            "(build one with python -m neuroimagedisttraining_tpu.serve "
+            "--from_checkpoint ... --build_only)")
+    manifest = read_manifest(serve_bundle)
+    shape = tuple(manifest["input_shape"])
+    #: route the fleet across the first two personalized site models
+    #: (the routing-distinctness proof); a site-less bundle serves the
+    #: global model to everyone
+    sites = [str(s) for s in manifest["sites"][:2]]
+    total = serve_requests if serve_requests > 0 else 2 * num_clients
+    n_by_rank = {r: total // num_clients
+                 + (1 if r <= total % num_clients else 0)
+                 for r in range(1, num_clients + 1)}
+    pace_s = 0.01  # seeded exponential think-time between requests
+
+    if trace_out:
+        obs_trace.arm(trace_out, tags={"role": "loadgen-serve-root"})
+    server = ShardedServeServer(
+        serve_bundle, port=int(base_port or 0),
+        serve_workers=serve_workers, batch_buckets=tuple(batch_buckets),
+        max_queue_ms=max_queue_ms, precision=serve_precision,
+        trace_out=trace_out, flight_out=flight_out)
+    msrv = None
+    if metrics_port:
+        from neuroimagedisttraining_tpu.obs.http import MetricsServer
+
+        msrv = MetricsServer(max(0, int(metrics_port)),
+                             registry=server.metrics_view(),
+                             health_probe=server.health)
+
+    stats = [ServeClientStats() for _ in range(num_clients + 1)]
+    fleet_workers: list[tuple] = []
+    ready_go = None
+    if fleet_procs > 1:
+        ctx = mp.get_context("spawn")
+        ready_go = ctx.Event()
+        slices = np.array_split(np.arange(1, num_clients + 1),
+                                fleet_procs)
+        for sl in slices:
+            parent_c, child_c = ctx.Pipe(duplex=False)
+            p = ctx.Process(
+                target=_serve_fleet_proc_main,
+                args=(child_c, [int(r) for r in sl], server.port,
+                      shape, {int(r): n_by_rank[int(r)] for r in sl},
+                      sites, seed, pace_s, ready_go),
+                daemon=True, name="nidt-loadgen-serve-fleet")
+            p.start()
+            child_c.close()
+            fleet_workers.append((p, parent_c))
+        for p, c in fleet_workers:
+            if not c.poll(300.0) or c.recv() != "ready":
+                raise RuntimeError(
+                    "loadgen serve fleet shard failed to start")
+
+    killed = threading.Event()
+    fleet_done = threading.Event()
+    if serve_kill_at >= 0:
+        def _kill_watch():
+            while not fleet_done.is_set():
+                if server.total("served") >= serve_kill_at:
+                    try:
+                        os.kill(server.worker_pids[0], signal.SIGKILL)
+                        killed.set()
+                    except (OSError, IndexError):
+                        pass
+                    return
+                time.sleep(0.02)
+
+        threading.Thread(target=_kill_watch, daemon=True,
+                         name="serve-kill-watch").start()
+
+    t0 = time.monotonic()
+    if fleet_procs > 1:
+        ready_go.set()
+        for p, c in fleet_workers:
+            if c.poll(600.0):
+                for d in c.recv():
+                    stats.append(ServeClientStats(**d))
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+    else:
+        async def _fleet():
+            tasks = [asyncio.create_task(_serve_client(
+                r, server.port, shape, n_by_rank[r],
+                sites[r % len(sites)] if sites else None, stats[r],
+                pace_s, seed, start_stagger=r * 0.002))
+                for r in range(1, num_clients + 1)]
+            await asyncio.gather(*tasks)
+
+        asyncio.run(_fleet())
+    wall = time.monotonic() - t0
+    fleet_done.set()
+    fleet = _merge_serve_stats(stats)
+    # the one nidt_client_rtt_ms publication path (shared helper with
+    # the ingest fleet backfill)
+    _publish_fleet_rtt(fleet.rtt_ms)
+
+    audit = server.stop()
+    # ---- compile pin: ONE program per (model, bucket), no recompiles;
+    #      a SIGKILLed worker ships no bye, so its pin is unknowable
+    #      and skipped (the root's counts still bound its requests) ----
+    pin_ok = True
+    total_compiles = total_recompiles = dispatches = 0
+    requests_dispatched = slots = 0
+    batches: dict[str, int] = {}
+    compiled_programs: dict[str, list] = {}
+    for wid, pw in sorted(audit["per_worker"].items()):
+        eng = pw.get("engine")
+        if eng is None:
+            pin_ok = pin_ok and not pw["alive"]
+            continue
+        compiled_programs[wid] = eng["compiled"]
+        total_compiles += eng["compiles"]
+        total_recompiles += eng["recompiles"]
+        dispatches += eng["dispatches"]
+        requests_dispatched += eng["requests_dispatched"]
+        for b, n in eng["batches"].items():
+            batches[b] = batches.get(b, 0) + n
+            slots += int(b) * n
+        pin_ok = (pin_ok and eng["recompiles"] == 0
+                  and eng["compiles"] == len(set(eng["compiled"])))
+    if audit["dead_workers"] == 0:
+        # cross-pin against the fan-in-merged compute-plane counter —
+        # worker-labeled cells only: the root registry may carry serve
+        # compiles of engines run in THIS process (tests), and a
+        # killed worker's stale snapshot would skew it (hence the
+        # dead_workers guard)
+        snap = server.fanin.merged_snapshot().get(
+            obs_names.COMPILES_TOTAL)
+        metric_compiles = sum(
+            c["value"] for c in (snap or {"values": []})["values"]
+            if (c["labels"].get("engine") == "serve"
+                and "worker" in c["labels"]))
+        pin_ok = pin_ok and int(metric_compiles) == total_compiles
+
+    # ---- routing proof: each site observed exactly one digest, and
+    #      the digests differ across sites ----
+    per_site = {site: sorted(d) for site, d in fleet.routes.items()}
+    distinct = (len(per_site) >= 2
+                and all(len(d) == 1 for d in per_site.values())
+                and len({d[0] for d in per_site.values()})
+                == len(per_site))
+
+    received = audit["received"]
+    client_exact = (fleet.sent
+                    == fleet.ok + fleet.rejected + fleet.errors)
+    # every client-confirmed reply had a server verdict; a killed
+    # worker's unflushed tail (<= one flush interval) is the only
+    # legitimate gap and is reported, not hidden
+    unflushed = max(0, fleet.ok + fleet.rejected - received)
+    reconciled = bool(
+        audit["reconciled"] and client_exact
+        and received <= fleet.sent
+        and (unflushed == 0 or killed.is_set()))
+
+    merged_text = server.fanin.prometheus_text()
+    import re as _re
+
+    result = {
+        "mode": "serve",
+        "bundle": serve_bundle,
+        "model": manifest["model"],
+        "model_version": manifest["source_round"],
+        "precision": serve_precision or manifest["precision"],
+        "num_clients": num_clients,
+        "serve_workers": int(serve_workers),
+        "batch_buckets": [int(b) for b in batch_buckets],
+        "max_queue_ms": float(max_queue_ms),
+        "serve_kill_at": (int(serve_kill_at) if serve_kill_at >= 0
+                          else None),
+        "worker_killed": killed.is_set(),
+        "workers_live_at_end": server.live_workers(),
+        "wall_s": round(wall, 3),
+        "requests_target": total,
+        "requests_sent": fleet.sent,
+        "requests_ok": fleet.ok,
+        "requests_rejected": fleet.rejected,
+        "client_errors": fleet.errors,
+        "client_reconnects": fleet.reconnects,
+        "requests_per_s": round(fleet.ok / wall, 2) if wall else 0.0,
+        "rtt_ms_p50": (round(float(np.percentile(fleet.rtt_ms, 50)), 2)
+                       if fleet.rtt_ms else None),
+        "rtt_ms_p99": (round(float(np.percentile(fleet.rtt_ms, 99)), 2)
+                       if fleet.rtt_ms else None),
+        "dispatches": dispatches,
+        "batches": batches,
+        "batch_occupancy": (round(requests_dispatched / slots, 3)
+                            if slots else None),
+        "compiled_programs": compiled_programs,
+        "compiles_total": total_compiles,
+        "recompiles_total": total_recompiles,
+        "compile_pin_ok": bool(pin_ok),
+        "routing": {"per_site": per_site,
+                    "distinct_site_models": bool(distinct)},
+        "serve_audit": audit,
+        "unflushed_with_worker": unflushed,
+        "frames_reconciled": reconciled,
+        "obs_fanin": server.fanin.summary(),
+        "merged_metrics": {
+            "port": msrv.port if msrv is not None else None,
+            "lines": len(merged_text.splitlines()),
+            "worker_labeled": sorted(
+                {int(m) for m in _re.findall(r'worker="(\d+)"',
+                                             merged_text)}),
+            "has_serve_latency":
+                (obs_names.SERVE_LATENCY_MS + "_bucket") in merged_text,
+            "has_rtt_samples":
+                (obs_names.CLIENT_RTT_MS + "_bucket") in merged_text,
+        },
+    }
+    if trace_out:
+        obs_trace.disarm()
+    if msrv is not None:
+        msrv.close()
+    return result
+
+
 class _TimedSyncServer(FedAvgServer):
     """The round-synchronous baseline with advance timestamps, so both
     modes report the same p99 version-advance metric."""
@@ -341,7 +731,14 @@ def run_load(mode: str = "async", num_clients: int = 200,
              fleet_procs: int = 1,
              trace_out: str = "",
              flight_out: str = "",
-             metrics_port: int = 0) -> dict:
+             metrics_port: int = 0,
+             serve_bundle: str = "",
+             serve_workers: int = 2,
+             serve_requests: int = 0,
+             serve_kill_at: int = -1,
+             batch_buckets=(1, 2, 4, 8),
+             max_queue_ms: float = 2.0,
+             serve_precision: str = "") -> dict:
     """Drive ``num_clients`` simulated clients against one server and
     return the metrics dict. ``mode="async"`` runs the buffered server
     for ``aggregations`` aggregations of ``buffer_k`` uploads each;
@@ -357,8 +754,24 @@ def run_load(mode: str = "async", num_clients: int = 200,
     only — fault schedules need the in-process server probes and pin
     ``fleet_procs=1``); the same fleet drives every mode, so the
     comparison stays generator-fair."""
+    if mode == "serve":
+        if fault_spec:
+            raise ValueError(
+                "mode='serve' does not take fault_spec; use "
+                "serve_kill_at for the serving chaos cell")
+        return _run_serve_load(
+            num_clients=num_clients, serve_bundle=serve_bundle,
+            serve_workers=serve_workers,
+            serve_requests=serve_requests,
+            serve_kill_at=serve_kill_at, batch_buckets=batch_buckets,
+            max_queue_ms=max_queue_ms,
+            serve_precision=serve_precision, seed=seed,
+            fleet_procs=fleet_procs, base_port=base_port,
+            metrics_port=metrics_port, trace_out=trace_out,
+            flight_out=flight_out)
     if mode not in ("async", "sync", "ingest"):
-        raise ValueError(f"mode must be async|sync|ingest, got {mode!r}")
+        raise ValueError(
+            f"mode must be async|sync|ingest|serve, got {mode!r}")
     port = base_port if base_port is not None else free_port_block(2)
     k = int(buffer_k) if buffer_k else num_clients
     init = canned_update_tree(0, leaf_elems)
@@ -534,15 +947,14 @@ def run_load(mode: str = "async", num_clients: int = 200,
         for f in dataclasses.fields(ClientStats):
             setattr(fleet, f.name,
                     getattr(fleet, f.name) + getattr(s, f.name))
-    if fleet_procs > 1 and fleet.rtt_ms:
+    if fleet_procs > 1:
         # sharded fleets ran EVERY client in spawned processes whose
         # registries never ship home — backfill their RTT samples into
         # this process's histogram so the merged scrape still carries
         # the distribution (in-process fleets observed live above, and
-        # run exactly one of the two paths, so no double count)
-        h = obs_fanin.rtt_histogram()
-        for v in fleet.rtt_ms:
-            h.observe(float(v))
+        # run exactly one of the two paths, so no double count); the
+        # serve fleet reuses the same publication path
+        _publish_fleet_rtt(fleet.rtt_ms)
     if mode in ("async", "ingest"):
         adv_t = [h["t"] for h in server.history]
         accepted = server.upload_stats["accepted"]
@@ -681,13 +1093,16 @@ def main(argv=None) -> int:
         description=__doc__.split("\n\n")[0])
     ap.add_argument("--clients", type=int, default=1000)
     ap.add_argument("--mode", choices=("async", "sync", "both", "ingest",
-                                       "ingest_bench"),
+                                       "ingest_bench", "serve"),
                     default="both",
                     help="ingest = one sharded-plane run at "
                          "--ingest_workers; ingest_bench = the headline "
                          "sweep (single-process async baseline, then "
                          "ingest at N in {1, 2, 4} workers) -> "
-                         "bench_matrix/ingest_bench.json")
+                         "bench_matrix/ingest_bench.json; serve = "
+                         "open-loop request fleet against the serving "
+                         "plane (--serve_bundle) -> "
+                         "bench_matrix/serve_bench.json")
     ap.add_argument("--aggregations", type=int, default=30,
                     help="async: buffered aggregations to run; the sync "
                          "baseline runs the round count consuming a "
@@ -712,6 +1127,30 @@ def main(argv=None) -> int:
     ap.add_argument("--ingest_secure_quant", action="store_true",
                     help="clients ship secure-quant field-element "
                          "frames; workers fold SlotAccumulator chunks")
+    ap.add_argument("--serve_bundle", type=str, default="",
+                    help="mode serve: deployment-bundle directory "
+                         "(python -m neuroimagedisttraining_tpu.serve "
+                         "--from_checkpoint ... --build_only)")
+    ap.add_argument("--serve_workers", type=int, default=2,
+                    help="mode serve: HTTP worker processes on the "
+                         "shared SO_REUSEPORT port")
+    ap.add_argument("--serve_requests", type=int, default=0,
+                    help="mode serve: total requests across the fleet "
+                         "(0 = 2 per client)")
+    ap.add_argument("--serve_kill_at", type=int, default=-1,
+                    help="SIGKILL serve worker 0 once this many "
+                         "requests were served (chaos cell; -1 = "
+                         "never)")
+    ap.add_argument("--batch_buckets", type=str, default="1,2,4,8",
+                    help="mode serve: declared batch sizes, e.g. "
+                         "1,2,4,8")
+    ap.add_argument("--max_queue_ms", type=float, default=2.0,
+                    help="mode serve: max wait of the oldest queued "
+                         "request for batch-mates")
+    ap.add_argument("--serve_precision", type=str, default="",
+                    choices=("", "bf16", "fp32"),
+                    help="mode serve: serving precision override "
+                         "('' = as stored in the bundle)")
     ap.add_argument("--metrics_port", type=int, default=0,
                     help="ingest modes: serve the MERGED /metrics "
                          "(root + worker-labeled samples + staleness "
@@ -739,7 +1178,7 @@ def main(argv=None) -> int:
 
     fleet_procs = args.fleet_procs
     if fleet_procs == 0:
-        fleet_procs = (3 if args.mode == "ingest_bench"
+        fleet_procs = (3 if args.mode in ("ingest_bench", "serve")
                        and not args.fault_spec else 1)
     common = dict(
         num_clients=args.clients, aggregations=args.aggregations,
@@ -771,11 +1210,38 @@ def main(argv=None) -> int:
                           metrics_port=args.metrics_port,
                           trace_out=args.trace_out,
                           flight_out=args.flight_out)
+            elif mode == "serve":
+                kw.update(
+                    serve_bundle=args.serve_bundle,
+                    serve_workers=args.serve_workers,
+                    serve_requests=args.serve_requests,
+                    serve_kill_at=args.serve_kill_at,
+                    batch_buckets=tuple(
+                        int(b) for b in args.batch_buckets.split(",")
+                        if b.strip()),
+                    max_queue_ms=args.max_queue_ms,
+                    serve_precision=args.serve_precision,
+                    metrics_port=args.metrics_port,
+                    trace_out=args.trace_out,
+                    flight_out=args.flight_out)
             cells[mode] = run_load(mode=mode, **kw)
             print(json.dumps(cells[mode]), flush=True)
     bench_name = ("ingest_plane" if args.mode == "ingest_bench"
+                  else "serve_plane" if args.mode == "serve"
                   else "async_control_plane")
     out = {"bench": bench_name, **cells}
+    if args.mode == "serve":
+        c = cells["serve"]
+        out["summary"] = {
+            "audits_green": bool(c["serve_audit"]["reconciled"]
+                                 and c["frames_reconciled"]),
+            "requests_per_s": c["requests_per_s"],
+            "compile_pin_ok": c["compile_pin_ok"],
+            "distinct_site_models":
+                c["routing"]["distinct_site_models"],
+            "fleet_procs": fleet_procs,
+        }
+        print(json.dumps({"summary": out["summary"]}), flush=True)
     if args.mode == "ingest_bench":
         base = cells["async"]["uploads_per_s_sustained"]
         # the ISSUE's yardstick is the COMMITTED single-process selector

@@ -98,6 +98,14 @@ HEALTH_MASK_OVERLAP = "nidt_health_mask_overlap"
 HEALTH_MASK_CHURN = "nidt_health_mask_churn"
 HEALTH_ROUND = "nidt_health_round"
 
+# -- serving plane (serve/engine.py, serve/worker.py, serve/server.py) --
+SERVE_LATENCY_MS = "nidt_serve_latency_ms"
+SERVE_BATCH_OCCUPANCY = "nidt_serve_batch_occupancy"
+SERVE_QUEUE_DEPTH = "nidt_serve_queue_depth"
+SERVE_REQUESTS = "nidt_serve_requests_total"
+SERVE_WORKERS_LIVE = "nidt_serve_workers_live"
+SERVE_WORKER_REQUESTS = "nidt_serve_worker_requests_total"
+
 # -- anomaly-rule engine (obs/rules.py) --
 ALERT = "nidt_alert"
 

@@ -454,6 +454,26 @@ def builtin_rules(dp_epsilon_budget: float = 0.0, comm_round: int = 200,
             description=(
                 "2+ quarantines entered within 5 boundaries — a "
                 "coordinated anomaly, not one flaky silo")),
+        # -- serving plane (ISSUE 17): evaluated at the engine's
+        #    dispatch boundary inside each serve worker --
+        HealthRule(
+            name="serve-p99-latency", metric=N.SERVE_LATENCY_MS,
+            op=">", threshold=1000.0,
+            labels=(("stage", "dispatch"),), for_rounds=2,
+            severity="warn",
+            description=(
+                "p99 dispatch-stage serving latency above 1s for 2 "
+                "boundaries: the compiled forward no longer keeps up "
+                "with the offered load (bucket misconfiguration, "
+                "recompile storm, or host contention)")),
+        HealthRule(
+            name="serve-queue-runaway", metric=N.SERVE_QUEUE_DEPTH,
+            op=">", threshold=512.0, for_rounds=2, severity="warn",
+            description=(
+                "512+ requests queued behind the micro-batcher for 2 "
+                "boundaries: arrival rate exceeds dispatch throughput "
+                "and waiters are compounding (/predict is about to "
+                "time out)")),
     ]
     if dp_epsilon_budget > 0:
         rules.append(HealthRule(

@@ -1,0 +1,28 @@
+"""Serving plane (ISSUE 17): checkpoint→bundle contract, jitted
+micro-batched inference, per-site model routing, and the SO_REUSEPORT
+HTTP frontend.
+
+Layering mirrors the ingest plane: ``bundle`` owns the versioned
+deployment artifact, ``engine`` owns the compiled forward programs and
+the micro-batcher, ``worker`` is the per-process HTTP listener, and
+``server`` is the root that spawns/audits/fans-in the worker fleet.
+``python -m neuroimagedisttraining_tpu.serve`` is the operator CLI.
+"""
+
+from neuroimagedisttraining_tpu.serve.bundle import (  # noqa: F401
+    BundleError,
+    ServeBundle,
+    build_bundle,
+    load_bundle,
+)
+from neuroimagedisttraining_tpu.serve.engine import ServeEngine  # noqa: F401
+
+
+def __getattr__(name):
+    # server pulls in the multiprocessing stack; keep bundle/engine
+    # importable without it (worker processes import serve.bundle only)
+    if name == "ShardedServeServer":
+        from neuroimagedisttraining_tpu.serve.server import (
+            ShardedServeServer)
+        return ShardedServeServer
+    raise AttributeError(name)

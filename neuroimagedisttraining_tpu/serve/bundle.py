@@ -1,0 +1,331 @@
+"""Checkpoint→deployment bundle contract (ISSUE 17 tentpole part 1).
+
+A bundle is the unit ROADMAP item 2's regional distribution ships: a
+directory holding ``manifest.json`` + ``weights.msgpack``. ``build_bundle``
+reads a training checkpoint (utils/checkpoint.py format), strips training
+state (opt state, PRNG keys, history, fomo weights), converts the f32
+masters to bf16 inference weights (fp32 retained behind ``precision=
+"fp32"``), applies salientgrads sparse masks at load (the served params
+ARE sparse; nnz is pinned in the manifest), and unstacks per-silo
+personalized models (ditto/fedfomo ``per_params`` [C, ...] stacks) into
+per-site entries so the frontend can route ``site → personalized model``.
+
+The manifest is deliberately timestamp-free and written with sorted keys
+so save→load→save is bitwise-stable (tests pin this), and it carries a
+sha256 over the weights payload plus per-model digests: ``load_bundle``
+recomputes both and rejects loudly on any drift — the same
+trust-the-committed-artifact posture as analysis/bench_gate.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from flax import serialization
+
+from neuroimagedisttraining_tpu.utils.checkpoint import load_checkpoint
+
+#: bump when the manifest schema or weights layout changes; load_bundle
+#: rejects any other version.
+BUNDLE_VERSION = 1
+
+MANIFEST_NAME = "manifest.json"
+WEIGHTS_NAME = "weights.msgpack"
+
+#: manifest keys that must be present (schema floor for drift rejection)
+_REQUIRED_KEYS = (
+    "bundle_version", "model", "num_classes", "input_shape", "precision",
+    "source_round", "flavor", "sites", "sparse_nnz", "total_params",
+    "weights_sha256", "models",
+)
+
+GLOBAL_KEY = "global"
+
+
+class BundleError(ValueError):
+    """Raised on any bundle build/load contract violation. Always loud:
+    the message names the file and the specific drift."""
+
+
+def _site_key(site: str) -> str:
+    return f"site:{site}"
+
+
+def _sha256(raw: bytes) -> str:
+    return hashlib.sha256(raw).hexdigest()
+
+
+def _to_numpy(tree: Any) -> Any:
+    return jax.tree.map(np.asarray, tree)
+
+
+def _cast_floats(tree: Any, dtype) -> Any:
+    def cast(x):
+        x = np.asarray(x)
+        if np.issubdtype(x.dtype, np.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+def _count_params(tree: Any) -> int:
+    return int(sum(np.asarray(x).size for x in jax.tree.leaves(tree)))
+
+
+def _count_nnz(tree: Any) -> int:
+    return int(sum(int(np.count_nonzero(np.asarray(x)))
+                   for x in jax.tree.leaves(tree)))
+
+
+def _model_digest(entry: dict) -> str:
+    """Per-model digest: sha256 over the model's own serialized subtree.
+
+    This is what /predict replies echo, so two sites can PROVE they were
+    served different personalized weights (bench routing check)."""
+    return _sha256(serialization.msgpack_serialize(entry))
+
+
+def _apply_mask(params: Any, masks: Any) -> Any:
+    """Multiply salientgrads masks into the served params (sparse at
+    load — the engine never sees the mask, only the zeroed weights)."""
+    try:
+        return jax.tree.map(lambda p, m: np.asarray(p) * np.asarray(m),
+                            params, masks)
+    except ValueError as e:
+        raise BundleError(
+            f"salientgrads mask tree does not match params tree: {e}"
+        ) from e
+
+
+def _unstack(tree: Any, idx: int) -> Any:
+    """Row ``idx`` of a [C, ...] stacked per-silo tree."""
+    return jax.tree.map(lambda x: np.asarray(x)[idx], tree)
+
+
+def _stack_dim(tree: Any) -> int:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return 0
+    return int(np.asarray(leaves[0]).shape[0])
+
+
+def _infer_flavor(state: dict) -> str:
+    """Name the checkpoint flavor from its state keys (the shapes are an
+    engine contract — see engines/*.py maybe_checkpoint payloads)."""
+    if "masks" in state:
+        return "salientgrads"
+    if "p_choose" in state or "weights" in state:
+        return "fedfomo"
+    if "per_params" in state:
+        return "ditto"
+    if "params" in state:
+        return "fedavg"
+    raise BundleError(
+        f"unrecognized checkpoint state (keys={sorted(state)}): no "
+        "params/per_params — not a federation checkpoint?")
+
+
+def _mean_tree(tree: Any) -> Any:
+    """Uniform mean over the leading [C, ...] stack axis — the global
+    fallback for fedfomo checkpoints, which keep no global model."""
+    return jax.tree.map(
+        lambda x: np.mean(np.asarray(x, np.float32), axis=0), tree)
+
+
+def build_bundle(checkpoint_dir: str, out_dir: str, *, model: str,
+                 num_classes: int, input_shape: tuple[int, ...] | list[int],
+                 precision: str = "bf16", round_idx: int | None = None,
+                 ) -> dict:
+    """Convert a training checkpoint into a deployment bundle directory.
+
+    Returns the manifest dict. ``precision`` is ``"bf16"`` (default:
+    f32 masters → bf16 inference weights) or ``"fp32"`` (the retained
+    full-precision flag)."""
+    if precision not in ("bf16", "fp32"):
+        raise BundleError(
+            f"precision must be bf16|fp32, got {precision!r}")
+    found = load_checkpoint(checkpoint_dir, round_idx)
+    if found is None:
+        raise BundleError(f"no checkpoints in {checkpoint_dir!r}")
+    source_round, state = found
+    flavor = _infer_flavor(state)
+
+    g_params = state.get("params")
+    g_bstats = state.get("batch_stats", {})
+    per_params = state.get("per_params")
+    per_bstats = state.get("per_bstats", {})
+    masks = state.get("masks")
+    sparse_nnz = None
+    if masks is not None and g_params is not None:
+        g_params = _apply_mask(g_params, masks)
+        sparse_nnz = _count_nnz(g_params)
+    if g_params is None:
+        # fedfomo keeps no global model; serve the uniform mean of the
+        # personalized stack as the unknown-site fallback.
+        if per_params is None:
+            raise BundleError(
+                f"checkpoint flavor {flavor!r} has neither params nor "
+                "per_params")
+        g_params = _mean_tree(per_params)
+        g_bstats = (_mean_tree(per_bstats)
+                    if jax.tree.leaves(per_bstats) else {})
+
+    dtype = np.float32 if precision == "fp32" else jax.numpy.bfloat16
+    models: dict[str, dict] = {
+        GLOBAL_KEY: {
+            "params": _cast_floats(g_params, dtype),
+            "batch_stats": _cast_floats(_to_numpy(g_bstats), dtype),
+        }
+    }
+    sites: list[str] = []
+    if per_params is not None:
+        n_sites = _stack_dim(per_params)
+        has_bstats = bool(jax.tree.leaves(per_bstats))
+        for i in range(n_sites):
+            site = str(i)
+            sites.append(site)
+            p_i = _unstack(per_params, i)
+            if masks is not None:
+                p_i = _apply_mask(p_i, masks)
+            models[_site_key(site)] = {
+                "params": _cast_floats(p_i, dtype),
+                "batch_stats": _cast_floats(
+                    _unstack(per_bstats, i) if has_bstats else {}, dtype),
+            }
+
+    payload = serialization.msgpack_serialize(
+        {k: models[k] for k in sorted(models)})
+    manifest = {
+        "bundle_version": BUNDLE_VERSION,
+        "model": model,
+        "num_classes": int(num_classes),
+        "input_shape": [int(d) for d in input_shape],
+        "precision": precision,
+        "source_round": int(source_round),
+        "flavor": flavor,
+        "sites": sites,
+        "sparse_nnz": sparse_nnz,
+        "total_params": _count_params(models[GLOBAL_KEY]["params"]),
+        "weights_sha256": _sha256(payload),
+        "models": {k: {"digest": _model_digest(models[k])}
+                   for k in sorted(models)},
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, WEIGHTS_NAME), "wb") as f:
+        f.write(payload)
+    with open(os.path.join(out_dir, MANIFEST_NAME), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return manifest
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeBundle:
+    """A validated, loaded bundle: the manifest plus the weight trees
+    keyed ``"global"`` / ``"site:<id>"``."""
+
+    path: str
+    manifest: dict
+    models: dict[str, dict]
+
+    @property
+    def model_name(self) -> str:
+        return self.manifest["model"]
+
+    @property
+    def num_classes(self) -> int:
+        return int(self.manifest["num_classes"])
+
+    @property
+    def input_shape(self) -> tuple[int, ...]:
+        return tuple(self.manifest["input_shape"])
+
+    @property
+    def precision(self) -> str:
+        return self.manifest["precision"]
+
+    @property
+    def source_round(self) -> int:
+        return int(self.manifest["source_round"])
+
+    @property
+    def sites(self) -> tuple[str, ...]:
+        return tuple(self.manifest["sites"])
+
+    def digest(self, model_key: str) -> str:
+        return self.manifest["models"][model_key]["digest"]
+
+    def route(self, site: str | None) -> str:
+        """Site → model key; unknown/absent sites fall back to the
+        global model (the caller records the unknown-site verdict)."""
+        if site is not None and _site_key(site) in self.models:
+            return _site_key(site)
+        return GLOBAL_KEY
+
+
+def read_manifest(bundle_dir: str) -> dict:
+    """Parse + schema-validate manifest.json (no weights read)."""
+    mpath = os.path.join(bundle_dir, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except FileNotFoundError as e:
+        raise BundleError(f"not a bundle: {mpath} missing") from e
+    except json.JSONDecodeError as e:
+        raise BundleError(f"corrupt manifest {mpath}: {e}") from e
+    missing = [k for k in _REQUIRED_KEYS if k not in manifest]
+    if missing:
+        raise BundleError(
+            f"stale manifest {mpath}: missing keys {missing} "
+            f"(schema version {BUNDLE_VERSION} requires "
+            f"{list(_REQUIRED_KEYS)})")
+    if manifest["bundle_version"] != BUNDLE_VERSION:
+        raise BundleError(
+            f"bundle version mismatch in {mpath}: found "
+            f"{manifest['bundle_version']!r}, this tree speaks "
+            f"{BUNDLE_VERSION}")
+    return manifest
+
+
+def load_bundle(bundle_dir: str) -> ServeBundle:
+    """Load + verify a bundle. Every drift path raises ``BundleError``
+    naming the mismatch: bad schema, payload sha256, site set, or
+    per-model digest."""
+    manifest = read_manifest(bundle_dir)
+    wpath = os.path.join(bundle_dir, WEIGHTS_NAME)
+    try:
+        with open(wpath, "rb") as f:
+            payload = f.read()
+    except FileNotFoundError as e:
+        raise BundleError(f"bundle {bundle_dir!r}: {WEIGHTS_NAME} "
+                          "missing") from e
+    got = _sha256(payload)
+    if got != manifest["weights_sha256"]:
+        raise BundleError(
+            f"weights drift in {wpath}: sha256 {got[:12]}… != manifest "
+            f"{manifest['weights_sha256'][:12]}…")
+    try:
+        models = serialization.msgpack_restore(payload)
+    except Exception as e:  # msgpack raises library-specific types
+        raise BundleError(f"corrupt weights payload {wpath}: {e}") from e
+    want_keys = {GLOBAL_KEY} | {_site_key(s) for s in manifest["sites"]}
+    if set(models) != want_keys:
+        raise BundleError(
+            f"bundle {bundle_dir!r}: weights carry models "
+            f"{sorted(models)} but manifest declares {sorted(want_keys)}")
+    for key, entry in models.items():
+        digest = _model_digest(entry)
+        if digest != manifest["models"][key]["digest"]:
+            raise BundleError(
+                f"model {key!r} drift in {wpath}: digest {digest[:12]}… "
+                f"!= manifest {manifest['models'][key]['digest'][:12]}…")
+    return ServeBundle(path=os.path.abspath(bundle_dir),
+                       manifest=manifest, models=models)

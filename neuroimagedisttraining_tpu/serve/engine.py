@@ -1,0 +1,320 @@
+"""Jitted micro-batched inference engine (ISSUE 17 tentpole part 2).
+
+Compile-once/dispatch-many (Frostig 2018, PAPERS.md) applied to the
+serving path: ONE jitted batched forward program per (model, bucket)
+pair, so steady-state traffic NEVER traces. Requests queue per routed
+model; a single batcher thread collects up to the largest declared
+bucket (or until the oldest request has waited ``max_queue_ms``), pads
+to the smallest bucket that fits, dispatches once, de-pads, and
+resolves the waiters.
+
+Instrumentation rides the EXISTING compute-plane seam: every program
+build goes through ``obs.compute.note_compile`` and every invocation
+through ``note_dispatch`` (compile-vs-execute phases in
+``nidt_dispatch_ms``), so the recompile tripwire and the
+``compiles_total`` pins work unchanged for serving. Per-request stage
+latencies land in ``nidt_serve_latency_ms{stage=queue|batch|dispatch|
+reply}`` (the reply stage is observed by the HTTP worker) plus a
+batch-occupancy gauge and a queue-depth gauge — all names declared in
+obs/names.py. HOST-BOUNDARY RULE: all metrics fire on the host side of
+the dispatch, never inside the jitted body.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuroimagedisttraining_tpu.models import create_model, primary_logits
+from neuroimagedisttraining_tpu.obs import compute as obs_compute
+from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
+from neuroimagedisttraining_tpu.obs import names as N
+from neuroimagedisttraining_tpu.obs import rules as obs_rules
+from neuroimagedisttraining_tpu.serve.bundle import ServeBundle
+
+#: engine label on the shared compute-plane series
+#: (``nidt_compiles_total{engine="serve"}`` etc.)
+ENGINE_LABEL = "serve"
+
+#: per-request stage latency edges (ms) — wider than the upload stage
+#: buckets because a cold compile rides the first dispatch
+SERVE_LATENCY_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+                            50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0,
+                            5000.0, 10000.0)
+
+#: rule-engine boundary cadence: snapshotting the registry per dispatch
+#: would dominate tiny-model serving, so health rules are evaluated
+#: every N dispatches
+_RULE_BOUNDARY_EVERY = 32
+
+
+def serve_latency_histogram(registry: obs_metrics.MetricsRegistry | None
+                            = None):
+    reg = registry if registry is not None else obs_metrics.REGISTRY
+    return reg.histogram(
+        N.SERVE_LATENCY_MS,
+        "per-request serving latency by stage: queue (enqueue→batch "
+        "collect), batch (pad/stack), dispatch (compiled forward incl. "
+        "device sync), reply (result→bytes on the wire; observed by "
+        "serve/worker.py)",
+        labelnames=("stage",), buckets=SERVE_LATENCY_BUCKETS_MS)
+
+
+class _Pending:
+    """One queued request: numpy input + the waiter's event."""
+
+    __slots__ = ("x", "event", "result", "error", "t_enq")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.event = threading.Event()
+        self.result: np.ndarray | None = None
+        self.error: BaseException | None = None
+        self.t_enq = time.perf_counter()
+
+
+class ServeEngine:
+    """Micro-batching inference over a loaded :class:`ServeBundle`.
+
+    ``batch_buckets`` declares the ONLY batch shapes that may compile;
+    ``max_queue_ms`` bounds how long the oldest request waits for
+    batch-mates. ``precision`` "" serves the bundle's stored precision;
+    "bf16"/"fp32" re-cast at load (the fp32 escape hatch)."""
+
+    def __init__(self, bundle: ServeBundle,
+                 batch_buckets: tuple[int, ...] = (1, 2, 4, 8),
+                 max_queue_ms: float = 2.0, precision: str = "",
+                 registry: obs_metrics.MetricsRegistry | None = None):
+        buckets = sorted({int(b) for b in batch_buckets})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(
+                f"batch_buckets must be positive ints, got "
+                f"{batch_buckets!r}")
+        if precision not in ("", "bf16", "fp32"):
+            raise ValueError(
+                f"precision must be ''|bf16|fp32, got {precision!r}")
+        self.bundle = bundle
+        self.buckets = tuple(buckets)
+        self._max_bucket = buckets[-1]
+        self._max_queue_s = max(0.0, float(max_queue_ms)) / 1e3
+        self.precision = precision or bundle.precision
+        dtype = jnp.bfloat16 if self.precision == "bf16" else jnp.float32
+        self._model = create_model(bundle.model_name,
+                                   num_classes=bundle.num_classes,
+                                   dtype=dtype)
+        self._input_rank = getattr(self._model, "input_rank", None)
+        self.input_shape = bundle.input_shape
+
+        def load(tree):
+            def leaf(x):
+                x = jnp.asarray(x)
+                if (self.precision != bundle.precision
+                        and jnp.issubdtype(x.dtype, jnp.floating)):
+                    x = x.astype(dtype)
+                return x
+            return jax.tree.map(leaf, tree)
+
+        self._weights = {
+            key: (load(entry["params"]), load(entry["batch_stats"]))
+            for key, entry in bundle.models.items()}
+
+        reg = registry if registry is not None else obs_metrics.REGISTRY
+        self._lat = serve_latency_histogram(reg)
+        self._occupancy = reg.gauge(
+            N.SERVE_BATCH_OCCUPANCY,
+            "real requests / bucket slots of the latest dispatch "
+            "(serve/engine.py); chronically low means the declared "
+            "buckets are too coarse for the offered load")
+        self._depth = reg.gauge(
+            N.SERVE_QUEUE_DEPTH,
+            "requests queued behind the batcher after the latest "
+            "collect (serve/engine.py)")
+
+        # one jitted program per (model_key, bucket); only the batcher
+        # thread touches these
+        self._programs: dict[tuple[str, int], object] = {}
+        self._sigs: dict[tuple[str, int], tuple] = {}
+        self._recompiles = 0
+
+        self._cv = threading.Condition()
+        self._queues: dict[str, deque[_Pending]] = {
+            key: deque() for key in self._weights}
+        self._open = True
+        self._dispatches = 0
+        self._batches: dict[int, int] = {}
+        self._real_total = 0
+        self._slot_total = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+
+    # ---------- the jitted forward ----------
+
+    def _forward(self, params, bstats, x):
+        """Pure batched forward — mirrors LocalTrainer._prep/_apply so
+        served predictions match training-eval semantics."""
+        x = x.astype(jnp.float32)  # nidt: allow[precision-upcast] -- serving ingests raw client arrays at the same uint8/f32 quantization boundary as training (core/trainer.py _prep); the model re-casts to its compute dtype
+        if (self._input_rank is not None
+                and x.ndim == self._input_rank - 1):
+            x = x[..., None]
+        variables = {"params": params}
+        if jax.tree.leaves(bstats):
+            variables["batch_stats"] = bstats
+        out = self._model.apply(variables, x, train=False)
+        return primary_logits(out)
+
+    # ---------- request side ----------
+
+    def submit(self, site: str | None, x) -> tuple[_Pending, str]:
+        """Validate + enqueue one request; returns (pending, model_key).
+        Shape validation here is the bucket-misconfiguration fence: a
+        non-conforming array would otherwise mint a fresh program."""
+        x = np.asarray(x, dtype=np.float32)
+        if tuple(x.shape) != self.input_shape:
+            raise ValueError(
+                f"input shape {tuple(x.shape)} != bundle input_shape "
+                f"{self.input_shape}")
+        model_key = self.bundle.route(site)
+        pending = _Pending(x)
+        with self._cv:
+            if not self._open:
+                raise RuntimeError("serve engine is closed")
+            self._queues[model_key].append(pending)
+            self._cv.notify()
+        return pending, model_key
+
+    def predict(self, site: str | None, x, timeout: float = 30.0
+                ) -> tuple[np.ndarray, str]:
+        """Blocking single prediction: (logits row, routed model key)."""
+        pending, model_key = self.submit(site, x)
+        if not pending.event.wait(timeout):
+            raise TimeoutError(
+                f"no dispatch within {timeout}s (queue depth "
+                f"{self.queue_depth()})")
+        if pending.error is not None:
+            raise pending.error
+        return pending.result, model_key
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return sum(len(q) for q in self._queues.values())
+
+    # ---------- batcher thread ----------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._open and not any(self._queues.values()):
+                    self._cv.wait(0.05)
+                if not self._open:
+                    leftovers = [p for q in self._queues.values()
+                                 for p in q]
+                    for q in self._queues.values():
+                        q.clear()
+                    break
+                # oldest head request picks the model; one batcher
+                # serializes dispatches (one device) so per-model
+                # fairness is head-age order
+                model_key = min(
+                    (q[0].t_enq, k)
+                    for k, q in self._queues.items() if q)[1]
+                queue = self._queues[model_key]
+                deadline = queue[0].t_enq + self._max_queue_s
+                while (self._open and len(queue) < self._max_bucket):
+                    remain = deadline - time.perf_counter()
+                    if remain <= 0:
+                        break
+                    self._cv.wait(remain)
+                n = min(len(queue), self._max_bucket)
+                batch = [queue.popleft() for _ in range(n)]
+                depth = sum(len(q) for q in self._queues.values())
+            self._depth.set(depth)
+            if batch:
+                try:
+                    self._dispatch(model_key, batch)
+                except BaseException as e:  # resolve waiters, keep serving
+                    for p in batch:
+                        p.error = e
+                        p.event.set()
+        for p in leftovers:
+            p.error = RuntimeError("serve engine closed")
+            p.event.set()
+
+    def _dispatch(self, model_key: str, batch: list[_Pending]) -> None:
+        t_collect = time.perf_counter()
+        queue_obs = self._lat.labels(stage="queue")
+        for p in batch:
+            queue_obs.observe((t_collect - p.t_enq) * 1e3)
+        n = len(batch)
+        bucket = next(b for b in self.buckets if b >= n)
+        xb = np.zeros((bucket, *self.input_shape), dtype=np.float32)
+        for i, p in enumerate(batch):
+            xb[i] = p.x
+        t_pad = time.perf_counter()
+        batch_obs = self._lat.labels(stage="batch")
+        for _ in batch:
+            batch_obs.observe((t_pad - t_collect) * 1e3)
+
+        key = (model_key, bucket)
+        program = f"{model_key}/b{bucket}"
+        sig = (xb.shape, str(xb.dtype))
+        fresh = key not in self._programs
+        recompile = (not fresh) and self._sigs[key] != sig
+        if fresh or recompile:
+            # the tripwire: a second build of the SAME (model, bucket)
+            # key means the declared-bucket fence leaked a shape
+            self._programs[key] = jax.jit(self._forward)
+            self._sigs[key] = sig
+            if recompile:
+                self._recompiles += 1
+            obs_compute.note_compile(ENGINE_LABEL, program,
+                                     recompile=recompile)
+        phase = "compile" if (fresh or recompile) else "execute"
+        params, bstats = self._weights[model_key]
+        t0 = time.perf_counter()
+        y = jax.block_until_ready(self._programs[key](params, bstats, xb))
+        dur = time.perf_counter() - t0
+        obs_compute.note_dispatch(ENGINE_LABEL, program, dur, rounds=1,
+                                  phase=phase)
+        self._occupancy.set(n / bucket)
+        self._dispatches += 1
+        self._batches[bucket] = self._batches.get(bucket, 0) + 1
+        self._real_total += n
+        self._slot_total += bucket
+
+        y_np = np.asarray(jnp.asarray(y, jnp.float32))
+        t_done = time.perf_counter()
+        dispatch_obs = self._lat.labels(stage="dispatch")
+        for i, p in enumerate(batch):
+            p.result = y_np[i]
+            dispatch_obs.observe((t_done - t_pad) * 1e3)
+            p.event.set()
+        if self._dispatches % _RULE_BOUNDARY_EVERY == 0:
+            obs_rules.observe_boundary(self._dispatches)
+
+    # ---------- lifecycle / introspection ----------
+
+    def stats(self) -> dict:
+        """Bookkeeping the worker ships home in its bye message; the
+        bench compile pin reads ``compiled``/``recompiles``."""
+        return {
+            "dispatches": self._dispatches,
+            "batches": {str(b): c for b, c in sorted(self._batches.items())},
+            "occupancy_mean": (self._real_total / self._slot_total
+                               if self._slot_total else 0.0),
+            "requests_dispatched": self._real_total,
+            "compiled": sorted(f"{mk}/b{b}" for mk, b in self._programs),
+            "compiles": len(self._programs),
+            "recompiles": self._recompiles,
+        }
+
+    def close(self) -> None:
+        with self._cv:
+            self._open = False
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)

@@ -400,6 +400,135 @@ EOF
     PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" $PY "$killpy"
 }
 
+run_serve() {
+    # serving plane (ISSUE 17, serve/): SIGKILL one serve worker
+    # mid-load — the surviving SO_REUSEPORT listener absorbs the whole
+    # request fleet (clients reconnect, the kernel re-hashes their new
+    # connections), the MERGED /metrics scrape mid-chaos carries both
+    # workers' serve-latency samples, and the shutdown audit leaves
+    # zero dropped-but-unaccounted requests: every attempt lands in
+    # exactly one client bucket, every server verdict reconciles, and
+    # the corpse's unflushed tail is pinned to it, never vanished.
+    local mport
+    mport=$($PY -c "from neuroimagedisttraining_tpu.distributed.ports \
+import free_port_block; print(free_port_block(2))")
+    local scrape_out="/tmp/chaos_smoke_serve_metrics.txt"
+    rm -f "$scrape_out"
+    echo "== chaos smoke (serving cell): SIGKILL serve worker 0" \
+         "mid-load, survivor absorbs the fleet, MERGED /metrics on" \
+         "$mport =="
+    # mid-chaos scraper: the merged exposition must carry BOTH workers'
+    # registries (worker label) + the serve-latency histogram while the
+    # fleet is still running
+    $PY - "$mport" "$scrape_out" <<'PYEOF' &
+import sys, time, urllib.request
+port, out = int(sys.argv[1]), sys.argv[2]
+deadline = time.time() + 240
+while time.time() < deadline:
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2).read().decode()
+        if ('worker="0"' in body and 'worker="1"' in body
+                and "nidt_serve_latency_ms_bucket" in body
+                and "nidt_serve_requests_total" in body):
+            open(out, "w").write(body)
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.2)
+sys.exit(1)
+PYEOF
+    local scraper_pid=$!
+    # a real file, not a '$PY -' heredoc: the serve root spawns worker
+    # processes with the 'spawn' context, which re-imports the parent's
+    # main module — '<stdin>' has no path to re-import
+    local servepy="/tmp/chaos_smoke_serve.py"
+    cat > "$servepy" <<'EOF'
+import os
+import sys
+import tempfile
+
+# the __main__ guard matters: the spawn context re-imports this file in
+# every worker child
+if __name__ == "__main__":
+    import jax
+    import jax.numpy as jnp
+    from neuroimagedisttraining_tpu.models import create_model
+    from neuroimagedisttraining_tpu.utils.checkpoint import save_checkpoint
+    from neuroimagedisttraining_tpu.serve.bundle import build_bundle
+    from neuroimagedisttraining_tpu.asyncfl.loadgen import run_load
+
+    mport = int(sys.argv[1])
+    shape = (12, 14, 12)
+    m = create_model("3dcnn_tiny", num_classes=1)
+    v = m.init({"params": jax.random.PRNGKey(0),
+                "dropout": jax.random.PRNGKey(1)},
+               jnp.zeros((1, *shape, 1)), train=False)
+    params, bstats = v["params"], v.get("batch_stats", {})
+
+    def stack(t):
+        return jax.tree.map(
+            lambda x: jnp.stack([x * (1.0 + 0.1 * i)
+                                 for i in range(2)]), t)
+
+    state = {"params": params, "batch_stats": bstats,
+             "per_params": stack(params), "per_bstats": stack(bstats)}
+    td = tempfile.mkdtemp(prefix="nidt_chaos_serve.")
+    ck, bd = os.path.join(td, "ck"), os.path.join(td, "bundle")
+    save_checkpoint(ck, 3, state)
+    build_bundle(ck, bd, model="3dcnn_tiny", num_classes=1,
+                 input_shape=shape)
+
+    res = run_load(mode="serve", num_clients=80, serve_bundle=bd,
+                   serve_workers=2, serve_requests=400,
+                   serve_kill_at=80, fleet_procs=2,
+                   batch_buckets=(1, 2, 4), metrics_port=mport)
+    audit = res["serve_audit"]
+    assert res["worker_killed"], res
+    assert audit["dead_workers"] == 1, audit
+    assert res["workers_live_at_end"] == [1], res["workers_live_at_end"]
+    assert res["frames_reconciled"], audit
+    # the fleet was absorbed: post-kill attempts reconnected onto the
+    # survivor, and every attempt landed in exactly one client bucket
+    assert res["client_reconnects"] > 0, res
+    assert res["requests_sent"] == (res["requests_ok"]
+                                    + res["requests_rejected"]
+                                    + res["client_errors"]), res
+    assert res["requests_ok"] > 80, res
+    print(f"OK(serve/kill-worker): {res['requests_ok']}/"
+          f"{res['requests_sent']} served, worker 0 SIGKILLed after "
+          f"80 served, {res['client_reconnects']} reconnects absorbed "
+          f"by the survivor, {res['unflushed_with_worker']} in-flight "
+          "verdicts pinned to the corpse, audits green")
+EOF
+    # PYTHONPATH: running a file from /tmp drops the repo cwd from
+    # sys.path; worker children inherit it
+    if ! PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" $PY "$servepy" \
+            "$mport"; then
+        kill "$scraper_pid" 2>/dev/null
+        echo "FAIL(serve): kill-one-worker serving cell"
+        return 1
+    fi
+    if ! wait "$scraper_pid"; then
+        echo "FAIL(serve/obs): mid-chaos MERGED /metrics scrape never "\
+"saw both workers' serve-latency samples"
+        return 1
+    fi
+    $PY - "$scrape_out" <<'EOF'
+import re, sys
+sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+                    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+scrape = open(sys.argv[1]).read()
+for line in scrape.strip().splitlines():
+    assert line.startswith("#") or sample.match(line), line
+workers = sorted(set(re.findall(r'worker="(\d+)"', scrape)))
+assert workers == ["0", "1"], workers
+assert "nidt_serve_latency_ms_bucket" in scrape
+print(f"OK(serve/obs): MERGED /metrics scraped mid-chaos "
+      f"({len(scrape.splitlines())} lines, workers {workers})")
+EOF
+}
+
 rc=0
 run_one socket crash || rc=1
 run_one broker crash || rc=1
@@ -408,4 +537,5 @@ run_one broker byz   || rc=1
 run_async            || rc=1
 run_secure_quant     || rc=1
 run_ingest           || rc=1
+run_serve            || rc=1
 exit $rc

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Serving-plane headline bench (ISSUE 17, serve/): train a REAL tiny
+# ditto run (per-site personalized heads) to a checkpoint, convert it
+# to a bf16 deployment bundle (serve/bundle.py), then drive the seeded
+# open-loop loadgen request fleet (1k clients by default) against 2
+# SO_REUSEPORT serve workers with jitted micro-batched inference.
+#
+# Acceptance (gated by the analysis/bench_gate.py serve_bench SPEC):
+#   - >= 500 requests served at 1k concurrent clients, all accounted:
+#     client-side sent == ok+rejected+errors AND root/bye verdict
+#     reconciliation per worker (zero dropped-but-unaccounted)
+#   - ONE compiled program per (model, batch-bucket): the compile
+#     counter pin, zero recompile-tripwire hits
+#   - per-site routing proof: two sites observe two DIFFERENT
+#     personalized bundle digests
+#   - merged /metrics carries nidt_serve_latency_ms + nidt_client_rtt_ms
+#
+# Writes bench_matrix/serve_bench.json (committed artifact).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PY=${PYTHON:-python}
+OUT=${1:-bench_matrix/serve_bench.json}
+WORK=$(mktemp -d /tmp/nidt_serve_bench.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+echo "== 1/4 train the source checkpoint (ditto, synthetic cohort) =="
+$PY -m neuroimagedisttraining_tpu --algorithm ditto \
+    --dataset synthetic --model 3dcnn_tiny \
+    --synthetic_num_subjects 32 --synthetic_shape 12 14 12 \
+    --client_num_in_total 4 --comm_round 2 --batch_size 4 --epochs 1 \
+    --lr 5e-4 --virtual_devices 8 --log_dir "$WORK/log" \
+    --checkpoint_dir "$WORK/ckpt" --checkpoint_every 1 \
+    --seed "${BENCH_SEED:-1024}"
+
+echo "== 2/4 checkpoint -> bf16 deployment bundle =="
+$PY -m neuroimagedisttraining_tpu.serve \
+    --bundle "$WORK/bundle" --from_checkpoint "$WORK/ckpt" \
+    --model 3dcnn_tiny --input_shape 12,14,12 --build_only
+
+echo "== 3/4 serve fleet: ${BENCH_CLIENTS:-1000} clients, 2 workers =="
+$PY -m neuroimagedisttraining_tpu.asyncfl.loadgen \
+    --mode serve \
+    --clients "${BENCH_CLIENTS:-1000}" \
+    --serve_bundle "$WORK/bundle" \
+    --serve_workers "${BENCH_SERVE_WORKERS:-2}" \
+    --serve_requests "${BENCH_REQUESTS:-2000}" \
+    --batch_buckets "${BENCH_BUCKETS:-1,2,4,8}" \
+    --max_queue_ms "${BENCH_MAX_QUEUE_MS:-2.0}" \
+    --seed "${BENCH_SEED:-1024}" \
+    --out "$OUT"
+
+$PY - "$OUT" <<'EOF'
+import json, sys
+res = json.load(open(sys.argv[1]))
+c, s = res["serve"], res["summary"]
+assert s["audits_green"], "serve bench: accounting audit came back red"
+assert c["requests_ok"] >= 500, \
+    f"serve bench: only {c['requests_ok']} requests served (need >= 500)"
+assert c["serve_workers"] >= 2, c["serve_workers"]
+assert c["compile_pin_ok"], \
+    (c["compiled_programs"], c["compiles_total"], c["recompiles_total"])
+assert c["routing"]["distinct_site_models"], c["routing"]
+assert c["merged_metrics"]["has_serve_latency"], c["merged_metrics"]
+assert c["merged_metrics"]["has_rtt_samples"], c["merged_metrics"]
+print(f"OK: {c['requests_ok']} served by {c['serve_workers']} workers "
+      f"at {c['requests_per_s']} req/s "
+      f"(p50 {c['rtt_ms_p50']} ms, p99 {c['rtt_ms_p99']} ms), "
+      f"occupancy {c['batch_occupancy']}, "
+      f"{c['compiles_total']} compiled programs, 0 recompiles, "
+      f"routing digests distinct across {len(c['routing']['per_site'])} "
+      f"sites")
+EOF
+
+echo "== 4/4 bench gate (serve_bench cell) =="
+$PY -m neuroimagedisttraining_tpu.analysis.bench_gate \
+    --artifact serve_bench.json --quiet

@@ -1,0 +1,437 @@
+"""Serving plane (ISSUE 17, serve/): checkpoint→bundle contract,
+micro-batched inference engine, per-site routing, live HTTP workers.
+
+Layout mirrors tests/test_ingest.py:
+  (a) bundle contract — round-trip determinism, precision, sparse
+      masks, loud drift rejection;
+  (b) engine — bucketed compile pins, recompile tripwire, shape fence;
+  (c) live multi-process serving — one fast 2-worker HTTP cell in
+      tier-1, the loadgen serve fleet marked slow.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flax import serialization
+
+from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.obs import compute as obs_compute
+from neuroimagedisttraining_tpu.serve.bundle import (
+    BundleError,
+    GLOBAL_KEY,
+    MANIFEST_NAME,
+    WEIGHTS_NAME,
+    build_bundle,
+    load_bundle,
+)
+from neuroimagedisttraining_tpu.serve.engine import ServeEngine
+from neuroimagedisttraining_tpu.utils.checkpoint import save_checkpoint
+
+SHAPE = (12, 14, 12)
+
+
+def _init_tree(seed=0):
+    m = create_model("3dcnn_tiny", num_classes=1)
+    v = m.init({"params": jax.random.PRNGKey(seed),
+                "dropout": jax.random.PRNGKey(seed + 1)},
+               jnp.zeros((1, *SHAPE, 1)), train=False)
+    return v["params"], v.get("batch_stats", {})
+
+
+def _stack(tree, n):
+    # i+1: row 0 must NOT equal the global params, or the per-site
+    # digests would collide with the global one
+    return jax.tree.map(
+        lambda x: jnp.stack([x * (1.0 + 0.1 * (i + 1))
+                             for i in range(n)]),
+        tree)
+
+
+@pytest.fixture(scope="module")
+def ditto_ckpt(tmp_path_factory):
+    """One ditto-flavor checkpoint (2 personalized sites) shared by the
+    module — model init dominates the cost, the checkpoint is
+    read-only."""
+    params, bstats = _init_tree()
+    state = {"params": params, "batch_stats": bstats,
+             "per_params": _stack(params, 2),
+             "per_bstats": _stack(bstats, 2)}
+    ck = str(tmp_path_factory.mktemp("serve") / "ck")
+    save_checkpoint(ck, 5, state)
+    return ck
+
+
+def _build(ck, out, **kw):
+    kw.setdefault("model", "3dcnn_tiny")
+    kw.setdefault("num_classes", 1)
+    kw.setdefault("input_shape", SHAPE)
+    return build_bundle(ck, str(out), **kw)
+
+
+# ---------------------------------------------------------------------------
+# (a) bundle contract
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_roundtrip_bitwise(ditto_ckpt, tmp_path):
+    """save→load→save is bitwise-stable: a rebuild from the same
+    checkpoint reproduces both files byte for byte, and re-serializing
+    the LOADED weight trees reproduces the committed payload (bf16
+    survives the msgpack round trip exactly)."""
+    d1, d2 = tmp_path / "b1", tmp_path / "b2"
+    m1 = _build(ditto_ckpt, d1)
+    m2 = _build(ditto_ckpt, d2)
+    assert m1 == m2
+    for name in (MANIFEST_NAME, WEIGHTS_NAME):
+        b1 = (d1 / name).read_bytes()
+        assert b1 == (d2 / name).read_bytes(), name
+    bundle = load_bundle(str(d1))
+    payload = serialization.msgpack_serialize(
+        {k: bundle.models[k] for k in sorted(bundle.models)})
+    assert payload == (d1 / WEIGHTS_NAME).read_bytes()
+    # the manifest is exactly its own sorted-keys dump (timestamp-free)
+    assert ((d1 / MANIFEST_NAME).read_text()
+            == json.dumps(bundle.manifest, indent=1, sort_keys=True)
+            + "\n")
+    assert bundle.source_round == 5
+    assert bundle.sites == ("0", "1")
+
+
+def test_bundle_bf16_predictions_near_f32(ditto_ckpt, tmp_path):
+    """bf16 serving stays within the pinned tolerance of the f32
+    escape hatch on the same checkpoint."""
+    bf = load_bundle(_bundle_dir(ditto_ckpt, tmp_path / "bf", "bf16"))
+    fp = load_bundle(_bundle_dir(ditto_ckpt, tmp_path / "fp", "fp32"))
+    assert bf.precision == "bf16" and fp.precision == "fp32"
+    e_bf = ServeEngine(bf, batch_buckets=(1,), max_queue_ms=0.5)
+    e_fp = ServeEngine(fp, batch_buckets=(1,), max_queue_ms=0.5)
+    try:
+        x = np.random.default_rng(0).normal(size=SHAPE)
+        y_bf, _ = e_bf.predict(None, x)
+        y_fp, _ = e_fp.predict(None, x)
+        # tiny-model logits are O(1); bf16 carries ~8 mantissa bits
+        assert np.max(np.abs(y_bf - y_fp)) < 0.1, (y_bf, y_fp)
+    finally:
+        e_bf.close()
+        e_fp.close()
+
+
+def _bundle_dir(ck, out, precision):
+    _build(ck, out, precision=precision)
+    return str(out)
+
+
+def test_salientgrads_bundle_applies_mask(tmp_path):
+    """A salientgrads checkpoint serves SPARSE params: the mask is
+    multiplied in at build, nnz is pinned in the manifest, and the
+    loaded weights honor it."""
+    params, bstats = _init_tree()
+    rng = np.random.default_rng(7)
+    masks = jax.tree.map(
+        lambda p: (rng.random(np.shape(p)) < 0.5).astype(np.float32),
+        jax.tree.map(np.asarray, params))
+    state = {"params": params, "batch_stats": bstats, "masks": masks,
+             "history": []}
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, 2, state)
+    manifest = _build(ck, tmp_path / "bundle")
+    assert manifest["flavor"] == "salientgrads"
+    expect_nnz = int(sum(
+        np.count_nonzero(np.asarray(p) * m) for p, m in zip(
+            jax.tree.leaves(params), jax.tree.leaves(masks))))
+    assert manifest["sparse_nnz"] == expect_nnz
+    assert 0 < expect_nnz < manifest["total_params"]
+    bundle = load_bundle(str(tmp_path / "bundle"))
+    got_nnz = int(sum(
+        np.count_nonzero(np.asarray(x, np.float32)) for x in
+        jax.tree.leaves(bundle.models[GLOBAL_KEY]["params"])))
+    assert got_nnz == expect_nnz
+
+
+def test_fedfomo_bundle_serves_mean_global(tmp_path):
+    """fedfomo checkpoints keep no global model — the bundle's global
+    fallback is the uniform mean of the personalized stack."""
+    params, bstats = _init_tree()
+    state = {"per_params": _stack(params, 3),
+             "per_bstats": _stack(bstats, 3),
+             "weights": np.eye(3, dtype=np.float32),
+             "p_choose": np.ones((3, 3), np.float32) / 3,
+             "history": []}
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, 1, state)
+    manifest = _build(ck, tmp_path / "bundle", precision="fp32")
+    assert manifest["flavor"] == "fedfomo"
+    bundle = load_bundle(str(tmp_path / "bundle"))
+    assert bundle.sites == ("0", "1", "2")
+    # mean of x*(1.1, 1.2, 1.3) == x*1.2
+    lead = jax.tree.leaves(params)[0]
+    got = jax.tree.leaves(bundle.models[GLOBAL_KEY]["params"])[0]
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(lead) * 1.2, rtol=1e-5)
+
+
+def test_corrupt_and_stale_bundles_rejected(ditto_ckpt, tmp_path):
+    bdir = tmp_path / "bundle"
+    _build(ditto_ckpt, bdir)
+    mpath, wpath = bdir / MANIFEST_NAME, bdir / WEIGHTS_NAME
+
+    with pytest.raises(BundleError, match="not a bundle"):
+        load_bundle(str(tmp_path / "nowhere"))
+
+    good = mpath.read_text()
+    mpath.write_text(good[:-20])  # truncate: invalid JSON
+    with pytest.raises(BundleError, match="corrupt manifest"):
+        load_bundle(str(bdir))
+
+    doc = json.loads(good)
+    del doc["sites"]
+    mpath.write_text(json.dumps(doc))
+    with pytest.raises(BundleError, match="stale manifest"):
+        load_bundle(str(bdir))
+
+    doc = json.loads(good)
+    doc["bundle_version"] = 99
+    mpath.write_text(json.dumps(doc))
+    with pytest.raises(BundleError, match="version mismatch"):
+        load_bundle(str(bdir))
+
+    mpath.write_text(good)
+    raw = bytearray(wpath.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    wpath.write_bytes(bytes(raw))
+    with pytest.raises(BundleError, match="weights drift"):
+        load_bundle(str(bdir))
+
+    # per-model digest drift with a still-valid payload sha: swap the
+    # declared digests of two models in the manifest
+    _build(ditto_ckpt, bdir)  # restore
+    doc = json.loads(mpath.read_text())
+    a, b = doc["models"]["site:0"], doc["models"]["site:1"]
+    doc["models"]["site:0"], doc["models"]["site:1"] = b, a
+    mpath.write_text(json.dumps(doc))
+    with pytest.raises(BundleError, match="drift"):
+        load_bundle(str(bdir))
+
+
+def test_bundle_missing_checkpoint_and_bad_precision(tmp_path):
+    with pytest.raises(BundleError, match="no checkpoints"):
+        _build(str(tmp_path / "empty"), tmp_path / "b")
+    with pytest.raises(BundleError, match="precision"):
+        _build(str(tmp_path / "empty"), tmp_path / "b",
+               precision="fp16")
+
+
+def test_routing_distinct_digests(ditto_ckpt, tmp_path):
+    bundle = load_bundle(_bundle_dir(ditto_ckpt, tmp_path / "b",
+                                     "bf16"))
+    assert bundle.route("0") == "site:0"
+    assert bundle.route("1") == "site:1"
+    # unknown or absent site falls back to the global model
+    assert bundle.route("7") == GLOBAL_KEY
+    assert bundle.route(None) == GLOBAL_KEY
+    digests = {bundle.digest(k) for k in
+               (GLOBAL_KEY, "site:0", "site:1")}
+    assert len(digests) == 3, "personalized models must differ"
+
+
+# ---------------------------------------------------------------------------
+# (b) engine: buckets, compile pins, tripwire
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ditto_bundle(ditto_ckpt, tmp_path_factory):
+    out = tmp_path_factory.mktemp("serve") / "bundle"
+    _build(ditto_ckpt, out)
+    return load_bundle(str(out))
+
+
+def test_engine_one_program_per_bucket(ditto_bundle):
+    """The compile pin at engine level: N distinct (model, bucket)
+    shapes → exactly N programs on the SHARED compute-plane counter
+    (``nidt_compiles_total{engine="serve"}``), zero recompiles, and
+    re-dispatching an existing bucket never traces again."""
+    c0 = obs_compute.compiles_total(engine="serve")
+    eng = ServeEngine(ditto_bundle, batch_buckets=(1, 4),
+                      max_queue_ms=200.0)
+    try:
+        x = np.zeros(SHAPE, np.float32)
+        # 4 concurrent submissions fill the max bucket in one dispatch
+        pends = [eng.submit("0", x)[0] for _ in range(4)]
+        for p in pends:
+            assert p.event.wait(60.0)
+            assert p.error is None and p.result is not None
+        s = eng.stats()
+        assert s["dispatches"] == 1 and s["batches"] == {"4": 1}, s
+        assert s["compiles"] == 1 and s["compiled"] == ["site:0/b4"]
+        # same bucket again: execute, no new program
+        pends = [eng.submit("0", x)[0] for _ in range(4)]
+        for p in pends:
+            assert p.event.wait(60.0)
+        assert eng.stats()["compiles"] == 1
+        # a lone request pads to bucket 1 → second program
+        y, key = eng.predict("0", x, timeout=60.0)
+        assert key == "site:0" and y.shape == (1,)
+        s = eng.stats()
+        assert s["compiles"] == 2 and s["recompiles"] == 0, s
+        assert s["requests_dispatched"] == 9
+        assert obs_compute.compiles_total(engine="serve") == c0 + 2
+    finally:
+        eng.close()
+
+
+def test_engine_recompile_tripwire(ditto_bundle):
+    """A second build of the SAME (model, bucket) key — the declared-
+    bucket fence leaking a shape — must hit the recompile counter, not
+    pass silently."""
+    eng = ServeEngine(ditto_bundle, batch_buckets=(1,),
+                      max_queue_ms=0.5)
+    try:
+        x = np.zeros(SHAPE, np.float32)
+        eng.predict(None, x, timeout=60.0)
+        assert eng.stats()["recompiles"] == 0
+        # poison the recorded signature to simulate a shape leak
+        eng._sigs[(GLOBAL_KEY, 1)] = ("poisoned",)
+        eng.predict(None, x, timeout=60.0)
+        s = eng.stats()
+        assert s["recompiles"] == 1 and s["compiles"] == 1, s
+    finally:
+        eng.close()
+
+
+def test_engine_shape_fence_and_validation(ditto_bundle):
+    eng = ServeEngine(ditto_bundle, batch_buckets=(2,),
+                      max_queue_ms=0.5)
+    try:
+        with pytest.raises(ValueError, match="input shape"):
+            eng.submit(None, np.zeros((3, 3), np.float32))
+    finally:
+        eng.close()
+    with pytest.raises(ValueError, match="batch_buckets"):
+        ServeEngine(ditto_bundle, batch_buckets=())
+    with pytest.raises(ValueError, match="precision"):
+        ServeEngine(ditto_bundle, precision="fp16")
+
+
+def test_engine_precision_override(ditto_bundle):
+    """The fp32 flag re-casts a bf16 bundle at load (escape hatch)."""
+    eng = ServeEngine(ditto_bundle, batch_buckets=(1,),
+                      max_queue_ms=0.5, precision="fp32")
+    try:
+        assert eng.precision == "fp32"
+        lead = jax.tree.leaves(eng._weights[GLOBAL_KEY][0])[0]
+        assert lead.dtype == jnp.float32
+        y, _ = eng.predict(None, np.zeros(SHAPE, np.float32),
+                           timeout=60.0)
+        assert np.all(np.isfinite(y))
+    finally:
+        eng.close()
+
+
+def test_engine_concurrent_sites_route_differently(ditto_bundle):
+    """Two sites served concurrently come back from DIFFERENT
+    personalized weights (routing happens per request, inside one
+    engine)."""
+    eng = ServeEngine(ditto_bundle, batch_buckets=(1, 2),
+                      max_queue_ms=1.0)
+    try:
+        x = np.random.default_rng(1).normal(size=SHAPE)
+        results = {}
+
+        def hit(site):
+            y, key = eng.predict(site, x, timeout=60.0)
+            results[site] = (float(y[0]), key)
+
+        ts = [threading.Thread(target=hit, args=(s,))
+              for s in ("0", "1")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(90.0)
+        assert results["0"][1] == "site:0"
+        assert results["1"][1] == "site:1"
+        # per-site weights differ by construction → logits differ
+        assert results["0"][0] != results["1"][0], results
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# (c) live multi-process serving
+# ---------------------------------------------------------------------------
+
+
+def test_http_two_workers_live(ditto_ckpt, tmp_path):
+    """Tier-1 live cell: 2 SO_REUSEPORT workers on one port, JSON and
+    raw-array /predict, per-site routing digests distinct, malformed
+    and unknown-site verdicts recorded, shutdown audit reconciles."""
+    import urllib.error
+    import urllib.request
+
+    from neuroimagedisttraining_tpu.serve.server import (
+        ShardedServeServer,
+    )
+
+    bdir = _bundle_dir(ditto_ckpt, tmp_path / "bundle", "bf16")
+    srv = ShardedServeServer(bdir, serve_workers=2,
+                             batch_buckets=(1, 2), max_queue_ms=1.0)
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        x = np.random.default_rng(0).normal(size=SHAPE).astype(
+            np.float32)
+
+        def post(data, headers):
+            req = urllib.request.Request(f"{url}/predict", data=data,
+                                         headers=headers,
+                                         method="POST")
+            return json.loads(
+                urllib.request.urlopen(req, timeout=120).read())
+
+        r0 = post(json.dumps({"x": x.tolist(), "site": "0"}).encode(),
+                  {"Content-Type": "application/json"})
+        r1 = post(x.tobytes(),
+                  {"Content-Type": "application/octet-stream",
+                   "X-NIDT-Shape": "12,14,12", "X-NIDT-Site": "1"})
+        assert r0["model"] == "site:0" and r1["model"] == "site:1"
+        assert r0["digest"] != r1["digest"]
+        assert r0["model_version"] == 5
+        # unknown site → served by the global model, verdict recorded
+        ru = post(x.tobytes(),
+                  {"Content-Type": "application/octet-stream",
+                   "X-NIDT-Shape": "12,14,12", "X-NIDT-Site": "9"})
+        assert ru["model"] == GLOBAL_KEY
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(b"not json", {"Content-Type": "application/json"})
+        assert ei.value.code == 400
+        h = json.loads(urllib.request.urlopen(
+            f"{url}/healthz", timeout=30).read())
+        assert h["ok"] and h["model"] == "3dcnn_tiny"
+        assert h["model_version"] == 5
+    finally:
+        audit = srv.stop()
+    assert audit["reconciled"], audit
+    assert audit["served"] == 3 and audit["rejected"] == 1, audit
+    assert audit["unknown_site"] == 1, audit
+
+
+@pytest.mark.slow
+def test_loadgen_serve_fleet_end_to_end(ditto_ckpt, tmp_path):
+    from neuroimagedisttraining_tpu.asyncfl.loadgen import run_load
+
+    bdir = _bundle_dir(ditto_ckpt, tmp_path / "bundle", "bf16")
+    res = run_load(mode="serve", num_clients=16, serve_bundle=bdir,
+                   serve_workers=2, serve_requests=48,
+                   batch_buckets=(1, 2, 4), fleet_procs=1)
+    assert res["frames_reconciled"], res["serve_audit"]
+    assert res["requests_ok"] == 48
+    assert res["compile_pin_ok"], res["compiled_programs"]
+    assert res["routing"]["distinct_site_models"], res["routing"]
+    assert res["merged_metrics"]["worker_labeled"] == [0, 1]
+    assert res["merged_metrics"]["has_serve_latency"]
+    assert res["merged_metrics"]["has_rtt_samples"]
