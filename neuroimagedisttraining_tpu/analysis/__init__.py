@@ -48,6 +48,7 @@ from neuroimagedisttraining_tpu.analysis import (  # noqa: E402,F401
     precision_discipline,
     privacy_discipline,
     round_program,
+    shm_discipline,
     trace_safety,
 )
 

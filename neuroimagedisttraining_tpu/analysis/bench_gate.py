@@ -198,6 +198,40 @@ SPECS: dict[str, tuple[Check, ...]] = {
               "client-observed p99 RTT tripwire (box drift "
               "tolerated)"),
     ),
+    # hierarchical aggregation tier (ISSUE 18,
+    # scripts/run_region_bench.sh): a 2-region x 2-worker tree under the
+    # committed ingest_bench load (1k clients) plus the downlink
+    # delta-sync A/B (same fleet, delta on vs off). Structural cells
+    # exact — the audits, the shm-beats-pipe A/B, the tree-vs-committed-
+    # single-root floor, the >=3x delta-bytes pin (all computed as
+    # booleans by the bench itself so the gate re-judges fresh runs,
+    # not just the committed one) — and the absolute throughput cell at
+    # the standard drift-tolerant ratio tripwire.
+    "region_bench.json": (
+        Check("summary.audits_green", "true",
+              note="every cell's received/accepted accounting exact + "
+                   "frames reconciled through the region tier"),
+        Check("summary.tree_at_least_committed_single_root", "true",
+              note="the 2x2 tree sustains >= the committed single-root "
+                   "best (ingest_bench ingest_w*)"),
+        Check("summary.shm_beats_pipe", "true",
+              note="shared-memory partial hand-off beats the pickled "
+                   "pipe on mean per-export latency"),
+        Check("summary.delta_sync_3x", "true",
+              note=">=3x fewer bytes per changed-version sync reply "
+                   "(delta vs dense, decoded bitwise-equal)"),
+        Check("summary.delta_errors", "abs_max", 0,
+              "zero base-mismatch delta replies ever shipped"),
+        Check("summary.regions", "eq",
+              note="the committed cell is the 2-region tree"),
+        Check("summary.workers_per_region", "eq"),
+        Check("summary.tree_uploads_per_s_sustained", "ratio_min", 0.5,
+              "tree sustained throughput tripwire (box drift "
+              "tolerated)"),
+        Check("summary.delta_sync_bytes_ratio", "ratio_min", 0.5,
+              "dense/delta sync-bytes ratio (codec regression "
+              "tripwire)"),
+    ),
     "profile_session.json": (
         Check("session.structural_fingerprint", "eq",
               note="the declared probe manifest (structural cells)"),

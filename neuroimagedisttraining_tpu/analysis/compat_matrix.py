@@ -171,6 +171,21 @@ MATRIX: tuple[dict[str, Any], ...] = (
     },
     {
         "where": 'neuroimagedisttraining_tpu/distributed/run.py',
+        "knobs": ('ingest_shm', 'ingest_workers', 'sync_delta'),
+        "message": (
+            '--ingest_shm/--sync_delta are sharded-ingest-plane transport'
+            's (asyncfl/ingest.py) — add --ingest_workers N'),
+    },
+    {
+        "where": 'neuroimagedisttraining_tpu/distributed/run.py',
+        "knobs": ('ingest_workers', 'regions'),
+        "message": (
+            '--regions interposes regional sub-aggregators in the SHARDED'
+            ' ingest plane — pass --ingest_workers N (workers per region)'
+            ' too'),
+    },
+    {
+        "where": 'neuroimagedisttraining_tpu/distributed/run.py',
         "knobs": ('mpc_n_shares', 'n_aggregators'),
         "message": (
             '--n_aggregators ( ) must equal --mpc_n_shares ( ): slot j ro'

@@ -433,6 +433,158 @@ def single_process_fold(entries: list[tuple], spec: FoldSpec,
 
 
 # ---------------------------------------------------------------------------
+# cross-worker exactly-once dedup (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class SeqWatermarks:
+    """Root-held upload-seq watermarks per (sender, incarnation).
+
+    The per-worker watermark dedups transport re-deliveries on ONE
+    connection; this table closes the cross-worker hole: marks of
+    accepted (seq, incarnation) pairs ride every verdict batch up to
+    the root, and when a sender RE-registers anywhere in the tree with
+    the SAME incarnation (a reconnect — its monotone seq continues),
+    the root sends the watermark floor back down to the new worker
+    BEFORE that worker answers the register, so a re-sent upload the
+    old worker already accepted is dropped as a duplicate instead of
+    double-contributing. A register under a NEW incarnation is a
+    restart: fresh floor, seq 0 legitimate — the documented
+    reset-on-re-register semantics for legacy senders are untouched
+    (no incarnation => no floor traffic at all). Not thread-safe by
+    itself: the root mutates it under its event-loop lock."""
+
+    def __init__(self):
+        self._wm: dict[int, list[int]] = {}  # c -> [incarnation, max_seq]
+
+    def register(self, c: int, inc: int) -> int:
+        """Floor for a registering sender: its surviving watermark on a
+        same-incarnation reconnect, -1 on a new incarnation."""
+        cur = self._wm.get(int(c))
+        if cur is not None and cur[0] == int(inc):
+            return cur[1]
+        self._wm[int(c)] = [int(inc), -1]
+        return -1
+
+    def advance(self, c: int, inc: int, seq: int) -> None:
+        """One accepted-upload mark from a verdict batch. Marks from a
+        superseded incarnation (an old worker's batch draining after
+        the sender restarted) are ignored — latest incarnation wins."""
+        cur = self._wm.get(int(c))
+        if cur is None:
+            self._wm[int(c)] = [int(inc), int(seq)]
+        elif cur[0] == int(inc):
+            cur[1] = max(cur[1], int(seq))
+
+    def floor(self, c: int, inc: int) -> int:
+        cur = self._wm.get(int(c))
+        return cur[1] if cur is not None and cur[0] == int(inc) else -1
+
+
+# ---------------------------------------------------------------------------
+# shared-memory partial hand-off (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+#: slab header: int64 x3 — seqlock generation, w_int_total, count
+_SHM_HEADER_BYTES = 24
+#: double buffering: one slab being read by the parent while the next
+#: export writes the other; both un-acked => pickled-pipe fallback
+#: (counted, never blocked — exactness is transport-independent)
+_SHM_SLABS = 2
+
+
+class _ShmSlabWriter:
+    """OWNER side of one partial-export slab: creates the segment,
+    writes the flat int64 vector under a seqlock-style generation
+    counter (odd while writing, even when consistent), and — on its
+    teardown path — both ``close()``es AND ``unlink()``s it (the
+    nidtlint ``shm-discipline`` contract; a SIGKILLed owner's segment
+    is reclaimed by multiprocessing's resource tracker instead)."""
+
+    def __init__(self, total_size: int):
+        from multiprocessing import shared_memory
+
+        self.total_size = int(total_size)
+        self.shm = shared_memory.SharedMemory(
+            create=True, size=_SHM_HEADER_BYTES + self.total_size * 8)
+        self.name = self.shm.name
+        self._hdr = np.ndarray(3, np.int64, buffer=self.shm.buf)
+        self._vec = np.ndarray(self.total_size, np.int64,
+                               buffer=self.shm.buf,
+                               offset=_SHM_HEADER_BYTES)
+        self._hdr[:] = 0
+
+    def write(self, segs: list[np.ndarray], w_int: int,
+              count: int) -> int:
+        """One exported partial into the slab; returns the (even)
+        generation the reader must observe unchanged around its copy.
+        The ack protocol makes a concurrent write impossible — the
+        seqlock turns 'impossible' into 'loudly detected'."""
+        gen = int(self._hdr[0])
+        self._hdr[0] = gen + 1          # odd: write in progress
+        if len(segs) == 1:
+            np.copyto(self._vec, segs[0])
+        else:
+            np.concatenate(segs, out=self._vec)
+        self._hdr[1] = int(w_int)
+        self._hdr[2] = int(count)
+        self._hdr[0] = gen + 2          # even: consistent
+        return gen + 2
+
+    def destroy(self) -> None:
+        """Owner teardown: close the mapping AND unlink the name."""
+        # numpy views export the buffer; drop them or close() raises
+        self._hdr = self._vec = None
+        try:
+            self.shm.close()
+        finally:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:  # pragma: no cover
+                pass
+
+
+class _ShmSlabReader:
+    """ATTACH side of one slab: copies the vector out under the
+    generation check, then acks so the writer may reuse the slab. The
+    attach side only ever ``close()``s — it must NEVER ``unlink()`` a
+    segment it does not own (nidtlint ``shm-attach-unlink``); a dead
+    owner's segment is the resource tracker's to reclaim."""
+
+    def __init__(self, name: str, total_size: int):
+        from multiprocessing import shared_memory
+
+        self.total_size = int(total_size)
+        self.shm = shared_memory.SharedMemory(name=name)
+        self._hdr = np.ndarray(3, np.int64, buffer=self.shm.buf)
+        self._vec = np.ndarray(self.total_size, np.int64,
+                               buffer=self.shm.buf,
+                               offset=_SHM_HEADER_BYTES)
+
+    def read(self, gen: int) -> tuple[np.ndarray, int, int]:
+        """``(flat_copy, w_int, count)`` — raises on a torn or stale
+        generation instead of ever returning a silently-wrong vector
+        (the audit would catch the count; the totals must never be
+        guessable-wrong)."""
+        g0 = int(self._hdr[0])
+        flat = self._vec.copy()
+        w_int, count = int(self._hdr[1]), int(self._hdr[2])
+        g1 = int(self._hdr[0])
+        if g0 != int(gen) or g1 != int(gen) or g0 % 2:
+            raise RuntimeError(
+                f"shm slab torn read: generation {g0}/{g1}, expected "
+                f"{int(gen)} — writer reused an un-acked slab")
+        return flat, w_int, count
+
+    def close(self) -> None:
+        self._hdr = self._vec = None
+        try:
+            self.shm.close()
+        except (OSError, BufferError):  # pragma: no cover
+            pass
+
+
+# ---------------------------------------------------------------------------
 # worker-side core (socket-free; unit-testable)
 # ---------------------------------------------------------------------------
 
@@ -471,6 +623,16 @@ class IngestWorkerCore:
         self._contributed: dict[int, set[int]] = {}
         self.registered: set[int] = set()
         self.last_synced: dict[int, int] = {}
+        #: ISSUE 18: sender-lifetime nonces (reconnect vs restart) and
+        #: the delta-sync capability set, both declared at registration
+        self.incarnations: dict[int, int] = {}
+        self.sync_delta_ok: set[int] = set()
+        #: delta frames are shared by every client syncing (base ->
+        #: current); cache one encode per pair, cleared on set_model
+        self._delta_cache: dict[tuple[int, int], dict] = {}
+        #: delta-sync accounting (honest fallback counts, ISSUE 18)
+        self.sync_stats = {"sync_delta_sent": 0, "sync_dense_sent": 0,
+                           "sync_dense_fallback_ring": 0}
         #: per-entry metadata riding the next exported partial:
         #: (client, tag_version, anchor_version, n, w_int, tau)
         self.entries: list[tuple] = []
@@ -497,18 +659,76 @@ class IngestWorkerCore:
             self._flat_ring.pop(old, None)
         for c, seen in self._contributed.items():
             self._contributed[c] = {v for v in seen if v >= floor}
+        # delta-sync frames against superseded versions are dead weight
+        # (every changed-version reply now deltas against a new pair)
+        self._delta_cache.clear()
 
     # ---- client plane ----
 
-    def handle_register(self, c: int) -> bool:
+    def handle_register(self, c: int, incarnation: int | None = None,
+                        delta_ok: bool = False) -> bool:
         """Returns True on first worker-local contact. A re-register —
         which is also how a connection migrates workers — resets the
-        sender's dedup state, exactly like the single-process server."""
+        sender's LOCAL dedup state, exactly like the single-process
+        server; a sender that declared an incarnation then has the
+        root's cross-worker watermark floor applied via
+        ``note_seqfloor`` BEFORE its register is answered (ISSUE 18),
+        so a worker hop cannot double-contribute."""
         first = c not in self.registered
         self.registered.add(c)
         self._seq_seen.pop(c, None)
         self._contributed.pop(c, None)
+        if incarnation is not None:
+            self.incarnations[c] = int(incarnation)
+        else:
+            self.incarnations.pop(c, None)
+        if delta_ok:
+            self.sync_delta_ok.add(c)
+        else:
+            self.sync_delta_ok.discard(c)
         return first
+
+    def note_seqfloor(self, c: int, inc: int, floor: int) -> None:
+        """Apply the root's cross-worker watermark floor (ISSUE 18).
+        Guarded by incarnation: a floor for a superseded incarnation
+        (the sender restarted while the message was in flight) must not
+        poison the fresh sender's seq space."""
+        if self.incarnations.get(c) != int(inc):
+            return
+        if int(floor) > self._seq_seen.get(c, -1):
+            self._seq_seen[c] = int(floor)
+
+    def build_sync_body(self, c: int):
+        """The model body of a CHANGED-version sync reply for sender
+        ``c``: the lossless delta against the sender's last-synced
+        version when it advertised the capability and the base is still
+        in the broadcast ring, else the dense tree (fallback counted
+        and logged — never silent). Returns ``(body, kind)`` with kind
+        in {"dense", "delta", "dense_fallback_ring"}."""
+        from neuroimagedisttraining_tpu.codec import wire as codec
+
+        base = self.last_synced.get(c)
+        if (c not in self.sync_delta_ok or base is None
+                or base == self.version):
+            self.sync_stats["sync_dense_sent"] += 1
+            return self.params, "dense"
+        if base not in self._ring:
+            log.info(
+                "ingest worker %d: delta-sync base %d for client %d "
+                "left the broadcast ring (current %d, floor %d); "
+                "falling back to a dense body", self.wid, base, c,
+                self.version, self.version - self.max_staleness)
+            self.sync_stats["sync_dense_fallback_ring"] += 1
+            return self.params, "dense_fallback_ring"
+        key = (int(base), self.version)
+        frame = self._delta_cache.get(key)
+        if frame is None:
+            frame = codec.encode_sync_delta(self.params,
+                                            self._ring[base],
+                                            base_version=base)
+            self._delta_cache[key] = frame
+        self.sync_stats["sync_delta_sent"] += 1
+        return frame, "delta"
 
     def handle_upload(self, msg: M.Message) -> str:
         """One admission decision; returns the verdict key (a
@@ -707,11 +927,13 @@ class _IngestWorkerProc(Observer):
     export that ships it — the FIFO pipe then guarantees the root sees
     events strictly before the partial containing them."""
 
-    def __init__(self, wid: int, core: IngestWorkerCore, comm, conn):
+    def __init__(self, wid: int, core: IngestWorkerCore, comm, conn,
+                 use_shm: bool = False, sync_delta: bool = False):
         self.wid = wid
         self.core = core
         self.comm = comm
         self.conn = conn
+        self.sync_delta = bool(sync_delta)
         self._lock = threading.Lock()
         #: verdict batch (under _lock): counts per verdict + the taus of
         #: accepted entries — ONE "vb" pipe message per batch instead of
@@ -721,6 +943,25 @@ class _IngestWorkerProc(Observer):
         self._vb_counts: dict[str, int] = {}
         self._vb_taus: list[int] = []
         self._vb_n = 0
+        #: accepted-upload watermark marks riding the next vb flush
+        #: (ISSUE 18): c -> (incarnation, max accepted seq)
+        self._vb_marks: dict[int, tuple[int, int]] = {}
+        #: registers deferred until the root's seqfloor answers (the
+        #: sender uploads only after its sync reply, so the floor is in
+        #: place before any post-migration upload can race it)
+        self._pending_reg: dict[int, bool] = {}
+        #: shm partial hand-off (ISSUE 18): double-buffered slabs owned
+        #: by THIS process; pipe carries control dicts, acks free slabs
+        self._slabs: list[_ShmSlabWriter] = []
+        self._free_slabs: list[int] = []
+        if use_shm:
+            self._slabs = [_ShmSlabWriter(core.partial._total_size)
+                           for _ in range(_SHM_SLABS)]
+            self._free_slabs = list(range(len(self._slabs)))
+        #: transport accounting for the shm-vs-pipe bench cell
+        self._xstats = {"shm_exports": 0, "pipe_exports": 0,
+                        "shm_export_ns": 0, "pipe_export_ns": 0,
+                        "shm_fallback_busy": 0}
         #: heartbeat batch (under _lock, ISSUE 13 satellite): per-client
         #: beats fold into ONE "beats" pipe message per flush interval
         #: — at cross-device scale the per-beat pipe events were the
@@ -756,8 +997,10 @@ class _IngestWorkerProc(Observer):
             self._beats_pending.clear()
         if not self._vb_n:
             return
-        self.conn.send(("vb", self.wid, self._vb_counts, self._vb_taus))  # nidt: allow[lock-send] -- every caller holds self._lock (the _locked suffix contract); the one pipe has no other writer thread outside it
+        self.conn.send(("vb", self.wid, self._vb_counts, self._vb_taus,  # nidt: allow[lock-send] -- every caller holds self._lock (the _locked suffix contract); the one pipe has no other writer thread outside it
+                        self._vb_marks))
         self._vb_counts, self._vb_taus, self._vb_n = {}, [], 0
+        self._vb_marks = {}
 
     def _ship_obs_locked(self, force: bool = False) -> None:
         """Under ``_lock``: one batched telemetry payload per interval
@@ -771,6 +1014,12 @@ class _IngestWorkerProc(Observer):
         self._pipe_thread.start()
         with self._lock:
             self.conn.send(("ready", self.wid))
+            if self._slabs:
+                # announced BEFORE any partial can reference a slab
+                # (same FIFO pipe), so the parent attaches in time
+                self.conn.send(("shm_names", self.wid,
+                                [s.name for s in self._slabs],
+                                self._slabs[0].total_size))
         self.comm.handle_receive_message()
 
     # ---- root pipe (its own thread) ----
@@ -792,6 +1041,7 @@ class _IngestWorkerProc(Observer):
                 # root died: nothing to aggregate into — stop serving
                 log.warning("ingest worker %d: root pipe closed; "
                             "shutting down", self.wid)
+                self._destroy_slabs()
                 self.comm.stop_receive_message()
                 return
             kind = cmd[0]
@@ -803,9 +1053,13 @@ class _IngestWorkerProc(Observer):
                     # verdicts strictly BEFORE the partial containing
                     # them (same pipe, FIFO)
                     self._flush_verdicts_locked()
-                    payload = self.core.export_partial()
-                    self.conn.send(("partial", self.wid, cmd[1], payload,
-                                    dict(self.core.stats)))
+                    self._export_locked(cmd[1])
+            elif kind == "shm_ack":
+                # parent copied the slab out: free it for reuse
+                with self._lock:
+                    self._free_slabs.append(int(cmd[1]))
+            elif kind == "seqfloor":
+                self._on_seqfloor(cmd[1], cmd[2], cmd[3])
             elif kind == "clock":
                 # spawn-time clock handshake (obs/fanin.py): echo the
                 # root's t0 with this process's perf_counter reading;
@@ -816,6 +1070,60 @@ class _IngestWorkerProc(Observer):
             elif kind == "finish":
                 self._finish()
                 return
+
+    def _export_locked(self, seq: int) -> None:
+        """Under ``_lock``: export the staged partial and ship it —
+        through a free shm slab when transport is enabled and one is
+        un-acked-free (O(control) pipe message), else pickled through
+        the pipe (the documented cross-host fallback, also taken when
+        both slabs are still in flight)."""
+        t0 = time.perf_counter_ns()
+        payload = self.core.export_partial()
+        if payload is None:
+            self.conn.send(("partial", self.wid, seq, None,  # nidt: allow[lock-send] -- caller holds self._lock (the _locked suffix contract); the one pipe has no other writer thread outside it
+                            dict(self.core.stats)))
+            return
+        if self._slabs and self._free_slabs:
+            idx = self._free_slabs.pop()
+            gen = self._slabs[idx].write(
+                [payload["slots"][name] for name, _ in self.core.sizes],
+                payload["w_int"], payload["count"])
+            ctrl = {"shm": idx, "gen": gen,
+                    "entries": payload["entries"]}
+            self.conn.send(("partial", self.wid, seq, ctrl,  # nidt: allow[lock-send] -- caller holds self._lock (the _locked suffix contract); the one pipe has no other writer thread outside it
+                            dict(self.core.stats)))
+            self._xstats["shm_exports"] += 1
+            self._xstats["shm_export_ns"] += \
+                time.perf_counter_ns() - t0
+            return
+        if self._slabs:
+            self._xstats["shm_fallback_busy"] += 1
+        self.conn.send(("partial", self.wid, seq, payload,  # nidt: allow[lock-send] -- caller holds self._lock (the _locked suffix contract); the one pipe has no other writer thread outside it
+                        dict(self.core.stats)))
+        self._xstats["pipe_exports"] += 1
+        self._xstats["pipe_export_ns"] += time.perf_counter_ns() - t0
+
+    def _destroy_slabs(self) -> None:
+        """Owner teardown: close AND unlink every slab exactly once."""
+        slabs, self._slabs, self._free_slabs = self._slabs, [], []
+        for s in slabs:
+            s.destroy()
+
+    def _on_seqfloor(self, c: int, inc: int, floor: int) -> None:
+        """Root answered a deferred register: install the surviving
+        watermark, then release the held INIT/SYNC reply."""
+        with self._lock:
+            self.core.note_seqfloor(c, inc, floor)
+            first = self._pending_reg.pop(c, None)
+            if first is None:
+                return
+            done = self.core.done
+            version, params = self.core.version, self.core.params
+        if done:
+            _send_tolerant(self.comm,
+                           M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
+            return
+        self._send_reg_reply(c, first, version, params)
 
     def _finish(self) -> None:
         with self._lock:
@@ -837,12 +1145,14 @@ class _IngestWorkerProc(Observer):
             # artifacts include this worker's tail
             self._ship_obs_locked(force=True)
             residual = self.core.partial.count
+            xs = {**self._xstats, **self.core.sync_stats}
             self.conn.send(("bye", self.wid, dict(self.core.stats),
                             residual, self.comm.byte_stats(),
-                            self.comm.peak_connections))
+                            self.comm.peak_connections, xs))
         # the worker's LOCAL trace dump (the .wN-suffixed secondary
         # artifact; the root's merged trace is the primary)
         obs_trace.dump()
+        self._destroy_slabs()
         self.comm.stop_receive_message()
 
     # ---- client frames (dispatch thread) ----
@@ -870,14 +1180,29 @@ class _IngestWorkerProc(Observer):
 
     def _on_register(self, msg: M.Message) -> None:
         c = msg.sender_id
+        inc = msg.get(M.ARG_CLIENT_INCARNATION)
+        delta_ok = bool(msg.get(M.ARG_SYNC_DELTA_OK)) and self.sync_delta
         with self._lock:
             if self.core.done:
                 _send_tolerant(self.comm,
                                M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
                 return
-            first = self.core.handle_register(c)
+            first = self.core.handle_register(c, incarnation=inc,
+                                              delta_ok=delta_ok)
+            if inc is not None:
+                # the reply is DEFERRED until the root's seqfloor
+                # lands: the sender uploads only after its sync reply,
+                # so the cross-worker watermark is installed before any
+                # post-migration upload can race it
+                self._pending_reg[c] = first
+                self.conn.send(("reg", self.wid, c, int(inc)))
+                return
             self.conn.send(("reg", self.wid, c))
             version, params = self.core.version, self.core.params
+        self._send_reg_reply(c, first, version, params)
+
+    def _send_reg_reply(self, c: int, first: bool, version: int,
+                        params) -> None:
         out = M.Message(M.MSG_TYPE_S2C_INIT_CONFIG if first
                         else M.MSG_TYPE_S2C_SYNC_MODEL, 0, c)
         out.add(M.ARG_MODEL_PARAMS, params)
@@ -897,11 +1222,23 @@ class _IngestWorkerProc(Observer):
                 tau = self.core.entries[-1][5] if self.core.entries \
                     else 0
                 self._vb_add_locked(verdict, int(tau))
+                seq = msg.get(M.ARG_UPLOAD_SEQ)
+                inc = self.core.incarnations.get(c)
+                if seq is not None and inc is not None:
+                    # accepted-seq mark rides the next vb flush so the
+                    # root watermark covers a later worker hop
+                    prev = self._vb_marks.get(c)
+                    if (prev is None or prev[0] != inc
+                            or int(seq) > prev[1]):
+                        self._vb_marks[c] = (inc, int(seq))
             else:
                 self._vb_add_locked(verdict, None)
             done = self.core.done
-            version, params = self.core.version, self.core.params
+            version = self.core.version
             fresh = self.core.last_synced.get(c) != version
+            body = None
+            if not done and fresh:
+                body, _kind = self.core.build_sync_body(c)
         if done:
             _send_tolerant(self.comm,
                            M.Message(M.MSG_TYPE_S2C_FINISH, 0, c))
@@ -909,12 +1246,15 @@ class _IngestWorkerProc(Observer):
         out = M.Message(M.MSG_TYPE_S2C_SYNC_MODEL, 0, c)
         out.add(M.ARG_ROUND_IDX, version)
         if fresh:
-            # the sender's model is behind: ship the full body. At an
+            # the sender's model is behind: ship a body. At an
             # unchanged version the body is OMITTED — the sender holds
             # that exact tree already (cached-sync contract,
             # cross_silo.FedAvgClientProc) — which removes the per-
             # upload model serialization from the hot path entirely.
-            out.add(M.ARG_MODEL_PARAMS, params)
+            # A delta-capable sender gets the lossless delta against
+            # its last-synced version when that base is still in the
+            # broadcast ring (build_sync_body, ISSUE 18).
+            out.add(M.ARG_MODEL_PARAMS, body)
         if _send_tolerant(self.comm, out) and fresh:
             # recorded only on DELIVERED body (see _on_register)
             with self._lock:
@@ -981,7 +1321,9 @@ def _ingest_worker_main(wid: int, conn, wcfg: dict) -> None:
                                base_port=wcfg["base_port"],
                                send_timeout=2.0, reuse_port=True,
                                inline_dispatch=True)
-    worker = _IngestWorkerProc(wid, core, comm, conn)
+    worker = _IngestWorkerProc(wid, core, comm, conn,
+                               use_shm=bool(wcfg.get("shm")),
+                               sync_delta=bool(wcfg.get("sync_delta")))
     try:
         worker.run()
     except Exception:  # noqa: BLE001 — log the real error before the
@@ -1030,6 +1372,11 @@ class ShardedIngestServer(BufferedFedAvgServer):
     server-side defenses/quarantine are rejected at construction — the
     root only ever sees pre-folded partials."""
 
+    #: audit key for uploads buffered at a child when it died — the
+    #: hierarchical tier's children are whole REGIONS, so it overrides
+    #: this to "lost_with_region" (asyncfl/region.py)
+    _lost_key = "lost_with_worker"
+
     def __init__(self, init_params, comm_round: int, num_clients: int,
                  ingest_workers: int = 2, buffer_k: int = 0,
                  staleness_alpha: float = 0.5, max_staleness: int = 20,
@@ -1039,7 +1386,8 @@ class ShardedIngestServer(BufferedFedAvgServer):
                  heartbeat_timeout: float = 0.0, wire_masks=None,
                  host_map: dict[int, str] | None = None,
                  spawn_timeout: float = 180.0, trace_out: str = "",
-                 flight_out: str = "", **kw):
+                 flight_out: str = "", use_shm: bool = False,
+                 sync_delta: bool = False, **kw):
         if ingest_workers < 1:
             raise ValueError(
                 f"ingest_workers must be >= 1, got {ingest_workers}")
@@ -1063,6 +1411,7 @@ class ShardedIngestServer(BufferedFedAvgServer):
                          world_size=world_size, comm=NullCommManager(),
                          heartbeat_timeout=heartbeat_timeout, **kw)
         self.upload_stats["lost_with_worker"] = 0
+        self.upload_stats[self._lost_key] = 0
         self.fold_spec = make_fold_spec(self.params, quant=secure_quant,
                                         weight_ref=ingest_weight_ref)
         self.ingest_quant = secure_quant
@@ -1091,9 +1440,18 @@ class ShardedIngestServer(BufferedFedAvgServer):
         # workers write .wN-suffixed local secondaries.
         self.trace_out = trace_out
         self.flight_out = flight_out
-        self.fanin = obs_fanin.TelemetryFanIn()
+        self.fanin = self._make_fanin()
         self._stage_hist = obs_fanin.stage_histogram()
         self._obs_dumped = False
+        # ---- cross-worker exactly-once (ISSUE 18) ----
+        # root-held accepted-seq watermarks, advanced by vb marks and
+        # answered to deferred registers so a worker/region-hopping
+        # client cannot double-contribute
+        self._watermarks = SeqWatermarks()
+        # cached flat layout for rebuilding shm partial slots
+        self._fold_sizes = model_sizes(self.params)
+        self._fold_splits = np.cumsum(
+            [n for _, n in self._fold_sizes])[:-1]
         # ---- worker processes ----
         ctx = mp.get_context("spawn")
         wcfg = {"spec": self.fold_spec, "init_params": self.params,
@@ -1103,23 +1461,21 @@ class ShardedIngestServer(BufferedFedAvgServer):
                 "host_map": host_map,
                 "world_size": world_size or num_clients + 1,
                 "base_port": self.base_port,
+                "shm": bool(use_shm),
+                "sync_delta": bool(sync_delta),
                 "obs": {"trace": bool(trace_out) or obs_trace.TRACER.armed,
                         "trace_path": trace_out,
                         "flight_path": flight_out,
                         "flight_capacity": obs_flight.FLIGHT.capacity}}
         self._workers: dict[int, dict] = {}
         for wid in range(self.ingest_workers):
-            parent, child = ctx.Pipe(duplex=True)
-            proc = ctx.Process(target=_ingest_worker_main,
-                               args=(wid, child, wcfg), daemon=True,
-                               name=f"nidt-ingest-w{wid}")
-            proc.start()
-            child.close()
+            proc, parent = self._spawn_child(ctx, wid, wcfg)
             self._workers[wid] = {
                 "proc": proc, "conn": parent, "alive": True,
                 "acc": 0, "folded": 0, "partials": 0,
                 "stats": None, "residual": 0, "bye": False,
                 "byte_stats": None, "peak_conns": 0,
+                "xstats": None, "shm": None, "last_partial_t": None,
             }
         deadline = time.monotonic() + spawn_timeout
         ready: set[int] = set()
@@ -1160,7 +1516,7 @@ class ShardedIngestServer(BufferedFedAvgServer):
         # that gap, so the estimated offset would absorb half of it
         # and misalign every worker timeline in the merged trace
         for wid, w in self._workers.items():
-            self.fanin.register_worker(wid)
+            self._register_fanin(wid)
             try:
                 w["conn"].send(("clock", time.perf_counter_ns()))  # nidt: allow[lock-send] -- ctor is single-threaded: the event loop and monitor threads do not exist yet
             except (BrokenPipeError, OSError):
@@ -1189,11 +1545,47 @@ class ShardedIngestServer(BufferedFedAvgServer):
         log.info("ingest root: %d workers ready on port %d",
                  self.ingest_workers, self.base_port)
 
+    def _make_fanin(self) -> obs_fanin.TelemetryFanIn:
+        """Fan-in label tiers — one ``worker`` tier here; the
+        hierarchical root overrides with ``("region", "worker")``."""
+        return obs_fanin.TelemetryFanIn()
+
+    def _register_fanin(self, wid: int) -> None:
+        """Register the fan-in key(s) one direct child contributes —
+        a region child registers every (region, worker) pair."""
+        self.fanin.register_worker(wid)
+
+    def _spawn_child(self, ctx, wid: int, wcfg: dict):
+        """Spawn ONE direct child (an ingest worker here; a regional
+        sub-aggregator in ``HierarchicalIngestServer``) and return
+        ``(process, parent_conn)`` — the override point that lets the
+        hierarchical tier reuse the whole root event loop, because a
+        region speaks the exact worker pipe protocol upstream."""
+        parent, child = ctx.Pipe(duplex=True)
+        proc = ctx.Process(target=_ingest_worker_main,
+                           args=(wid, child, wcfg), daemon=True,
+                           name=f"nidt-ingest-w{wid}")
+        proc.start()
+        child.close()
+        return proc, parent
+
     # ---- introspection (tests / loadgen) ----
 
     @property
     def worker_pids(self) -> list[int]:
         return [w["proc"].pid for w in self._workers.values()]
+
+    def worker_xstats(self) -> dict[str, int]:
+        """Summed per-worker transport/sync accounting from the byes
+        (shm vs pipe export counts + ns, delta-sync counts) — the bench
+        cells' raw material."""
+        out: dict[str, int] = {}
+        for w in self._workers.values():
+            xs = w["xstats"]
+            if xs:
+                for k, v in xs.items():
+                    out[k] = out.get(k, 0) + int(v)
+        return out
 
     def live_workers(self) -> list[int]:
         return [wid for wid, w in self._workers.items() if w["alive"]]
@@ -1348,11 +1740,28 @@ class ShardedIngestServer(BufferedFedAvgServer):
                 for tau in taus:
                     self._obs_staleness.observe(tau)
                 self._obs_pending.set(self._pending())
+            if len(ev) > 4 and ev[4]:
+                # accepted-seq marks (ISSUE 18): advance the root
+                # watermark so a later re-register on ANY worker
+                # inherits the floor
+                for c, (inc, seq) in ev[4].items():
+                    self._watermarks.advance(c, inc, seq)
         elif kind == "reg":
             c = ev[2]
             self._registered.add(c)
             self._suspect.discard(c)
             self._last_beat[c] = time.monotonic()
+            if len(ev) > 3 and ev[3] is not None:
+                # incarnation-carrying register: answer the surviving
+                # watermark — the worker holds the client's reply until
+                # this seqfloor lands (exactly-once across hops)
+                inc = int(ev[3])
+                floor = self._watermarks.register(c, inc)
+                try:
+                    w["conn"].send(("seqfloor", c, inc, floor))  # nidt: allow[lock-send] -- caller holds _rlock (method contract) and the event loop is the ONLY thread that ever writes a worker pipe
+                except (BrokenPipeError, OSError):
+                    self._mark_worker_dead_locked(wid,
+                                                  "seqfloor send failed")
         elif kind == "beat":
             c = ev[2]
             self._last_beat[c] = time.monotonic()
@@ -1374,10 +1783,18 @@ class ShardedIngestServer(BufferedFedAvgServer):
         elif kind == "clock_reply":
             self.fanin.note_clock(wid, ev[2], ev[3],
                                   time.perf_counter_ns())
+        elif kind == "shm_names":
+            # worker announced its slabs (FIFO-before any shm partial):
+            # attach read-only views; NEVER unlinked here — the worker
+            # owns the segments and unlinks on ITS teardown
+            w["shm"] = [_ShmSlabReader(name, ev[3]) for name in ev[2]]
         elif kind == "partial":
             seq, payload, stats = ev[2], ev[3], ev[4]
             w["stats"] = stats
+            if isinstance(payload, dict) and "shm" in payload:
+                payload = self._resolve_shm_partial(wid, payload)
             if payload is not None:
+                w["last_partial_t"] = time.monotonic()
                 w["folded"] += int(payload["count"])
                 w["partials"] += 1
                 self._obs_partials.inc(worker=str(wid))
@@ -1396,11 +1813,31 @@ class ShardedIngestServer(BufferedFedAvgServer):
         elif kind == "bye":
             w["stats"], w["residual"] = ev[2], ev[3]
             w["byte_stats"], w["peak_conns"] = ev[4], ev[5]
+            if len(ev) > 6:
+                w["xstats"] = ev[6]
             w["bye"] = True
         elif kind == "ready":
             pass
         else:  # pragma: no cover
             log.warning("ingest root: unknown worker event %r", kind)
+
+    def _resolve_shm_partial(self, wid: int, ctrl: dict) -> dict:
+        """Under ``_rlock``: materialize a shm-transported partial —
+        copy the flat int64 vector out of the slab (seqlock-checked),
+        ack the slab back to the worker for reuse, rebuild the
+        per-leaf slots from the cached flat layout."""
+        w = self._workers[wid]
+        idx = int(ctrl["shm"])
+        flat, w_int, count = w["shm"][idx].read(ctrl["gen"])
+        try:
+            w["conn"].send(("shm_ack", idx))  # nidt: allow[lock-send] -- caller holds _rlock (method contract) and the event loop is the ONLY thread that ever writes a worker pipe
+        except (BrokenPipeError, OSError):
+            pass  # death surfaces on the sentinel; the copy is ours
+        segs = np.split(flat, self._fold_splits)
+        slots = {name: seg
+                 for (name, _), seg in zip(self._fold_sizes, segs)}
+        return {"slots": slots, "w_int": int(w_int),
+                "count": int(count), "entries": ctrl["entries"]}
 
     def _pending(self) -> int:
         """Under ``_rlock``: accepted uploads not yet merged, lost, or
@@ -1543,13 +1980,22 @@ class ShardedIngestServer(BufferedFedAvgServer):
         except (EOFError, OSError):
             pass
         w["alive"] = False
+        if w["shm"]:
+            # attach-side teardown: close our mappings ONLY — the
+            # (dead) worker owned the segments; unlink is its job (or
+            # the resource tracker's, for a SIGKILL)
+            readers, w["shm"] = w["shm"], None
+            for r in readers:
+                r.close()
         self.fanin.mark_dead(wid)  # last snapshot stays, marked stale
         lost = max(0, w["acc"] - w["folded"] - w["residual"])
         if lost and not w["bye"]:
-            # accepted uploads that died WITH the worker: accounted
+            # accepted uploads that died WITH the child: accounted
             # explicitly so the audit reconciles instead of leaking
-            self.upload_stats["lost_with_worker"] += lost
-            self._obs_uploads.inc(lost, outcome="lost_with_worker")
+            # (lost_with_worker on a flat root, lost_with_region when
+            # the dead child is a whole region)
+            self.upload_stats[self._lost_key] += lost
+            self._obs_uploads.inc(lost, outcome=self._lost_key)
             w["folded"] += lost
         self._obs_workers.set(len(self.live_workers()))
         obs_flight.record("worker_dead", worker=wid, why=why,
@@ -1599,6 +2045,10 @@ class ShardedIngestServer(BufferedFedAvgServer):
                 p.terminate()
                 p.join(timeout=2.0)
             w["alive"] = False
+            if w["shm"]:
+                readers, w["shm"] = w["shm"], None
+                for r in readers:
+                    r.close()
 
     def _maybe_complete(self) -> None:
         """The heartbeat monitor's nudge: a fresh suspect may have
@@ -1636,7 +2086,8 @@ class ShardedIngestServer(BufferedFedAvgServer):
                     s["received"] == s["accepted"] + dropped,
                 "accepted_accounted":
                     s["accepted"] == (aggregated + buffered
-                                      + s["lost_with_worker"]
+                                      + s.get("lost_with_worker", 0)
+                                      + s.get("lost_with_region", 0)
                                       + s["aggregation_discarded"]),
             }
         if not (audit["received_accounted"]

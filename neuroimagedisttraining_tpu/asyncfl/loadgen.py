@@ -88,6 +88,20 @@ class ClientStats:
     rejoins: int = 0
     finished: int = 0
     errors: int = 0
+    #: changed-version SYNC_MODEL replies that carried a body, and the
+    #: total received frame bytes of those replies — the downlink-bytes
+    #: denominator/numerator of the delta-sync cell (ISSUE 18); the
+    #: register bootstrap (INIT_CONFIG) is excluded: it is always dense
+    #: by design, not a changed-version sync
+    sync_bodies: int = 0
+    sync_body_bytes: int = 0
+    #: sync bodies that arrived as lossless delta frames and decoded
+    #: against the client-held base
+    delta_syncs: int = 0
+    #: delta frames whose named base did NOT match the client-held
+    #: version (protocol error — the client recovers by re-registering
+    #: for a dense resync, never by applying a wrong-base delta)
+    delta_errors: int = 0
     #: sampled upload->sync round-trips (ms, every 4th), fleet-merged
     #: by list concatenation in run_load's aggregation loop
     rtt_ms: list = dataclasses.field(default_factory=list)
@@ -100,10 +114,16 @@ def _frame(msg: M.Message) -> bytes:
 async def _read_msg(reader: asyncio.StreamReader) -> M.Message:
     header = await reader.readexactly(8)
     (length,) = struct.unpack("!Q", header)
-    return M.Message.from_bytes(await reader.readexactly(length))
+    msg = M.Message.from_bytes(await reader.readexactly(length))
+    # received wire size (header + body) — the downlink byte accounting
+    # of the delta-sync cell reads it off the reply it measures
+    msg.recv_len = 8 + length
+    return msg
 
 
-async def _connect_and_register(rank: int, port: int, server_done
+async def _connect_and_register(rank: int, port: int, server_done,
+                                incarnation: int | None = None,
+                                delta_ok: bool = False
                                 ) -> tuple[asyncio.StreamReader,
                                            asyncio.StreamWriter] | None:
     """Connect with patience — a 1k-client connect storm can transiently
@@ -126,6 +146,13 @@ async def _connect_and_register(rank: int, port: int, server_done
     # promise a persistent connection: the selector core routes every
     # reply to this rank back on this very socket
     reg.add(M.ARG_CONN_PERSISTENT, True)
+    if incarnation is not None:
+        # exactly-once dedup (ISSUE 18): the SAME incarnation rides
+        # every reconnect of this client process, so a post-migration
+        # worker learns the root's accepted-seq floor before replying
+        reg.add(M.ARG_CLIENT_INCARNATION, int(incarnation))
+    if delta_ok:
+        reg.add(M.ARG_SYNC_DELTA_OK, True)
     writer.write(_frame(reg))
     await writer.drain()
     return reader, writer
@@ -136,20 +163,40 @@ async def _run_client(rank: int, port: int, update: dict,
                       schedule: FaultSchedule | None,
                       version_probe, server_done, train_delay: float,
                       start_stagger: float, report_corpse=None,
-                      reconnect: bool = False) -> None:
+                      reconnect: bool = False,
+                      incarnation: int | None = None,
+                      sync_delta: bool = False,
+                      local_scale: float = 0.0) -> None:
     """One simulated client: persistent connection, real protocol, canned
     uploads, schedule-driven churn. ``version_probe``/``server_done``
     peek at the in-process server so a crashed client knows when its
-    rejoin round has arrived without holding a connection."""
+    rejoin round has arrived without holding a connection.
+
+    ``incarnation`` (constant across this coroutine's reconnects — the
+    upload ``seq`` below never resets either) arms the ingest root's
+    exactly-once watermarks; ``sync_delta`` opts into lossless delta
+    sync bodies and decodes them against the tracked base;
+    ``local_scale > 0`` uploads ``synced_params + local_scale * canned``
+    instead of the bare canned tree — the small-local-update regime of
+    real federated training, where consecutive model versions are
+    correlated enough for a delta to beat the dense body (the canned
+    random walk is not)."""
     if start_stagger > 0:
         await asyncio.sleep(start_stagger)
-    conn = await _connect_and_register(rank, port, server_done)
+    conn = await _connect_and_register(rank, port, server_done,
+                                       incarnation, sync_delta)
     if conn is None:
         stats.finished += 1
         return
     reader, writer = conn
     seq = 0
     t_sent = None
+    track_model = sync_delta or local_scale > 0
+    model = None        # last synced dense-equivalent tree (tracked)
+    model_version = -1  # the version that tree corresponds to
+    wire = None
+    if sync_delta:
+        from neuroimagedisttraining_tpu.codec import wire
 
     async def _lost_connection() -> bool:
         """Unexpected connection loss. Returns True when the client
@@ -164,7 +211,8 @@ async def _run_client(rank: int, port: int, update: dict,
             stats.errors += 1
             return False
         stats.errors += 1
-        c = await _connect_and_register(rank, port, server_done)
+        c = await _connect_and_register(rank, port, server_done,
+                                        incarnation, sync_delta)
         if c is None:
             stats.finished += 1
             return False
@@ -197,6 +245,30 @@ async def _run_client(rank: int, port: int, update: dict,
                 # fleet-merge time instead (end-of-run visibility).
                 obs_fanin.rtt_histogram().observe(rtt)
             t_sent = None
+        body = msg.get(M.ARG_MODEL_PARAMS) if track_model else None
+        if body is not None:
+            if msg.msg_type == M.MSG_TYPE_S2C_SYNC_MODEL:
+                stats.sync_bodies += 1
+                stats.sync_body_bytes += msg.recv_len
+            if wire is not None and wire.is_sync_delta_frame(body):
+                if model is None or int(body["base"]) != model_version:
+                    # protocol error, handled LOUDLY: never apply a
+                    # delta to a base the encoder did not name —
+                    # re-register for a dense resync instead
+                    stats.delta_errors += 1
+                    log.error(
+                        "client %d: sync delta names base %s but the "
+                        "client holds %d — re-registering for a dense "
+                        "resync", rank, body.get("base"), model_version)
+                    writer.close()
+                    if await _lost_connection():
+                        continue
+                    return
+                model = wire.decode_sync_delta(body, model)
+                stats.delta_syncs += 1
+            else:
+                model = body
+            model_version = version
         if schedule is not None and schedule.crashed(version, rank):
             # simulated SIGKILL: drop the connection, then wait out the
             # crash window (rejoin directive) by watching the server's
@@ -212,8 +284,8 @@ async def _run_client(rank: int, port: int, update: dict,
             while not server_done():
                 v = version_probe()
                 if not schedule.crashed(v, rank):
-                    conn = await _connect_and_register(rank, port,
-                                                       server_done)
+                    conn = await _connect_and_register(
+                        rank, port, server_done, incarnation, sync_delta)
                     if conn is None:
                         break  # finished while reconnecting
                     stats.rejoins += 1
@@ -233,7 +305,9 @@ async def _run_client(rank: int, port: int, update: dict,
         if delay > 0:
             await asyncio.sleep(delay)
         out = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, rank, 0)
-        out.add(M.ARG_MODEL_PARAMS, update)
+        out.add(M.ARG_MODEL_PARAMS,
+                _local_update_tree(model, update, local_scale)
+                if local_scale > 0 and model is not None else update)
         out.add(M.ARG_NUM_SAMPLES, num_samples)
         out.add(M.ARG_ROUND_IDX, version)
         out.add(M.ARG_UPLOAD_SEQ, seq)
@@ -263,6 +337,18 @@ async def _run_client(rank: int, port: int, update: dict,
         t_sent = time.monotonic()
 
 
+def _local_update_tree(base: dict, canned: dict, scale: float):
+    """``base + scale * canned``, leaf-wise, dtype-preserving — one
+    simulated local training step from the last synced model. Keeps the
+    upload structurally identical to the canned tree the servers'
+    templates expect."""
+    if isinstance(base, dict):
+        return {k: _local_update_tree(base[k], canned[k], scale)
+                for k in base}
+    b = np.asarray(base)
+    return b + b.dtype.type(scale) * np.asarray(canned)
+
+
 def bench_payload(r: int, leaf_elems: int, quant, seed: int):
     """The canned upload of one simulated client — shared by the
     in-process fleet and the spawned fleet shards so the two generators
@@ -279,7 +365,9 @@ def bench_payload(r: int, leaf_elems: int, quant, seed: int):
 
 
 def _fleet_proc_main(conn, ranks, port, leaf_elems, secure, seed,
-                     train_delay, ready_go, done_ev, reconnect) -> None:
+                     train_delay, ready_go, done_ev, reconnect,
+                     use_inc=False, sync_delta=False,
+                     local_scale=0.0) -> None:
     """Spawned fleet shard (loadgen scale-out). One asyncio client loop
     is ~one core of SYSCALL work on this box (socket.send alone profiles
     at ~0.5 ms in this kernel), so a single-process fleet caps near the
@@ -304,7 +392,9 @@ def _fleet_proc_main(conn, ranks, port, leaf_elems, secure, seed,
             r, port, payloads[r], float(8 + r % 5), stats[r], None,
             lambda: -1, done_ev.is_set, train_delay,
             start_stagger=r * 0.002, report_corpse=None,
-            reconnect=reconnect))
+            reconnect=reconnect,
+            incarnation=(r if use_inc else None),
+            sync_delta=sync_delta, local_scale=local_scale))
             for r in ranks]
         await asyncio.gather(*tasks)
 
@@ -728,6 +818,10 @@ def run_load(mode: str = "async", num_clients: int = 200,
              ingest_workers: int = 2,
              ingest_kill_at: int = -1,
              ingest_secure_quant: bool = False,
+             regions: int = 0,
+             ingest_shm: bool = False,
+             sync_delta: bool = False,
+             upload_local_scale: float = 0.0,
              fleet_procs: int = 1,
              trace_out: str = "",
              flight_out: str = "",
@@ -753,7 +847,17 @@ def run_load(mode: str = "async", num_clients: int = 200,
     shards the CLIENT fleet across that many processes (bench cells
     only — fault schedules need the in-process server probes and pin
     ``fleet_procs=1``); the same fleet drives every mode, so the
-    comparison stays generator-fair."""
+    comparison stays generator-fair.
+
+    ISSUE 18 knobs (``mode="ingest"``): ``regions > 0`` runs the
+    HIERARCHICAL tier — that many region sub-aggregator processes, each
+    owning ``ingest_workers`` workers on the shared SO_REUSEPORT port
+    (``ingest_kill_at`` then SIGKILLs REGION 0, the region chaos cell);
+    ``ingest_shm`` hands partials to the parent over double-buffered
+    shared-memory slabs instead of the pickled pipe; ``sync_delta``
+    lets clients opt into lossless delta sync bodies;
+    ``upload_local_scale > 0`` uploads ``synced + scale * canned``
+    (the correlated-model regime the downlink-bytes cell measures)."""
     if mode == "serve":
         if fault_spec:
             raise ValueError(
@@ -793,18 +897,35 @@ def run_load(mode: str = "async", num_clients: int = 200,
             from neuroimagedisttraining_tpu.privacy import QuantSpec
 
             quant = QuantSpec.from_bits(32, 10, 3)
+            if upload_local_scale > 0:
+                raise ValueError(
+                    "upload_local_scale needs plaintext uploads built "
+                    "from the synced model; secure_quant clients ship "
+                    "pre-encoded field-element frames")
         if trace_out:
             # the harness process hosts BOTH the in-process client
             # fleet and the ingest root, so arming here captures the
             # client flow starts AND the root merge/aggregate spans;
             # workers arm their own tracers from the wcfg obs config
             obs_trace.arm(trace_out, tags={"role": "loadgen-root"})
-        server = ShardedIngestServer(
-            init, aggregations, num_clients,
-            ingest_workers=ingest_workers, buffer_k=k,
-            staleness_alpha=staleness_alpha, max_staleness=max_staleness,
-            base_port=port, secure_quant=quant, trace_out=trace_out,
-            flight_out=flight_out)
+        common_kw = dict(
+            buffer_k=k, staleness_alpha=staleness_alpha,
+            max_staleness=max_staleness, base_port=port,
+            secure_quant=quant, trace_out=trace_out,
+            flight_out=flight_out, use_shm=ingest_shm,
+            sync_delta=sync_delta)
+        if regions > 0:
+            from neuroimagedisttraining_tpu.asyncfl.region import (
+                HierarchicalIngestServer,
+            )
+
+            server = HierarchicalIngestServer(
+                init, aggregations, num_clients, regions=regions,
+                workers_per_region=ingest_workers, **common_kw)
+        else:
+            server = ShardedIngestServer(
+                init, aggregations, num_clients,
+                ingest_workers=ingest_workers, **common_kw)
         rounds = aggregations
     elif mode == "async":
         comm = SelectorCommManager(0, num_clients + 1, base_port=port,
@@ -863,7 +984,10 @@ def run_load(mode: str = "async", num_clients: int = 200,
             r, port, client_payload(r), float(8 + r % 5),
             stats[r], schedule, version_probe, server_done, train_delay,
             start_stagger=r * 0.002, report_corpse=report_corpse,
-            reconnect=(mode == "ingest")))
+            reconnect=(mode == "ingest"),
+            incarnation=(r if mode == "ingest" else None),
+            sync_delta=(sync_delta and mode == "ingest"),
+            local_scale=upload_local_scale))
             for r in range(1, num_clients + 1)]
         await asyncio.gather(*tasks)
 
@@ -889,7 +1013,9 @@ def run_load(mode: str = "async", num_clients: int = 200,
                 target=_fleet_proc_main,
                 args=(child_c, [int(r) for r in sl], port, leaf_elems,
                       quant is not None, seed, train_delay, ready_go,
-                      done_ev, mode == "ingest"),
+                      done_ev, mode == "ingest", mode == "ingest",
+                      sync_delta and mode == "ingest",
+                      upload_local_scale),
                 daemon=True, name="nidt-loadgen-fleet")
             p.start()
             child_c.close()
@@ -911,9 +1037,11 @@ def run_load(mode: str = "async", num_clients: int = 200,
     server_thread.start()
     if mode == "ingest" and ingest_kill_at >= 0:
         def _kill_watch():
-            # the chaos cell: SIGKILL worker 0 once the version reaches
-            # the trigger — its clients reconnect onto the surviving
-            # SO_REUSEPORT listeners and the audit must stay green
+            # the chaos cell: SIGKILL worker 0 (region 0 in the
+            # hierarchical tier — worker_pids[0] is the region process)
+            # once the version reaches the trigger — its clients
+            # reconnect onto the surviving SO_REUSEPORT listeners and
+            # the audit must stay green
             while not server_done():
                 if server.round_idx >= ingest_kill_at:
                     try:
@@ -1048,6 +1176,23 @@ def run_load(mode: str = "async", num_clients: int = 200,
         result["secure_quant"] = bool(ingest_secure_quant)
         result["lost_with_worker"] = int(
             server.upload_stats["lost_with_worker"])
+        # ---- hierarchical tier / transport cells (ISSUE 18) ----
+        result["ingest_shm"] = bool(ingest_shm)
+        result["sync_delta"] = bool(sync_delta)
+        result["upload_local_scale"] = (float(upload_local_scale)
+                                        if upload_local_scale else None)
+        xstats = server.worker_xstats()
+        result["worker_xstats"] = xstats
+        for kind in ("shm", "pipe"):
+            n = xstats.get(f"{kind}_exports", 0)
+            result[f"{kind}_export_us_mean"] = (
+                round(xstats.get(f"{kind}_export_ns", 0) / n / 1e3, 1)
+                if n else None)
+        if regions > 0:
+            result["regions"] = int(regions)
+            result["workers_per_region"] = int(ingest_workers)
+            result["lost_with_region"] = int(
+                server.upload_stats["lost_with_region"])
         # ---- federation-wide obs summary (ISSUE 13) ----
         result["obs_fanin"] = server.fanin.summary()
         merged_text = server.fanin.prometheus_text()
@@ -1058,6 +1203,9 @@ def run_load(mode: str = "async", num_clients: int = 200,
             "lines": len(merged_text.splitlines()),
             "worker_labeled": sorted(
                 {int(m) for m in _re.findall(r'worker="(\d+)"',
+                                             merged_text)}),
+            "region_labeled": sorted(
+                {int(m) for m in _re.findall(r'region="(\d+)"',
                                              merged_text)}),
             "has_stage_samples":
                 (obs_names.UPLOAD_STAGE_MS + "_bucket") in merged_text,
@@ -1093,13 +1241,18 @@ def main(argv=None) -> int:
         description=__doc__.split("\n\n")[0])
     ap.add_argument("--clients", type=int, default=1000)
     ap.add_argument("--mode", choices=("async", "sync", "both", "ingest",
-                                       "ingest_bench", "serve"),
+                                       "ingest_bench", "region_bench",
+                                       "serve"),
                     default="both",
                     help="ingest = one sharded-plane run at "
                          "--ingest_workers; ingest_bench = the headline "
                          "sweep (single-process async baseline, then "
                          "ingest at N in {1, 2, 4} workers) -> "
-                         "bench_matrix/ingest_bench.json; serve = "
+                         "bench_matrix/ingest_bench.json; region_bench "
+                         "= the hierarchical-tier matrix (tree "
+                         "throughput, shm-vs-pipe hand-off, downlink "
+                         "delta-sync bytes) -> "
+                         "bench_matrix/region_bench.json; serve = "
                          "open-loop request fleet against the serving "
                          "plane (--serve_bundle) -> "
                          "bench_matrix/serve_bench.json")
@@ -1127,6 +1280,35 @@ def main(argv=None) -> int:
     ap.add_argument("--ingest_secure_quant", action="store_true",
                     help="clients ship secure-quant field-element "
                          "frames; workers fold SlotAccumulator chunks")
+    ap.add_argument("--regions", type=int, default=0,
+                    help="mode ingest/region_bench: run the "
+                         "HIERARCHICAL tier with this many region "
+                         "sub-aggregator processes, each owning "
+                         "--ingest_workers workers (0 = flat root)")
+    ap.add_argument("--ingest_shm", action="store_true",
+                    help="hand worker partials to the parent over "
+                         "double-buffered shared-memory slabs instead "
+                         "of the pickled pipe")
+    ap.add_argument("--sync_delta", action="store_true",
+                    help="clients opt into lossless delta sync bodies "
+                         "(changed-version replies ship the byte delta "
+                         "against the client's last-synced version)")
+    ap.add_argument("--upload_local_scale", type=float, default=0.0,
+                    help="clients upload synced + SCALE * canned "
+                         "instead of the bare canned tree (the "
+                         "correlated-model regime of the downlink-"
+                         "bytes cell); 0 = canned uploads")
+    ap.add_argument("--downlink_clients", type=int, default=600,
+                    help="mode region_bench: fleet size of the two "
+                         "downlink-bytes cells (they measure bytes "
+                         "per changed-version sync, not throughput)")
+    ap.add_argument("--downlink_aggregations", type=int, default=80,
+                    help="mode region_bench: aggregation count of the "
+                         "two downlink-bytes cells")
+    ap.add_argument("--downlink_leaf_elems", type=int, default=4096,
+                    help="mode region_bench: model size of the two "
+                         "downlink-bytes cells (large enough that the "
+                         "message envelope does not dominate)")
     ap.add_argument("--serve_bundle", type=str, default="",
                     help="mode serve: deployment-bundle directory "
                          "(python -m neuroimagedisttraining_tpu.serve "
@@ -1178,7 +1360,8 @@ def main(argv=None) -> int:
 
     fleet_procs = args.fleet_procs
     if fleet_procs == 0:
-        fleet_procs = (3 if args.mode in ("ingest_bench", "serve")
+        fleet_procs = (3 if args.mode in ("ingest_bench", "region_bench",
+                                          "serve")
                        and not args.fault_spec else 1)
     common = dict(
         num_clients=args.clients, aggregations=args.aggregations,
@@ -1198,6 +1381,36 @@ def main(argv=None) -> int:
                 mode="ingest", ingest_workers=n,
                 ingest_secure_quant=args.ingest_secure_quant, **common)
             print(json.dumps(cells[f"ingest_w{n}"]), flush=True)
+    elif args.mode == "region_bench":
+        # the hierarchical-tier matrix (ISSUE 18). The two TREE cells
+        # run the committed ingest_bench configuration so the headline
+        # number is comparable to the committed single-root cells, and
+        # differ ONLY in the partial hand-off transport (the shm-vs-
+        # pipe A/B). The two DOWNLINK cells measure bytes per changed-
+        # version sync reply in the small-local-update regime
+        # (synced + 1e-6 * canned uploads): that is the federated-
+        # training dynamics where consecutive versions correlate and a
+        # lossless delta can beat the dense body — the stock canned
+        # uploads drive the aggregate on a random walk whose version-
+        # to-version XOR is incompressible and would measure nothing
+        # about the transport.
+        tree = dict(regions=(args.regions or 2),
+                    ingest_workers=args.ingest_workers)
+        cells["tree_shm"] = run_load(mode="ingest", ingest_shm=True,
+                                     **tree, **common)
+        print(json.dumps(cells["tree_shm"]), flush=True)
+        cells["tree_pipe"] = run_load(mode="ingest", **tree, **common)
+        print(json.dumps(cells["tree_pipe"]), flush=True)
+        dl = dict(common)
+        dl.update(num_clients=args.downlink_clients,
+                  aggregations=args.downlink_aggregations,
+                  leaf_elems=args.downlink_leaf_elems,
+                  upload_local_scale=(args.upload_local_scale or 1e-6))
+        cells["downlink_delta"] = run_load(mode="ingest",
+                                           sync_delta=True, **tree, **dl)
+        print(json.dumps(cells["downlink_delta"]), flush=True)
+        cells["downlink_dense"] = run_load(mode="ingest", **tree, **dl)
+        print(json.dumps(cells["downlink_dense"]), flush=True)
     else:
         modes = (("async", "sync") if args.mode == "both"
                  else (args.mode,))
@@ -1207,6 +1420,10 @@ def main(argv=None) -> int:
                 kw.update(ingest_workers=args.ingest_workers,
                           ingest_kill_at=args.ingest_kill_at,
                           ingest_secure_quant=args.ingest_secure_quant,
+                          regions=args.regions,
+                          ingest_shm=args.ingest_shm,
+                          sync_delta=args.sync_delta,
+                          upload_local_scale=args.upload_local_scale,
                           metrics_port=args.metrics_port,
                           trace_out=args.trace_out,
                           flight_out=args.flight_out)
@@ -1227,6 +1444,7 @@ def main(argv=None) -> int:
             cells[mode] = run_load(mode=mode, **kw)
             print(json.dumps(cells[mode]), flush=True)
     bench_name = ("ingest_plane" if args.mode == "ingest_bench"
+                  else "region_tier" if args.mode == "region_bench"
                   else "serve_plane" if args.mode == "serve"
                   else "async_control_plane")
     out = {"bench": bench_name, **cells}
@@ -1283,6 +1501,71 @@ def main(argv=None) -> int:
                 "box is N=2."),
         }
         print(json.dumps({"summary": out["summary"]}), flush=True)
+    if args.mode == "region_bench":
+        # yardstick: the best COMMITTED flat single-root cell — the
+        # number the tree must not regress (ISSUE 18 acceptance)
+        committed = None
+        try:
+            with open("bench_matrix/ingest_bench.json") as f:
+                ib = json.load(f)
+            committed = max(
+                ib[f"ingest_w{n}"]["uploads_per_s_sustained"]
+                for n in (1, 2, 4) if f"ingest_w{n}" in ib)
+        except (OSError, KeyError, ValueError):
+            pass
+        ts, tp = cells["tree_shm"], cells["tree_pipe"]
+        dd, dn = cells["downlink_delta"], cells["downlink_dense"]
+
+        def _per_changed_sync(c):
+            cs = c["client_stats"]
+            return (round(cs["sync_body_bytes"] / cs["sync_bodies"], 1)
+                    if cs["sync_bodies"] else None)
+
+        delta_b = _per_changed_sync(dd)
+        dense_b = _per_changed_sync(dn)
+        ratio = (round(dense_b / delta_b, 2)
+                 if delta_b and dense_b else None)
+        tree_sustained = ts["uploads_per_s_sustained"]
+        out["summary"] = {
+            "regions": ts["regions"],
+            "workers_per_region": ts["workers_per_region"],
+            "committed_single_root_uploads_per_s": committed,
+            "tree_uploads_per_s_sustained": tree_sustained,
+            "tree_at_least_committed_single_root": bool(
+                committed and tree_sustained
+                and tree_sustained >= committed),
+            "shm_export_us_mean": ts["shm_export_us_mean"],
+            "pipe_export_us_mean": tp["pipe_export_us_mean"],
+            "shm_fallback_busy": ts["worker_xstats"].get(
+                "shm_fallback_busy", 0),
+            "shm_beats_pipe": bool(
+                ts["shm_export_us_mean"] and tp["pipe_export_us_mean"]
+                and ts["shm_export_us_mean"]
+                < tp["pipe_export_us_mean"]),
+            "sync_body_bytes_per_changed_sync_delta": delta_b,
+            "sync_body_bytes_per_changed_sync_dense": dense_b,
+            "delta_sync_bytes_ratio": ratio,
+            "delta_sync_3x": bool(ratio and ratio >= 3.0),
+            # HONEST fallback accounting: every changed-version reply
+            # the delta cell shipped dense anyway, and every delta the
+            # clients had to reject, are right here — a 3x claim that
+            # hid them behind the mean would be a lie
+            "delta_syncs": dd["client_stats"]["delta_syncs"],
+            "delta_errors": dd["client_stats"]["delta_errors"],
+            "sync_delta_sent": dd["worker_xstats"].get(
+                "sync_delta_sent", 0),
+            "sync_dense_sent": dd["worker_xstats"].get(
+                "sync_dense_sent", 0),
+            "sync_dense_fallback_ring": dd["worker_xstats"].get(
+                "sync_dense_fallback_ring", 0),
+            "lost_with_region": ts["lost_with_region"],
+            "audits_green": all(
+                c["upload_audit"]["received_accounted"]
+                and c["upload_audit"]["accepted_accounted"]
+                and c["frames_reconciled"] for c in cells.values()),
+            "fleet_procs": fleet_procs,
+        }
+        print(json.dumps({"summary": out["summary"]}), flush=True)
     if "async" in cells and "sync" in cells:
         a, s = cells["async"], cells["sync"]
         out["summary"] = {
@@ -1297,6 +1580,9 @@ def main(argv=None) -> int:
         }
         print(json.dumps({"summary": out["summary"]}), flush=True)
     if args.out:
+        d = os.path.dirname(args.out)
+        if d:
+            os.makedirs(d, exist_ok=True)
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1, sort_keys=True)
     ok = all(c["frames_reconciled"] for c in cells.values())

@@ -73,6 +73,17 @@ FRAME_VERSION = 1
 #: codec -> privacy import cycle.
 SECURE_QUANT_KEY = "__nidt_secure_quant__"
 
+#: magic of the DOWNLINK delta-sync frame (ISSUE 18): a changed-version
+#: sync reply shipped as the LOSSLESS byte-delta against the version the
+#: sender last synced (the broadcast ring, mirrored downlink). Distinct
+#: from the uplink FRAME_KEY codec: uplink deltas are float arithmetic
+#: (value-exact up to one f32 rounding); the downlink must reproduce the
+#: broadcast tree BITWISE — the receiver trains on it and the ingest
+#: delta-transport anchors on its flat image — so the delta is raw-byte
+#: XOR against the base, which is exactly invertible for every dtype.
+SYNC_DELTA_KEY = "__nidt_sync_delta__"
+SYNC_DELTA_VERSION = 1
+
 _QUANT_MODES = ("", "int8", "bf16")
 # sparse-record modes: how the receiver learns the support
 _SP_DENSE = 0      # all values shipped
@@ -404,6 +415,105 @@ def decode_update(obj: Any, *, like: PyTree,
                 x = x + ref
         out[name] = x.astype(rec.get("dt", "float32"))
     return _rebuild_like(like, out)
+
+
+# ---------------------------------------------------------------------------
+# downlink delta-sync (ISSUE 18): lossless byte-delta between two
+# versions of the SAME model tree
+# ---------------------------------------------------------------------------
+
+def is_sync_delta_frame(obj: Any) -> bool:
+    return isinstance(obj, dict) and SYNC_DELTA_KEY in obj
+
+
+def _tree_bytes(tree: PyTree) -> bytes:
+    """The tree's raw leaf bytes, concatenated in named-leaf order —
+    the canonical byte image both delta endpoints agree on (they hold
+    structurally identical trees: consecutive versions of one model)."""
+    return b"".join(np.ascontiguousarray(np.asarray(x)).tobytes()
+                    for _, x in _named_leaves(tree))
+
+
+def _byte_shuffle(x: np.ndarray) -> np.ndarray:
+    """Stride-4 byte-plane transpose (the HDF5 'shuffle' filter). The
+    XOR image of two float32 versions has near-zero sign/exponent bytes
+    and noisy low-mantissa bytes ELEMENT-INTERLEAVED; grouping byte
+    plane k of every element into one run hands zlib long zero runs
+    instead of a zero-noise-noise-noise stipple it cannot match. Pure
+    permutation — losslessly inverted by :func:`_byte_unshuffle` — so
+    it is safe (if pointless) on non-4-byte leaves too; the trailing
+    ``len % 4`` bytes pass through untouched."""
+    n4 = (x.size // 4) * 4
+    if n4 == 0:
+        return x
+    return np.concatenate(
+        [x[:n4].reshape(-1, 4).T.ravel(), x[n4:]])
+
+
+def _byte_unshuffle(x: np.ndarray) -> np.ndarray:
+    n4 = (x.size // 4) * 4
+    if n4 == 0:
+        return x
+    return np.concatenate(
+        [x[:n4].reshape(4, -1).T.ravel(), x[n4:]])
+
+
+def encode_sync_delta(new: PyTree, base: PyTree, *, base_version: int,
+                      zlib_level: int = 6) -> dict:
+    """Encode ``new`` as the lossless delta against ``base``.
+
+    The body is ``bytes(new) XOR bytes(base)``, byte-plane shuffled,
+    deflated: consecutive aggregated models are means of overlapping
+    cohorts, so their float bit patterns agree in the sign/exponent/
+    high-mantissa bits and the shuffled XOR image is long zero runs —
+    zlib's favorite input. Exactness is structural (XOR is its own
+    inverse on the byte level and the shuffle is a permutation), never
+    a float-rounding argument, so ``decode_sync_delta(frame, base) ==
+    new`` BITWISE for every leaf dtype (pinned in tests).
+    """
+    nb = _tree_bytes(new)
+    bb = _tree_bytes(base)
+    if len(nb) != len(bb):
+        raise ValueError(
+            "sync delta: base and new trees have different byte sizes "
+            f"({len(bb)} vs {len(nb)}) — not two versions of one model")
+    x = _byte_shuffle(
+        np.frombuffer(nb, np.uint8) ^ np.frombuffer(bb, np.uint8))
+    packed = zlib.compress(x.tobytes(), zlib_level)
+    z = 1 if len(packed) < x.size else 0
+    return {SYNC_DELTA_KEY: SYNC_DELTA_VERSION,
+            "base": int(base_version), "z": z,
+            "body": np.frombuffer(packed, np.uint8) if z else x}
+
+
+def decode_sync_delta(frame: dict, base: PyTree) -> PyTree:
+    """Invert :func:`encode_sync_delta` against the receiver-held base
+    tree (which MUST be the version named by ``frame["base"]`` — the
+    caller checks that against its own sync bookkeeping and treats a
+    mismatch as a protocol error, never a silent wrong model)."""
+    ver = frame[SYNC_DELTA_KEY]
+    if int(ver) != SYNC_DELTA_VERSION:
+        raise ValueError(f"sync delta frame version {ver} != supported "
+                         f"{SYNC_DELTA_VERSION}")
+    raw = np.asarray(frame["body"], np.uint8).tobytes()
+    if int(frame.get("z", 0)):
+        raw = zlib.decompress(raw)
+    bb = _tree_bytes(base)
+    if len(raw) != len(bb):
+        raise ValueError(
+            f"sync delta: body is {len(raw)} bytes but the base tree "
+            f"is {len(bb)} — receiver base differs from the encoder's")
+    nb = (_byte_unshuffle(np.frombuffer(raw, np.uint8))
+          ^ np.frombuffer(bb, np.uint8)).tobytes()
+    out: dict[str, np.ndarray] = {}
+    off = 0
+    for name, leaf in _named_leaves(base):
+        arr = np.asarray(leaf)
+        n = arr.nbytes
+        out[name] = np.frombuffer(
+            nb[off:off + n], arr.dtype).reshape(arr.shape)
+        off += n
+    return _rebuild_like(base, out)
 
 
 def frame_nbytes(frame: dict) -> int:

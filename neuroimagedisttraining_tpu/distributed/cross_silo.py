@@ -23,6 +23,7 @@ collectives; the socket plane then only carries control messages.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Callable
@@ -1365,7 +1366,8 @@ class FedAvgClientProc(ClientManager):
                  train_fn: Callable, world_size: int | None = None,
                  heartbeat_interval: float = 0.0, wire_codec: str = "none",
                  wire_masks=None, wire_topk_ratio: float = 0.25,
-                 fault_schedule=None, seed: int = 0, **kw):
+                 fault_schedule=None, seed: int = 0,
+                 sync_delta: bool = False, **kw):
         super().__init__(rank=rank, world_size=world_size or num_clients + 1,
                          **kw)
         self.num_clients = num_clients
@@ -1380,6 +1382,11 @@ class FedAvgClientProc(ClientManager):
         #: last full model body received, reused when a cached-sync
         #: reply (version unchanged; asyncfl/ingest.py) omits the body
         self._last_sync_params = None
+        #: opt into lossless delta sync bodies (ISSUE 18): changed-
+        #: version replies may then ship the byte delta against the
+        #: version named in ``_last_sync_version`` instead of the tree
+        self.sync_delta = bool(sync_delta)
+        self._last_sync_version = -1
         #: value-fault schedule (None, or a FaultSchedule whose spec may
         #: schedule THIS rank to upload Byzantine values)
         self.fault_schedule = fault_schedule
@@ -1396,6 +1403,14 @@ class FedAvgClientProc(ClientManager):
     def run(self) -> None:
         self.register_message_receive_handlers()
         reg = M.Message(M.MSG_TYPE_C2S_REGISTER, self.rank, 0)
+        # exactly-once dedup (ISSUE 18): this process lifetime IS the
+        # incarnation — a restarted silo gets a fresh one (fresh seq
+        # space), a reconnecting one keeps it, so a post-migration
+        # ingest worker installs the root's accepted-seq floor for this
+        # incarnation before replying
+        reg.add(M.ARG_CLIENT_INCARNATION, os.getpid())
+        if self.sync_delta:
+            reg.add(M.ARG_SYNC_DELTA_OK, True)
         # the server process may still be initializing (model build + jit
         # compile) when this silo is ready — give the FIRST contact a
         # generous retry window on transports that support it (capped
@@ -1430,7 +1445,13 @@ class FedAvgClientProc(ClientManager):
         from its previous sync. A body-less sync before any full sync
         is a protocol error (the ingest worker always ships the full
         model on register and on every version change); returns None
-        for that dropped-sync case."""
+        for that dropped-sync case.
+
+        Delta bodies (ISSUE 18, ``sync_delta`` opted in): a changed-
+        version reply may carry the lossless byte delta against the
+        version this silo last synced; it decodes against the held
+        base bitwise. A delta naming any OTHER base is a protocol
+        error handled LOUDLY (drop, never apply to a wrong base)."""
         params = msg.get(M.ARG_MODEL_PARAMS)
         if params is None:
             if self._last_sync_params is None:
@@ -1439,7 +1460,20 @@ class FedAvgClientProc(ClientManager):
                           round_idx)
                 return None
             return self._last_sync_params
+        if codec.is_sync_delta_frame(params):
+            base_v = int(params.get("base", -1))
+            if (self._last_sync_params is None
+                    or base_v != self._last_sync_version):
+                log.error(
+                    "silo %d: sync delta at version %d names base %d "
+                    "but this silo holds %d - dropping the sync",
+                    self.rank, round_idx, base_v,
+                    self._last_sync_version)
+                return None
+            params = codec.decode_sync_delta(params,
+                                             self._last_sync_params)
         self._last_sync_params = params
+        self._last_sync_version = int(round_idx)
         return params
 
     def _on_sync(self, msg: M.Message) -> None:

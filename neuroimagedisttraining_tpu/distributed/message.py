@@ -87,6 +87,18 @@ ARG_TRACE_CTX = "trace_ctx"
 #: port) never sets it, so its dying connections are never chosen as a
 #: reply route.
 ARG_CONN_PERSISTENT = "persistent_conn"
+#: sender-lifetime nonce (ISSUE 18): distinguishes a RECONNECT (same
+#: incarnation — the sender's monotone ``ARG_UPLOAD_SEQ`` continues, so
+#: the root-held watermark must survive a worker/region hop) from a
+#: RESTART (new incarnation — a fresh seq 0 is legitimate). Senders
+#: without it keep the documented per-worker reset-on-re-register
+#: semantics unchanged.
+ARG_CLIENT_INCARNATION = "client_incarnation"
+#: sender capability (ISSUE 18): "my sync replies may ship the lossless
+#: delta against my last-synced version instead of the dense body".
+#: Never assumed — a server only sends a delta frame to a sender that
+#: advertised this at registration.
+ARG_SYNC_DELTA_OK = "sync_delta_ok"
 
 _MAGIC = b"NIDT1"
 

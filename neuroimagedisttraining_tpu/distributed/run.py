@@ -424,6 +424,28 @@ def main(argv=None) -> int:
                          "worker-id order, bitwise-equal to the "
                          "single-process fold. 0 = the single-process "
                          "BufferedFedAvgServer")
+    ap.add_argument("--regions", type=int, default=0,
+                    help="async server: interpose N regional "
+                         "sub-aggregator PROCESSES between the ingest "
+                         "workers and the root (asyncfl/region.py) — "
+                         "each region owns --ingest_workers workers on "
+                         "the shared SO_REUSEPORT port, folds their "
+                         "partials locally and ships ONE merged partial "
+                         "upstream per flush interval; the root merges "
+                         "region partials in region-id order, "
+                         "bitwise-equal to the flat fold. 0 = flat root")
+    ap.add_argument("--ingest_shm", action="store_true",
+                    help="ingest workers hand partials to their parent "
+                         "over double-buffered shared-memory slabs "
+                         "instead of the pickled pipe (same-host "
+                         "fast path; the pipe remains the cross-host "
+                         "fallback)")
+    ap.add_argument("--sync_delta", action="store_true",
+                    help="changed-version sync replies to opted-in "
+                         "clients ship the lossless byte delta against "
+                         "the client's last-synced version from the "
+                         "broadcast ring (dense fallback when the base "
+                         "left the ring)")
     ap.add_argument("--max_staleness", type=int, default=20,
                     help="async server: uploads staler than this many "
                          "versions are dropped at admission (with a "
@@ -794,6 +816,17 @@ def main(argv=None) -> int:
                      "(matrix precedent: the buffered secure path). "
                      "Use the single-process plane (--ingest_workers 0) "
                      "or client-side clipping")
+    if args.regions:
+        if args.regions < 0:
+            ap.error("--regions must be >= 0")
+        if not args.ingest_workers:
+            ap.error("--regions interposes regional sub-aggregators in "
+                     "the SHARDED ingest plane — pass --ingest_workers "
+                     "N (workers per region) too")
+    if (args.ingest_shm or args.sync_delta) and not args.ingest_workers:
+        ap.error("--ingest_shm/--sync_delta are sharded-ingest-plane "
+                 "transports (asyncfl/ingest.py) — add "
+                 "--ingest_workers N")
     if args.round_deadline > 0 and args.quorum == 0:
         args.quorum = args.num_clients // 2 + 1  # simple majority
     if args.heartbeat_timeout > 0 and not (
@@ -895,26 +928,46 @@ def main(argv=None) -> int:
                       "stddev": args.stddev, "defense_seed": args.seed,
                       "dp_delta": args.dp_delta}
             if args.ingest_workers:
-                from neuroimagedisttraining_tpu.asyncfl.ingest import (
-                    ShardedIngestServer,
-                )
-
-                server = ShardedIngestServer(
-                    init, args.comm_round, args.num_clients,
-                    ingest_workers=args.ingest_workers,
+                ikw = dict(
                     buffer_k=args.buffer_k,
                     staleness_alpha=args.staleness_alpha,
                     max_staleness=args.max_staleness,
                     base_port=args.base_port, host_map=host_map,
                     heartbeat_timeout=args.heartbeat_timeout,
                     trace_out=args.trace_out,
-                    flight_out=args.flight_out, **kw)
+                    flight_out=args.flight_out,
+                    use_shm=args.ingest_shm,
+                    sync_delta=args.sync_delta, **kw)
+                if args.regions:
+                    from neuroimagedisttraining_tpu.asyncfl.region import (
+                        HierarchicalIngestServer,
+                    )
+
+                    server = HierarchicalIngestServer(
+                        init, args.comm_round, args.num_clients,
+                        regions=args.regions,
+                        workers_per_region=args.ingest_workers, **ikw)
+                    topo = (f"{args.regions} regions x "
+                            f"{args.ingest_workers} workers "
+                            f"(hierarchical tier)")
+                else:
+                    from neuroimagedisttraining_tpu.asyncfl.ingest import (
+                        ShardedIngestServer,
+                    )
+
+                    server = ShardedIngestServer(
+                        init, args.comm_round, args.num_clients,
+                        ingest_workers=args.ingest_workers, **ikw)
+                    topo = (f"{args.ingest_workers} selector workers")
                 print(f"[server] sharded ingest plane on port "
-                      f"{args.base_port}: {args.ingest_workers} "
-                      f"selector workers (SO_REUSEPORT), buffer_k="
-                      f"{server.buffer_k}, staleness_alpha="
+                      f"{args.base_port}: {topo} (SO_REUSEPORT), "
+                      f"buffer_k={server.buffer_k}, staleness_alpha="
                       f"{args.staleness_alpha}, max_staleness="
-                      f"{args.max_staleness}", flush=True)
+                      f"{args.max_staleness}"
+                      + (", shm partial hand-off" if args.ingest_shm
+                         else "")
+                      + (", delta sync" if args.sync_delta else ""),
+                      flush=True)
             else:
                 server = BufferedFedAvgServer(
                     init, args.comm_round, args.num_clients,
@@ -1068,6 +1121,10 @@ def main(argv=None) -> int:
                          for t in h.get("taus", ())})}
             if args.ingest_workers:
                 extra["ingest_workers"] = args.ingest_workers
+                if args.regions:
+                    extra["regions"] = args.regions
+                if args.ingest_shm or args.sync_delta:
+                    extra["worker_xstats"] = server.worker_xstats()
                 # workers own the client sockets: the wire accounting
                 # lives with them, not the root's placeholder comm
                 stats = server.worker_byte_stats()
@@ -1133,7 +1190,8 @@ def main(argv=None) -> int:
     else:
         kw = {"wire_codec": args.wire_codec,
               "wire_masks": wire_masks,
-              "wire_topk_ratio": args.wire_topk_ratio}
+              "wire_topk_ratio": args.wire_topk_ratio,
+              "sync_delta": args.sync_delta}
     if not args.secure and fault_spec is not None \
             and fault_spec.any_value_faults:
         # value faults live in the CLIENT, not the transport wrapper:

@@ -224,14 +224,16 @@ class WorkerObsShipper:
 
 
 class _WorkerTelemetry:
-    """Per-worker accumulation at the root."""
+    """Per-worker accumulation at the root. ``wid`` is the fan-in KEY:
+    a tuple with one element per label tier — ``(3,)`` on a flat root,
+    ``(region, worker)`` under the hierarchical tier (ISSUE 18)."""
 
     __slots__ = ("wid", "alive", "pid", "offset_ns", "offset_err_ns",
                  "epoch_ns", "snapshot", "snap_mono", "snap_wall",
                  "spans", "spans_dropped", "flight", "flight_evicted")
 
-    def __init__(self, wid: int):
-        self.wid = int(wid)
+    def __init__(self, wid: tuple):
+        self.wid = tuple(wid)
         self.alive = True
         self.pid: int | None = None
         self.offset_ns = 0
@@ -267,40 +269,70 @@ class TelemetryFanIn:
     def __init__(self,
                  registry: obs_metrics.MetricsRegistry | None = None,
                  tracer: obs_trace.SpanTracer | None = None,
-                 flight: obs_flight.FlightRecorder | None = None):
+                 flight: obs_flight.FlightRecorder | None = None,
+                 labelnames: tuple[str, ...] = ("worker",)):
         self._lock = threading.Lock()
-        self._workers: dict[int, _WorkerTelemetry] = {}
+        self._workers: dict[tuple, _WorkerTelemetry] = {}
+        #: one label per key tier (ISSUE 18): ``("worker",)`` on a flat
+        #: root, ``("region", "worker")`` under the hierarchical tier —
+        #: keys are same-length tuples, ints accepted as 1-tuples
+        self.labelnames = tuple(labelnames)
         self.registry = (registry if registry is not None
                          else obs_metrics.REGISTRY)
         self.tracer = tracer if tracer is not None else obs_trace.TRACER
         self.flight = (flight if flight is not None
                        else obs_flight.FLIGHT)
 
+    # ---- key helpers ----
+
+    def _key(self, wid) -> tuple:
+        if isinstance(wid, tuple):
+            return tuple(int(x) for x in wid)
+        return (int(wid),)
+
+    def _labels(self, key: tuple) -> dict[str, str]:
+        return {n: str(v) for n, v in zip(self.labelnames, key)}
+
+    def _name(self, key: tuple) -> str:
+        return (str(key[0]) if len(key) == 1
+                else "/".join(str(v) for v in key))
+
     # ---- worker lifecycle / ingestion ----
 
-    def register_worker(self, wid: int) -> None:
+    def register_worker(self, wid) -> None:
+        key = self._key(wid)
         with self._lock:
-            self._workers.setdefault(int(wid), _WorkerTelemetry(wid))
+            self._workers.setdefault(key, _WorkerTelemetry(key))
 
-    def note_clock(self, wid: int, t0_ns: int, t_worker_ns: int,
+    def note_clock(self, wid, t0_ns: int, t_worker_ns: int,
                    t1_ns: int) -> None:
         off, err = estimate_clock_offset(t0_ns, t_worker_ns, t1_ns)
+        key = self._key(wid)
         with self._lock:
-            w = self._workers.setdefault(int(wid), _WorkerTelemetry(wid))
+            w = self._workers.setdefault(key, _WorkerTelemetry(key))
             w.offset_ns, w.offset_err_ns = off, err
 
-    def mark_dead(self, wid: int) -> None:
+    def mark_dead(self, wid) -> None:
         """A dead worker's LAST snapshot stays visible — the staleness
-        gauge, not deletion, is how its death reads on a scrape."""
+        gauge, not deletion, is how its death reads on a scrape. A key
+        PREFIX shorter than the label tiers marks the whole subtree
+        (a dead REGION marks every ``(region, *)`` worker)."""
+        key = self._key(wid)
         with self._lock:
-            w = self._workers.get(int(wid))
+            if len(key) < len(self.labelnames):
+                for k, w in self._workers.items():
+                    if k[:len(key)] == key:
+                        w.alive = False
+                return
+            w = self._workers.get(key)
             if w is not None:
                 w.alive = False
 
-    def ingest(self, wid: int, payload: dict) -> None:
+    def ingest(self, wid, payload: dict) -> None:
         """One ``("obs", wid, payload)`` pipe message."""
+        key = self._key(wid)
         with self._lock:
-            w = self._workers.setdefault(int(wid), _WorkerTelemetry(wid))
+            w = self._workers.setdefault(key, _WorkerTelemetry(key))
             snap = payload.get("metrics")
             if snap is not None:
                 w.snapshot = snap
@@ -326,7 +358,7 @@ class TelemetryFanIn:
         """Machine-readable fan-in state (loadgen result / tests)."""
         with self._lock:
             now = time.monotonic()
-            return {str(w.wid): {
+            return {self._name(w.wid): {
                 "alive": w.alive,
                 "has_metrics": w.snapshot is not None,
                 "snapshot_age_s": (round(now - w.snap_mono, 3)
@@ -365,7 +397,7 @@ class TelemetryFanIn:
         with self._lock:
             for w in self._workers.values():
                 if w.snapshot is not None:
-                    _fold(w.snapshot, {"worker": str(w.wid)})
+                    _fold(w.snapshot, self._labels(w.wid))
         return merged
 
     # ---- merged Prometheus exposition ----
@@ -397,15 +429,15 @@ class TelemetryFanIn:
             workers = list(self._workers.values())
             for w in workers:
                 if w.snapshot is not None:
-                    _fold(w.snapshot, {"worker": str(w.wid)})
+                    _fold(w.snapshot, self._labels(w.wid))
             # synthesized staleness plane: how old each worker's last
             # snapshot is (a SIGKILLed worker's age grows forever) and
             # whether the root still believes the process alive
             now = time.monotonic()
-            age_rows = [({"worker": str(w.wid)},
+            age_rows = [(self._labels(w.wid),
                          round(now - w.snap_mono, 3))
                         for w in workers if w.snap_mono is not None]
-            alive_rows = [({"worker": str(w.wid)}, 1.0 if w.alive
+            alive_rows = [(self._labels(w.wid), 1.0 if w.alive
                            else 0.0) for w in workers]
         if age_rows:
             merged["nidt_obs_worker_snapshot_age_s"] = {
@@ -458,10 +490,15 @@ class TelemetryFanIn:
                     if pid is None:
                         pid = e.get("pid")
                 if pid is not None:
+                    if len(w.wid) == 1:
+                        pname = f"ingest-worker-{w.wid[0]}"
+                    else:
+                        pname = "ingest-" + "-".join(
+                            f"{n}{v}" for n, v in
+                            zip(self.labelnames, w.wid))
                     meta.append({"name": "process_name", "ph": "M",
                                  "pid": pid, "tid": 0,
-                                 "args": {"name":
-                                          f"ingest-worker-{w.wid}"}})
+                                 "args": {"name": pname}})
         return meta + events
 
     def merged_trace_doc(self) -> dict:
@@ -501,11 +538,18 @@ class TelemetryFanIn:
         events = [{**e, "proc": "root"} for e in self.flight.events()]
         with self._lock:
             for w in self._workers.values():
-                events.extend({**e, "proc": f"worker{w.wid}",
-                               "worker": w.wid} for e in w.flight)
-            workers = {str(w.wid): {"alive": w.alive,
-                                    "events": len(w.flight),
-                                    "evicted": w.flight_evicted}
+                if len(w.wid) == 1:
+                    tag, prov = f"worker{w.wid[0]}", {"worker": w.wid[0]}
+                else:
+                    tag = "-".join(f"{n}{v}" for n, v in
+                                   zip(self.labelnames, w.wid))
+                    prov = {n: v for n, v in
+                            zip(self.labelnames, w.wid)}
+                events.extend({**e, "proc": tag, **prov}
+                              for e in w.flight)
+            workers = {self._name(w.wid): {"alive": w.alive,
+                                           "events": len(w.flight),
+                                           "evicted": w.flight_evicted}
                        for w in self._workers.values()}
             evicted = sum(w.flight_evicted
                           for w in self._workers.values())

@@ -53,6 +53,10 @@ INGEST_WORKERS_LIVE = "nidt_ingest_workers_live"
 INGEST_PARTIALS = "nidt_ingest_partials_total"
 INGEST_WORKER_UPLOADS = "nidt_ingest_worker_uploads_total"
 
+# -- hierarchical aggregation tier (asyncfl/region.py, ISSUE 18) --
+REGION_STALENESS = "nidt_region_staleness"
+REGION_PARTIAL_AGE = "nidt_region_partial_age_s"
+
 # -- telemetry fan-in (obs/fanin.py) --
 UPLOAD_STAGE_MS = "nidt_upload_stage_ms"
 CLIENT_RTT_MS = "nidt_client_rtt_ms"

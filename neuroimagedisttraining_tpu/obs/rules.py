@@ -449,6 +449,17 @@ def builtin_rules(dp_epsilon_budget: float = 0.0, comm_round: int = 200,
                 "p99 accepted-upload staleness near the admission "
                 "bound: the buffered server is aggregating history")),
         HealthRule(
+            name="region-staleness-runaway", metric=N.REGION_STALENESS,
+            op=">", threshold=max(1.0, 0.8 * float(max_staleness)),
+            for_rounds=2, severity="warn",
+            description=(
+                "a regional sub-aggregator's batch staleness near the "
+                "admission bound for 2 boundaries: that region is "
+                "shipping history — its workers are wedged, its uplink "
+                "is backed up, or its client population stalled "
+                "(ISSUE 18; any-region-over semantics via the max "
+                "cell aggregation)")),
+        HealthRule(
             name="quarantine-burst", metric=N.BYZ_QUARANTINES,
             op=">=", threshold=2, window="delta", n=5, severity="warn",
             description=(

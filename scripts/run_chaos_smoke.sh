@@ -529,6 +529,119 @@ print(f"OK(serve/obs): MERGED /metrics scraped mid-chaos "
 EOF
 }
 
+run_region() {
+    # hierarchical aggregation tier (ISSUE 18, asyncfl/region.py):
+    # SIGKILL an entire REGION process (its worker fleet dies with it)
+    # mid-load — clients reconnect onto the surviving region's
+    # SO_REUSEPORT listeners, the corpse's unshipped partial is
+    # accounted lost_with_region (never silently vanished), and a
+    # MID-chaos scrape of the MERGED /metrics must read the death:
+    # region 0's fan-in rows stale (nidt_obs_worker_alive 0) while
+    # region 1 stays live, with the per-region staleness gauges
+    # present.
+    local mport
+    mport=$($PY -c "from neuroimagedisttraining_tpu.distributed.ports \
+import free_port_block; print(free_port_block(2))")
+    local scrape_out="/tmp/chaos_smoke_region_metrics.txt"
+    rm -f "$scrape_out"
+    echo "== chaos smoke (region-kill cell): SIGKILL region 0 of a" \
+         "2x2 tree at version 4, MERGED /metrics on $mport =="
+    # mid-chaos scraper: succeeds only on an exposition that shows
+    # region 0 DEAD and region 1 ALIVE at the same instant — by
+    # construction a mid-chaos capture (the server is still serving)
+    $PY - "$mport" "$scrape_out" <<'PYEOF' &
+import re, sys, time, urllib.request
+port, out = int(sys.argv[1]), sys.argv[2]
+dead = re.compile(r'nidt_obs_worker_alive\{[^}]*region="0"[^}]*\} 0(\.0)?$',
+                  re.M)
+live = re.compile(r'nidt_obs_worker_alive\{[^}]*region="1"[^}]*\} 1(\.0)?$',
+                  re.M)
+deadline = time.time() + 240
+while time.time() < deadline:
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2).read().decode()
+        if (dead.search(body) and live.search(body)
+                and "nidt_region_staleness" in body
+                and "nidt_region_partial_age_s" in body):
+            open(out, "w").write(body)
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.1)
+sys.exit(1)
+PYEOF
+    local scraper_pid=$!
+    # a real file, not a '$PY -' heredoc: the region tier spawns its
+    # children with the 'spawn' context, which re-imports the parent's
+    # main module — '<stdin>' has no path to re-import
+    local killpy="/tmp/chaos_smoke_region_kill.py"
+    cat > "$killpy" <<'EOF'
+import sys
+
+from neuroimagedisttraining_tpu.asyncfl.loadgen import run_load
+
+# the __main__ guard matters: the spawn context re-imports this file in
+# every region/worker child
+if __name__ == "__main__":
+    res = run_load(mode="ingest", num_clients=60, aggregations=24,
+                   buffer_k=20, regions=2, ingest_workers=2,
+                   ingest_kill_at=4, leaf_elems=64, ingest_shm=True,
+                   metrics_port=int(sys.argv[1]))
+    audit = res["upload_audit"]
+    assert audit["received_accounted"], audit
+    assert audit["accepted_accounted"], audit
+    assert res["frames_reconciled"], res
+    assert res["rounds_or_aggregations"] == 24, res
+    assert res["regions"] == 2, res
+    # region 0 died mid-run (region 1 reads not-alive too by now —
+    # that is the CLEAN end-of-run teardown, which the mid-chaos
+    # /metrics scrape disambiguates)
+    assert not audit["regions"][0]["alive"], audit
+    r0, r1 = audit["regions"][0], audit["regions"][1]
+    # the corpse's acceptances are all accounted: folded or counted
+    # lost_with_region — the invariant, not a specific loss count
+    assert r0["acc"] == r0["folded"], audit
+    # the fleet was absorbed: region 1 kept folding partials after the
+    # kill and region 0's clients re-registered onto its listeners
+    assert r1["partials"] > r0["partials"], audit
+    assert res["client_stats"]["rejoins"] > 0, res["client_stats"]
+    print(f"OK(region/kill-region): 24 aggregations, region 0 "
+          f"SIGKILLed, {res['lost_with_region']} buffered uploads "
+          f"accounted lost_with_region, "
+          f"{res['client_stats']['rejoins']} client rejoins onto the "
+          "survivor, audits green")
+EOF
+    # PYTHONPATH: running a file from /tmp drops the repo cwd from
+    # sys.path; region/worker children inherit it
+    if ! PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" $PY "$killpy" \
+            "$mport"; then
+        kill "$scraper_pid" 2>/dev/null
+        echo "FAIL(region): kill-one-region cell"
+        return 1
+    fi
+    if ! wait "$scraper_pid"; then
+        echo "FAIL(region/obs): mid-chaos MERGED /metrics scrape never "\
+"read region 0 dead + region 1 alive with the staleness gauges"
+        return 1
+    fi
+    $PY - "$scrape_out" <<'EOF'
+import re, sys
+sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+                    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+scrape = open(sys.argv[1]).read()
+for line in scrape.strip().splitlines():
+    assert line.startswith("#") or sample.match(line), line
+regions = sorted(set(re.findall(r'region="(\d+)"', scrape)))
+assert regions == ["0", "1"], regions
+assert "nidt_region_staleness" in scrape
+assert "nidt_region_partial_age_s" in scrape
+print(f"OK(region/obs): MERGED /metrics scraped mid-chaos "
+      f"({len(scrape.splitlines())} lines, regions {regions}, "
+      "region 0 read dead while region 1 served)")
+EOF
+}
+
 rc=0
 run_one socket crash || rc=1
 run_one broker crash || rc=1
@@ -537,5 +650,6 @@ run_one broker byz   || rc=1
 run_async            || rc=1
 run_secure_quant     || rc=1
 run_ingest           || rc=1
+run_region           || rc=1
 run_serve            || rc=1
 exit $rc

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Hierarchical aggregation tier bench (ISSUE 18, asyncfl/region.py):
+# a 2-region x 2-worker-per-region tree under the committed
+# ingest_bench load (1k open-loop clients, the SAME cohort / buffer /
+# canned-update configuration as bench_matrix/ingest_bench.json), plus
+# the downlink delta-sync A/B.
+#
+# Four cells:
+#   tree_shm       2x2 tree, shared-memory partial hand-off (headline)
+#   tree_pipe      same tree, pickled-pipe hand-off (transport A/B)
+#   downlink_delta small-local-update fleet, delta-sync replies ON
+#   downlink_dense same fleet, dense replies (downlink-bytes A/B)
+#
+# Acceptance (judged by the bench itself into summary.* booleans, then
+# re-judged by the gate): the tree sustains >= the committed
+# single-root best (ingest_bench ingest_w*); shm beats pipe on mean
+# per-export latency; delta replies carry >=3x fewer bytes per
+# changed-version sync than dense with ZERO base-mismatch errors; every
+# cell's received/accepted accounting audits exactly through the tier.
+#
+# The downlink cells run the small-local-update regime
+# (--upload_local_scale, clients upload synced_params + eps*canned):
+# the throughput cells' replacement aggregation makes consecutive
+# versions statistically independent — incompressible by construction —
+# while real FL rounds move the model a small step, which is the regime
+# delta-sync exists for.
+#
+# Writes bench_matrix/region_bench.json (committed artifact), then
+# gates it against the committed copy.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+PY=${PYTHON:-python}
+OUT=${1:-bench_matrix/region_bench.json}
+
+$PY -m neuroimagedisttraining_tpu.asyncfl.loadgen \
+    --mode region_bench \
+    --clients "${BENCH_CLIENTS:-1000}" \
+    --aggregations "${BENCH_AGGREGATIONS:-300}" \
+    --buffer_k "${BENCH_BUFFER_K:-50}" \
+    --leaf_elems "${BENCH_LEAF_ELEMS:-256}" \
+    --regions "${BENCH_REGIONS:-2}" \
+    --ingest_workers "${BENCH_WORKERS_PER_REGION:-2}" \
+    --downlink_clients "${BENCH_DOWNLINK_CLIENTS:-600}" \
+    --downlink_aggregations "${BENCH_DOWNLINK_AGGREGATIONS:-80}" \
+    --downlink_leaf_elems "${BENCH_DOWNLINK_LEAF_ELEMS:-4096}" \
+    --out "$OUT"
+
+$PY - "$OUT" <<'EOF'
+import json, sys
+res = json.load(open(sys.argv[1]))
+s = res["summary"]
+assert s["audits_green"], "region bench: an accounting audit came back red"
+print(f"tree ({s['regions']}x{s['workers_per_region']}): "
+      f"{s['tree_uploads_per_s_sustained']} uploads/s sustained "
+      f"(committed single-root best: {s['committed_single_root_uploads_per_s']})")
+print(f"  shm export: {s['shm_export_us_mean']}us mean  "
+      f"pipe export: {s['pipe_export_us_mean']}us mean  "
+      f"(shm fallback-to-pipe: {s['shm_fallback_busy']})")
+print(f"downlink: {s['sync_body_bytes_per_changed_sync_delta']} B/sync delta vs "
+      f"{s['sync_body_bytes_per_changed_sync_dense']} B/sync dense "
+      f"({s['delta_sync_bytes_ratio']}x; {s['delta_syncs']} deltas decoded, "
+      f"{s['delta_errors']} errors, {s['sync_dense_fallback_ring']} ring fallbacks)")
+bad = [k for k in ("tree_at_least_committed_single_root", "shm_beats_pipe",
+                   "delta_sync_3x") if not s[k]]
+if bad or s["delta_errors"]:
+    print(f"WARNING: region bench acceptance red: {bad or ''} "
+          f"delta_errors={s['delta_errors']}")
+    sys.exit(1)
+print("OK: tree >= committed single-root, shm beats pipe, delta-sync >= 3x, "
+      "audits green")
+EOF
+
+$PY -m neuroimagedisttraining_tpu.analysis.bench_gate \
+    --fresh "$(dirname "$OUT")" --artifact region_bench.json --quiet

@@ -448,6 +448,73 @@ def test_parse_error_is_a_finding():
     assert rules_of(fs) == ["parse-error"]
 
 
+# ---------------- shm-discipline (ISSUE 18) ----------------
+
+def test_shm_owner_must_close_and_unlink():
+    """A creator class missing EITHER teardown call is flagged at the
+    creation site — one finding per missing call."""
+    src = """
+        from multiprocessing import shared_memory
+
+        class LeakyWriter:
+            def __init__(self, size):
+                self.shm = shared_memory.SharedMemory(create=True,
+                                                      size=size)
+
+            def destroy(self):
+                self.shm.close()  # close but never unlink: name leaks
+        """
+    assert rules_of(lint(src)) == ["shm-owner-teardown"]
+    src_neither = """
+        from multiprocessing import shared_memory
+
+        class VeryLeakyWriter:
+            def __init__(self, size):
+                self.shm = shared_memory.SharedMemory(create=True,
+                                                      size=size)
+        """
+    assert rules_of(lint(src_neither)) == ["shm-owner-teardown"] * 2
+
+
+def test_shm_attacher_must_never_unlink():
+    src = """
+        from multiprocessing import shared_memory
+
+        class GreedyReader:
+            def __init__(self, name):
+                self.shm = shared_memory.SharedMemory(name=name)
+
+            def close(self):
+                self.shm.close()
+                self.shm.unlink()  # destroying a name it does not own
+        """
+    assert rules_of(lint(src)) == ["shm-attach-unlink"]
+
+
+def test_shm_discipline_clean_lifecycles_and_aliases():
+    """The correct asymmetric lifecycle is clean on both sides, and the
+    rule resolves the import alias + positional create=True spelling."""
+    src = """
+        import multiprocessing.shared_memory as sm
+
+        class Writer:
+            def __init__(self, size):
+                self.shm = sm.SharedMemory(None, True, size)
+
+            def destroy(self):
+                self.shm.close()
+                self.shm.unlink()
+
+        class Reader:
+            def __init__(self, name):
+                self.shm = sm.SharedMemory(name=name)
+
+            def close(self):
+                self.shm.close()
+        """
+    assert lint(src) == []
+
+
 # ---------------- CLI + tier-1 gate ----------------
 
 def test_cli_exits_nonzero_on_seeded_violations(tmp_path, capsys):

@@ -1,0 +1,379 @@
+"""Hierarchical aggregation tier tests (ISSUE 18, asyncfl/region.py,
+the shm partial hand-off, and the downlink delta-sync).
+
+Contracts:
+
+(a) THE tree invariant: any (region x worker) partitioning of the same
+    uploads — workers fold, each region merges its workers' partials,
+    the root merges the region partials in region-id order — equals one
+    accumulator that folded everything, BITWISE, for the dense int64
+    lattice AND the secure-quant chunk fold. Exact integer algebra is
+    commutative and associative, so the tree's merge ORDER and SHAPE
+    both cancel out.
+(b) The shm slab transport is a bitwise-faithful carrier: a partial
+    written through a real ``multiprocessing.shared_memory`` slab and
+    read back under the seqlock generation check reproduces the flat
+    int64 vector exactly — including the NaN-as-zero and +/-inf
+    saturation edge encodings — and a torn/stale generation raises
+    instead of returning a silently-wrong vector.
+(c) Downlink delta-sync: a changed-version sync reply's delta frame,
+    decoded against the client's last-synced tree, is BITWISE the dense
+    reply; a base that left the broadcast ring falls back to dense with
+    the reason logged and counted, never silently.
+(d) Cross-worker exactly-once (the forced-migration regression): a
+    sender reconnecting onto a DIFFERENT worker with the same
+    incarnation gets the root's seq watermark floor applied before its
+    register is answered, so a re-sent upload the old worker already
+    accepted is a duplicate — while a NEW incarnation legitimately
+    restarts from seq 0.
+(e) Live multi-process tree runs (region children owning SO_REUSEPORT
+    worker fleets): audits green across three processes tiers, both
+    transports, dense and secure_quant.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.asyncfl.ingest import (
+    IngestWorkerCore,
+    PartialAccumulator,
+    SeqWatermarks,
+    _ShmSlabReader,
+    _ShmSlabWriter,
+    make_fold_spec,
+    model_sizes,
+    single_process_fold,
+)
+from neuroimagedisttraining_tpu.asyncfl.loadgen import (
+    canned_update_tree,
+    run_load,
+)
+from neuroimagedisttraining_tpu.codec import wire
+from neuroimagedisttraining_tpu.distributed import message as M
+from neuroimagedisttraining_tpu.privacy import (
+    QuantSpec,
+    encode_secure_quant,
+)
+
+LIKE = canned_update_tree(0, 64)
+
+
+def _dense_entries(n, leaf_elems=64):
+    return [(canned_update_tree(r, leaf_elems), 100 + 7 * r)
+            for r in range(1, n + 1)]
+
+
+def _secure_entries(n, spec, leaf_elems=64):
+    return [(encode_secure_quant(canned_update_tree(r, leaf_elems), 1.0,
+                                 spec, np.random.default_rng(r)),
+             200 + 11 * r)
+            for r in range(1, n + 1)]
+
+
+def _merge_tree(entries, spec, topology):
+    """Fold ``entries`` through a (region x worker) tree: ``topology``
+    is a list of regions, each a list of per-worker entry counts. Each
+    worker folds its slice into its own accumulator; each region merges
+    its workers' exported partials; the root merges the region partials
+    in region-id order — exactly the live tier's merge shape."""
+    root = PartialAccumulator(spec, model_sizes(LIKE))
+    i = 0
+    for region_workers in topology:
+        region = PartialAccumulator(spec, model_sizes(LIKE))
+        for n in region_workers:
+            worker = PartialAccumulator(spec, model_sizes(LIKE))
+            for payload, w in entries[i:i + n]:
+                if spec.quant is not None:
+                    worker.fold_frame(payload, w)
+                else:
+                    worker.fold_dense(payload, w)
+            i += n
+            p = worker.export()
+            if p is not None:
+                region.merge_payload(p)
+        p = region.export()
+        if p is not None:
+            root.merge_payload(p)
+    assert i == len(entries), "topology must cover every entry"
+    return root
+
+
+# three-plus (region x worker) partitionings of the same 12 uploads:
+# one fat region, two symmetric shapes, a ragged tree, a deep one
+TOPOLOGIES = [
+    [[12]],                      # 1 region x 1 worker (degenerate)
+    [[6], [6]],                  # 2 regions x 1 worker
+    [[3, 3], [3, 3]],            # 2 regions x 2 workers (the bench)
+    [[4, 2], [1, 5]],            # ragged loads
+    [[2, 2], [2, 2], [2, 2]],    # 3 regions x 2 workers
+]
+
+
+# ---------------------------------------------------------------------------
+# (a) tree merge == single-process fold, bitwise, any partitioning
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("topology", TOPOLOGIES)
+def test_dense_tree_merge_partition_independent_bitwise(topology):
+    spec = make_fold_spec(LIKE)
+    entries = _dense_entries(12)
+    ref = single_process_fold(entries, spec, LIKE)
+    merged = _merge_tree(entries, spec, topology)
+    assert merged.w_int_total == ref.w_int_total
+    assert merged.count == ref.count
+    for name, _ in model_sizes(LIKE):
+        np.testing.assert_array_equal(merged.totals[name],
+                                      ref.totals[name])
+
+
+@pytest.mark.parametrize("topology", TOPOLOGIES)
+def test_secure_tree_merge_partition_independent_bitwise(topology):
+    quant = QuantSpec.from_bits(32, 10, 3)
+    spec = make_fold_spec(LIKE, quant=quant)
+    entries = _secure_entries(12, quant)
+    ref = single_process_fold(entries, spec, LIKE)
+    refp = ref.export()
+    merged = _merge_tree(entries, spec, topology)
+    assert merged.w_int_total == refp["w_int"]
+    for name, _ in model_sizes(LIKE):
+        np.testing.assert_array_equal(merged.totals[name],
+                                      refp["slots"][name])
+
+
+# ---------------------------------------------------------------------------
+# (b) the shm slab is a bitwise-faithful, torn-read-detecting carrier
+# ---------------------------------------------------------------------------
+
+
+def test_shm_slab_roundtrip_bitwise_with_edge_encodings():
+    """NaN/saturation edges cross the slab unchanged: the writer's flat
+    int64 vector — including NaN-as-zero and the +/-inf sign-preserving
+    clamp encodings — reads back bitwise under the generation check."""
+    spec = make_fold_spec(LIKE)
+    bad = canned_update_tree(1, 64)
+    k = bad["params"]["dense"]["kernel"]
+    k[0], k[1], k[2] = np.nan, np.inf, -np.inf
+    acc = PartialAccumulator(spec, model_sizes(LIKE))
+    acc.fold_dense(bad, 3)
+    payload = acc.export()
+    segs = [payload["slots"][name] for name, _ in model_sizes(LIKE)]
+    total = sum(s.size for s in segs)
+
+    writer = _ShmSlabWriter(total)
+    reader = _ShmSlabReader(writer.name, total)
+    try:
+        gen = writer.write(segs, payload["w_int"], payload["count"])
+        flat, w_int, count = reader.read(gen)
+        np.testing.assert_array_equal(flat, np.concatenate(segs))
+        assert w_int == payload["w_int"]
+        assert count == payload["count"]
+        # the edge encodings specifically: NaN folded as zero, inf
+        # saturated at +/- w * q_max — visible IN the slab copy
+        kernel = flat[:segs[0].size] if model_sizes(LIKE)[0][0] == \
+            "params/dense/kernel" else None
+        t = acc.totals["params/dense/kernel"]
+        assert t[0] == 0
+        assert t[1] == 3 * spec.q_max and t[2] == -3 * spec.q_max
+        if kernel is not None:
+            np.testing.assert_array_equal(kernel, t)
+        # a second write without an ack bumps the generation: reading
+        # at the OLD generation is a loudly-detected stale read
+        writer.write(segs, 1, 1)
+        with pytest.raises(RuntimeError, match="torn read"):
+            reader.read(gen)
+    finally:
+        reader.close()
+        writer.destroy()
+    # owner teardown unlinked the name: a re-attach must fail
+    with pytest.raises(FileNotFoundError):
+        _ShmSlabReader(writer.name, total)
+
+
+# ---------------------------------------------------------------------------
+# (c) downlink delta-sync: bitwise replies, honest fallback
+# ---------------------------------------------------------------------------
+
+
+def _core(wid=0, max_staleness=4):
+    spec = make_fold_spec(LIKE)
+    return IngestWorkerCore(wid, spec, LIKE,
+                            max_staleness=max_staleness,
+                            staleness_alpha=0.5)
+
+
+def _tree_equal(a, b):
+    la, lb = list(wire._named_leaves(a)), list(wire._named_leaves(b))
+    assert [n for n, _ in la] == [n for n, _ in lb]
+    for (_, x), (_, y) in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_byte_shuffle_is_a_permutation():
+    """The stride-4 byte-plane shuffle inverts exactly, tail included
+    (lengths not divisible by 4 carry the remainder through raw)."""
+    rng = np.random.default_rng(7)
+    for n in (0, 1, 3, 4, 5, 8, 257, 4096, 4097):
+        x = rng.integers(0, 256, n, dtype=np.uint8)
+        np.testing.assert_array_equal(
+            wire._byte_unshuffle(wire._byte_shuffle(x)), x)
+
+
+def test_delta_sync_reply_decodes_bitwise_to_dense_reply():
+    core = _core()
+    core.handle_register(1, incarnation=9, delta_ok=True)
+    core.handle_register(2, incarnation=9, delta_ok=False)
+    base = core.params
+    core.last_synced[1] = 0
+    core.last_synced[2] = 0
+    core.set_model(1, canned_update_tree(42, 64))
+
+    dense, kind_dense = core.build_sync_body(2)
+    assert kind_dense == "dense"
+    frame, kind = core.build_sync_body(1)
+    assert kind == "delta"
+    assert wire.is_sync_delta_frame(frame)
+    assert int(frame["base"]) == 0
+    decoded = wire.decode_sync_delta(frame, base)
+    _tree_equal(decoded, dense)
+    assert core.sync_stats["sync_delta_sent"] == 1
+    assert core.sync_stats["sync_dense_sent"] == 1
+    # the frame is cached per (base, version): same object, no
+    # re-encode for the next client syncing the same pair
+    frame2, _ = core.build_sync_body(1)
+    assert frame2 is frame
+
+
+def test_delta_sync_roundtrip_with_nonfinite_leaves():
+    """The XOR/shuffle/deflate pipeline is a BITWISE codec — NaN and
+    +/-inf payload bytes survive it (a float-arithmetic delta could
+    never say this)."""
+    a = canned_update_tree(3, 65)  # odd leaf size: exercises the tail
+    b = canned_update_tree(4, 65)
+    k = a["params"]["dense"]["kernel"]
+    k[0], k[1], k[2] = np.nan, np.inf, -np.inf
+    frame = wire.encode_sync_delta(a, b, base_version=5)
+    out = wire.decode_sync_delta(frame, b)
+    la = list(wire._named_leaves(a))
+    lo = list(wire._named_leaves(out))
+    for (_, x), (_, y) in zip(la, lo):
+        np.testing.assert_array_equal(
+            np.asarray(x).view(np.uint8), np.asarray(y).view(np.uint8))
+
+
+def test_delta_sync_base_off_ring_falls_back_dense_logged(caplog):
+    core = _core(max_staleness=2)
+    core.handle_register(1, incarnation=9, delta_ok=True)
+    core.last_synced[1] = 0
+    # advance far enough that version 0 leaves the broadcast ring
+    for v in (1, 2, 3, 4):
+        core.set_model(v, canned_update_tree(v, 64))
+    assert 0 not in core._ring
+    with caplog.at_level(logging.INFO,
+                         logger="neuroimagedisttraining_tpu.asyncfl"):
+        body, kind = core.build_sync_body(1)
+    assert kind == "dense_fallback_ring"
+    assert body is core.params  # the dense tree, not a frame
+    assert core.sync_stats["sync_dense_fallback_ring"] == 1
+    assert any("left the broadcast ring" in r.message
+               for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# (d) cross-worker exactly-once: watermark floors under forced migration
+# ---------------------------------------------------------------------------
+
+
+def _upload(c, tag, seq, n=8.0):
+    msg = M.Message(M.MSG_TYPE_C2S_SEND_MODEL, c, 0)
+    msg.add(M.ARG_MODEL_PARAMS, canned_update_tree(c, 64))
+    msg.add(M.ARG_NUM_SAMPLES, n)
+    msg.add(M.ARG_ROUND_IDX, tag)
+    msg.add(M.ARG_UPLOAD_SEQ, seq)
+    return msg
+
+
+def test_forced_migration_replay_is_duplicate_not_double_count():
+    """The regression the watermark plane exists for: worker A dies
+    after accepting seqs 0..2 from client 7; the client reconnects onto
+    worker B (same incarnation) and — not having heard A's verdicts —
+    re-sends seq 2. Without the root floor, B's fresh dedup state would
+    accept it again and the upload would double-contribute."""
+    wm = SeqWatermarks()
+    a, b = _core(wid=0), _core(wid=1)
+    c, inc = 7, 3
+
+    assert wm.register(c, inc) == -1
+    a.handle_register(c, incarnation=inc)
+    a.note_seqfloor(c, inc, -1)
+    for s in range(3):
+        assert a.handle_upload(_upload(c, 0, s)) == "accepted"
+    # the accepted marks ride A's verdict batch up to the root
+    wm.advance(c, inc, 2)
+
+    # forced migration: same incarnation re-registers on B; the root's
+    # floor reaches B BEFORE the register is answered
+    floor = wm.register(c, inc)
+    assert floor == 2
+    b.handle_register(c, incarnation=inc)
+    b.note_seqfloor(c, inc, floor)
+    assert b.handle_upload(_upload(c, 0, 2)) == "dropped_duplicate"
+    assert b.handle_upload(_upload(c, 0, 3)) == "accepted"
+
+    # a RESTART (new incarnation) is not a migration: fresh floor,
+    # seq 0 legitimate again
+    assert wm.register(c, inc + 1) == -1
+    b.handle_register(c, incarnation=inc + 1)
+    b.note_seqfloor(c, inc + 1, wm.register(c, inc + 1))
+    # a stale floor from the superseded incarnation must not poison
+    # the fresh seq space...
+    b.note_seqfloor(c, inc, 99)
+    assert b.handle_upload(_upload(c, 0, 0)) == "accepted"
+    # ...and neither must a superseded incarnation's draining marks
+    wm.advance(c, inc, 50)
+    assert wm.floor(c, inc + 1) == -1
+    wm.advance(c, inc + 1, 0)
+    assert wm.floor(c, inc + 1) == 0
+
+
+# ---------------------------------------------------------------------------
+# (e) live multi-process tree runs — slow (region children + fleets)
+# ---------------------------------------------------------------------------
+
+
+def _assert_green(res):
+    audit = res["upload_audit"]
+    assert audit["received_accounted"], audit
+    assert audit["accepted_accounted"], audit
+    assert res["frames_reconciled"], res
+    assert res["rounds_or_aggregations"] == res["target"], res
+
+
+@pytest.mark.slow
+def test_region_tree_end_to_end_shm_and_delta():
+    res = run_load(mode="ingest", num_clients=24, aggregations=6,
+                   buffer_k=8, regions=2, ingest_workers=2,
+                   ingest_shm=True, sync_delta=True,
+                   upload_local_scale=1e-6, leaf_elems=64)
+    _assert_green(res)
+    assert res["regions"] == 2 and res["workers_per_region"] == 2
+    assert res["lost_with_region"] == 0
+    xs = res["worker_xstats"]
+    assert xs["shm_exports"] > 0
+    assert res["client_stats"]["delta_syncs"] > 0
+    assert res["client_stats"]["delta_errors"] == 0
+    # the fan-in is two-tier labeled: region="R" on top of worker="N"
+    assert res["merged_metrics"]["region_labeled"] == [0, 1]
+    assert res["merged_metrics"]["worker_labeled"] == [0, 1, 2, 3]
+
+
+@pytest.mark.slow
+def test_region_tree_secure_quant_end_to_end():
+    res = run_load(mode="ingest", num_clients=16, aggregations=4,
+                   buffer_k=6, regions=2, ingest_workers=2,
+                   ingest_secure_quant=True, leaf_elems=64)
+    _assert_green(res)
+    assert res["secure_quant"] is True
+    assert res["regions"] == 2
