@@ -447,6 +447,21 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "that cross the host each round fall back "
                              "to 1 with a logged + counted reason "
                              "(nidt_fallback_total)")
+    parser.add_argument("--recipe", type=str, default="",
+                        help="apply a committed autotune recipe "
+                             "(tune/recipe.py) as config DEFAULTS "
+                             "before any conflict check: a path to "
+                             "bench_matrix/recipes/<device_kind>.json, "
+                             "or 'auto' to resolve the committed recipe "
+                             "for the visible device kind at startup. "
+                             "Explicit CLI flags win over recipe values "
+                             "(each override is logged + counted via "
+                             "nidt_fallback_total{plane='recipe'}); a "
+                             "truncated/tampered/mismatched recipe dies "
+                             "at argparse. Loading a recipe also arms "
+                             "the mfu-below-recipe drift rule "
+                             "(obs/rules.py) against the recipe's "
+                             "recorded score")
     return parser
 
 
@@ -515,6 +530,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         remat=args.remat,
+        recipe=args.recipe,
         stream_chunk_clients=args.stream_chunk_clients,
         log_dir=args.log_dir,
         trace_out=args.trace_out, metrics_port=args.metrics_port,
@@ -615,17 +631,26 @@ def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
 
     # remat policy for the 3D family (PROFILE.md): no-remat is faster
     # (b128 x 1 client/core measured 768 vs 611 samples/s against stem
-    # remat, round 3) and up to ~128 full-size samples fit in flight per
-    # chip without it; above that use stem remat (f0+f1 — same speed as
-    # full remat, less HBM).
+    # remat, round 3) and up to ~128 full-size fp32 samples fit in
+    # flight per chip without it; above that use stem remat (f0+f1 —
+    # same speed as full remat, less HBM). The cutoff is precision-
+    # aware (core/optim.py REMAT_AUTO_SAMPLES): bf16_mixed stores
+    # activations at half the bytes, so the same headroom carries 2x
+    # the samples before recompute pays for itself.
     remat: bool | str | None
     if cfg.remat == "auto":
         import jax
 
+        from neuroimagedisttraining_tpu.core.optim import (
+            remat_auto_samples_threshold,
+        )
+
         n_dev = max(1, len(jax.devices()) if mesh is None
                     else mesh.devices.size)
         per_dev = -(-cfg.fed.client_num_per_round // n_dev)
-        remat = False if per_dev * cfg.optim.batch_size <= 128 else "stem"
+        threshold = remat_auto_samples_threshold(cfg.optim.precision)
+        remat = (False if per_dev * cfg.optim.batch_size <= threshold
+                 else "stem")
     else:
         remat = {"none": False, "stem": "stem", "all": True}[cfg.remat]
     # precision contract (ISSUE 10): the model's flax dtype IS the
@@ -645,6 +670,31 @@ def main(argv: list[str] | None = None) -> int:
     parser = add_args(argparse.ArgumentParser(
         prog="neuroimagedisttraining_tpu"))
     args = parser.parse_args(argv)
+
+    # virtual devices provision BEFORE any backend touch — including
+    # the --recipe auto device-kind resolution just below
+    if args.virtual_devices:
+        from neuroimagedisttraining_tpu.parallel.mesh import (
+            provision_virtual_devices,
+        )
+        provision_virtual_devices(args.virtual_devices)
+
+    # autotune recipe (ISSUE 19, tune/recipe.py): applied as config
+    # DEFAULTS before the conflict checks below, so a recipe knob that
+    # conflicts with an explicit flag dies at argparse exactly like a
+    # hand-spelled config; explicit flags win with a logged + counted
+    # override (nidt_fallback_total{plane="recipe"})
+    recipe_doc = None
+    if args.recipe:
+        from neuroimagedisttraining_tpu.tune import recipe as tune_recipe
+
+        try:
+            recipe_doc = tune_recipe.resolve_and_load(args.recipe)
+            tune_recipe.apply_recipe(
+                args, recipe_doc,
+                argv if argv is not None else sys.argv[1:])
+        except (OSError, ValueError) as e:
+            parser.error(f"--recipe: {e}")
 
     # privacy-plane flag conflicts die AT ARGPARSE with the resolution
     # named (ISSUE 8 satellite) — the engine constructors reject these
@@ -769,12 +819,6 @@ def main(argv: list[str] | None = None) -> int:
         except ValueError as e:
             parser.error(str(e))
 
-    if args.virtual_devices:
-        from neuroimagedisttraining_tpu.parallel.mesh import (
-            provision_virtual_devices,
-        )
-        provision_virtual_devices(args.virtual_devices)
-
     if args.profile_session:
         # push-button profile session (ISSUE 14, obs/probe.py): the
         # declarative probe manifest through the shipped driver with
@@ -871,11 +915,20 @@ def main(argv: list[str] | None = None) -> int:
     # manifest parameterized by this run's budget/schedule, extended by
     # --health_rules; evaluated at every engine host boundary
     # (publish_stat_info) and reported on /healthz
+    # a loaded recipe arms its drift rule (mfu-below-recipe): live MFU
+    # sagging under the recipe's recorded score flight-records
+    # retune_recommended (tune/recipe.py drift_rules)
+    extra_rules = ()
+    if recipe_doc is not None:
+        from neuroimagedisttraining_tpu.tune import recipe as tune_recipe
+
+        extra_rules = tune_recipe.drift_rules(recipe_doc)
     hrules = obs_rules.configure(
         manifest_path=args.health_rules,
         dp_epsilon_budget=cfg.fed.dp_epsilon_budget,
         comm_round=cfg.fed.comm_round,
-        max_staleness=cfg.fed.max_staleness)
+        max_staleness=cfg.fed.max_staleness,
+        extra_rules=extra_rules)
     msrv = start_metrics_server(
         cfg.metrics_port, host=args.metrics_host,
         health_probe=lambda: {

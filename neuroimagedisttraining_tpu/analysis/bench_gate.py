@@ -251,15 +251,62 @@ SPECS: dict[str, tuple[Check, ...]] = {
         Check("probes.bf16.round_ms", "ratio_max", 2.0),
         Check("probes.fp32_baseline.sustained_tflops", "ratio_min", 0.5,
               "sustained analytic TFLOP/s over the last boundary "
-              "window (the MFU numerator; a nidt_mfu ratio check "
-              "joins the spec when the first TPU-session artifact — "
-              "where the peak is known — replaces the CPU cell: the "
-              "committed-dir canary requires every spec path to "
-              "resolve, and mfu is null off-chip)"),
+              "window (the MFU numerator)"),
+        # the MFU ratio cells are ACTIVE but judge only when the
+        # committed side carries a number: mfu is null off-chip (no
+        # device peak), the committed cell is the CPU baseline, and a
+        # null committed value SKIPS a ratio check (the self-diff
+        # canary in tests/test_bench_gate.py pins exactly this — only
+        # .mfu cells may skip). The first TPU-session regeneration
+        # flips them to judging with zero spec edits.
+        Check("probes.fp32_baseline.mfu", "ratio_min", 0.5,
+              "model FLOPs utilization (judged once the committed "
+              "artifact was measured where the device peak is known)"),
+        Check("probes.bf16.mfu", "ratio_min", 0.5),
         Check("xla.train_step.parity_ratio", "ratio_min", 0.9,
               "XLA cost_analysis vs analytic ops/flops.py FLOPs — "
               "deterministic on a fixed backend"),
         Check("xla.train_step.parity_ratio", "ratio_max", 1.1),
+    ),
+    # autotuner session (ISSUE 19, scripts/run_autotune.sh): the seeded
+    # successive-halving search over the declared space through the
+    # virtual backend, plus one REAL-driver run of the winner. Every
+    # cell is a deterministic search fact at the committed seed/space —
+    # the byte-determinism self-check, the winner identity, the space
+    # census — so the checks are exact; a regeneration that changes the
+    # winner changed the space/seed/cost model, not the weather.
+    "autotune_session.json": (
+        Check("session.deterministic", "true",
+              note="same seed + space reproduced the same recipe "
+                   "BYTES twice (in-memory rerun self-check)"),
+        Check("winner.fingerprint", "eq",
+              note="winner identity at the committed seed/space"),
+        Check("winner.score", "eq",
+              note="committed-window score (virtual backend: seeded, "
+                   "exact)"),
+        Check("space.fingerprint", "eq",
+              note="the declared space (axes + device context + "
+                   "pinned knobs)"),
+        Check("space.n_cells", "eq",
+              note="valid-cell census after the validity predicates"),
+        Check("winner_validation.ran", "true",
+              note="the winner ran once through the REAL probe "
+                   "driver after emission"),
+        Check("winner_validation.status", "eq",
+              note="and survived it (committed cell says 'ok')"),
+    ),
+    # the committed per-hardware recipe itself (tune/recipe.py): the
+    # artifact --recipe auto loads on this box. Identity cells exact —
+    # the sha256 self-pin covers every other byte.
+    "recipes/cpu.json": (
+        Check("device_kind", "eq",
+              note="the recipe file matches its directory slot"),
+        Check("fingerprint", "eq",
+              note="winning-cell identity"),
+        Check("score", "eq"),
+        Check("space_fingerprint", "eq"),
+        Check("sha256", "eq",
+              note="the self-pin: any other drift shows here"),
     ),
 }
 

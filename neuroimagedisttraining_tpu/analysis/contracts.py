@@ -24,8 +24,9 @@ run under ``python -m neuroimagedisttraining_tpu.analysis --project``:
    donated position is flagged (the per-file rule only sees one file).
 
 Every family suppresses through the standard ``# nidt: allow[rule-id]
--- why`` pragma on the flagged line. The REASONS and bench_gate SPECS
-closures ride family 2's spirit (names must resolve; orphans surface).
+-- why`` pragma on the flagged line. The REASONS, bench_gate SPECS,
+and autotuner RECIPE_KEYS closures ride family 2's spirit (names must
+resolve; orphans surface).
 """
 
 from __future__ import annotations
@@ -379,6 +380,73 @@ class BenchSpecClosureRule(ProjectRule):
                         f"SPECS cell {path!r} does not resolve in "
                         f"bench_matrix/{artifact} — the gate would fail "
                         "on a missing cell, not a regression")
+
+
+@register
+class RecipeKeyClosureRule(ProjectRule):
+    rule_ids = ("recipe-key-closure",)
+    description = (
+        "every committed bench_matrix/recipes/*.json cell key must "
+        "resolve through the tune/recipe.py RECIPE_KEYS table to a CLI "
+        "option declared on BOTH CLIs — a recipe can never name a "
+        "config field the trainers do not declare")
+
+    def project_check(self, model: ProjectModel) -> Iterator[Finding]:
+        from neuroimagedisttraining_tpu.analysis.project import (
+            committed_recipes,
+            recipe_keys_table,
+        )
+        recipe_mod = model.find("tune/recipe.py")
+        if recipe_mod is None:
+            return
+        table = recipe_keys_table(model)
+        if not table:
+            yield Finding(
+                recipe_mod.path, 1, "recipe-key-closure",
+                "tune/recipe.py has no statically-parseable RECIPE_KEYS "
+                "dict literal — the closure over committed recipes "
+                "cannot be checked")
+            return
+        cli_options: dict[str, set[str]] = {}
+        for suffix in ("/__main__.py", "distributed/run.py"):
+            mod = model.find(suffix)
+            if mod is not None:
+                cli_options[suffix] = {
+                    opt for f in argparse_flags(mod).values()
+                    for opt in f.options}
+        for key, (option, line) in sorted(table.items()):
+            for suffix, options in sorted(cli_options.items()):
+                if option not in options:
+                    yield Finding(
+                        recipe_mod.path, line, "recipe-key-closure",
+                        f"RECIPE_KEYS maps {key!r} to {option} but the "
+                        f"{suffix.lstrip('/')} CLI declares no such "
+                        "option — a recipe setting it would apply to a "
+                        "nonexistent knob")
+        anchor = min(l for _, l in table.values())
+        for fn, doc in sorted(committed_recipes(model).items()):
+            if not isinstance(doc, dict):
+                yield Finding(
+                    recipe_mod.path, anchor, "recipe-key-closure",
+                    f"committed bench_matrix/recipes/{fn} does not "
+                    "parse as a JSON object — --recipe would die on it "
+                    "at startup; regenerate (scripts/run_autotune.sh)")
+                continue
+            cell = doc.get("cell")
+            if not isinstance(cell, dict):
+                yield Finding(
+                    recipe_mod.path, anchor, "recipe-key-closure",
+                    f"committed bench_matrix/recipes/{fn} has no "
+                    "'cell' object — not a recipe the loader accepts")
+                continue
+            for key in sorted(cell):
+                if key not in table:
+                    yield Finding(
+                        recipe_mod.path, anchor, "recipe-key-closure",
+                        f"committed bench_matrix/recipes/{fn} sets "
+                        f"cell key {key!r} which RECIPE_KEYS does not "
+                        "declare — the loader would reject the file at "
+                        "startup")
 
 
 # ---------------------------------------------------------------------------

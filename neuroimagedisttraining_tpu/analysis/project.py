@@ -572,6 +572,57 @@ def resolve_cell(doc: Any, dotted: str) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# autotuner recipe keys vs committed recipes (tune/recipe.py)
+# ---------------------------------------------------------------------------
+
+def recipe_keys_table(model: ProjectModel
+                      ) -> dict[str, tuple[str, int]]:
+    """``cell key -> (CLI option, lineno)`` from the tune/recipe.py
+    ``RECIPE_KEYS`` literal — the declared set of knobs a recipe may
+    set."""
+    mod = model.find("tune/recipe.py")
+    if mod is None:
+        return {}
+    for stmt in mod.tree.body:
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target = stmt.targets[0]
+        elif isinstance(stmt, ast.AnnAssign):
+            target = stmt.target
+        if (isinstance(target, ast.Name) and target.id == "RECIPE_KEYS"
+                and isinstance(stmt.value, ast.Dict)):
+            out: dict[str, tuple[str, int]] = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    out[k.value] = (v.value, k.lineno)
+            return out
+    return {}
+
+
+def committed_recipes(model: ProjectModel) -> dict[str, Any]:
+    """Every committed ``bench_matrix/recipes/*.json`` parsed as JSON,
+    keyed by file name; unparseable files map to None (the closure
+    rule flags them — a committed recipe that does not parse would die
+    at --recipe load time)."""
+    rdir = os.path.join(model.root, "bench_matrix", "recipes")
+    if not os.path.isdir(rdir):
+        return {}
+    out: dict[str, Any] = {}
+    for fn in sorted(os.listdir(rdir)):
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(rdir, fn), encoding="utf-8") as fh:
+                out[fn] = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            out[fn] = None
+    return out
+
+
+# ---------------------------------------------------------------------------
 # startup-rejection sites -> compatibility-matrix rows
 # ---------------------------------------------------------------------------
 

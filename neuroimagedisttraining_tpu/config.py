@@ -255,6 +255,11 @@ class ExperimentConfig:
     remat: str = "auto"            # auto | none | stem | all — 3D-model
     # rematerialization policy (PROFILE.md); auto picks from samples
     # in flight per device (build_experiment)
+    # Autotune recipe applied at startup (tune/recipe.py, ISSUE 19):
+    # path to a committed bench_matrix/recipes/<device_kind>.json or
+    # "auto" (resolve by visible device kind); "" = none. Recorded so a
+    # run's config names the recipe that defaulted its knobs.
+    recipe: str = ""
     checkpoint_dir: str = ""
     checkpoint_every: int = 0          # rounds; 0 disables
     log_dir: str = "LOG"

@@ -39,6 +39,22 @@ from neuroimagedisttraining_tpu.config import OptimConfig
 #: legal ``OptimConfig.precision`` values, in contract order
 PRECISIONS = ("fp32", "bf16_mixed")
 
+#: ``--remat auto`` activation budget by precision: the max samples in
+#: flight per device before stem remat arms. The fp32 cutoff (128) is
+#: the measured activation-bytes knee on the harness box; under
+#: bf16_mixed the conv/matmul activations are stored in bfloat16 —
+#: half the bytes per sample — so the same HBM headroom carries 2x the
+#: samples before recompute pays for itself (ISSUE 19 satellite; the
+#: ratio is pinned in tests/test_tune.py).
+REMAT_AUTO_SAMPLES = {"fp32": 128, "bf16_mixed": 256}
+
+
+def remat_auto_samples_threshold(precision: str) -> int:
+    """Samples-in-flight-per-device cutoff above which ``--remat auto``
+    resolves to stem remat, for this precision policy."""
+    validate_precision_name(precision)
+    return REMAT_AUTO_SAMPLES[precision]
+
 
 def compute_dtype(precision: str):
     """The flax module ``dtype`` a precision policy compiles to (master

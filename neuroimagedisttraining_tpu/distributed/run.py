@@ -622,7 +622,33 @@ def main(argv=None) -> int:
                          "so there is no in-process client axis to shard "
                          "(cohort sharding lives in the simulated "
                          "engines, parallel/cohort.py)")
+    ap.add_argument("--recipe", type=str, default="",
+                    help="autotuner recipe (tune/recipe.py): a "
+                         "bench_matrix/recipes/<device_kind>.json path, "
+                         "or 'auto' for the committed recipe matching "
+                         "this rank's device kind. Applies as config "
+                         "DEFAULTS (flags spelled here win, override "
+                         "logged); the server rank arms the "
+                         "mfu-below-recipe drift rule")
     args = ap.parse_args(argv)
+    if args.force_cpu:
+        # provision BEFORE any backend touch: --recipe auto resolves
+        # the live device kind through jax.devices()
+        from neuroimagedisttraining_tpu.parallel.mesh import (
+            provision_virtual_devices,
+        )
+        provision_virtual_devices(1)
+    recipe_doc = None
+    if args.recipe:
+        from neuroimagedisttraining_tpu.tune import recipe as tune_recipe
+
+        try:
+            recipe_doc = tune_recipe.resolve_and_load(args.recipe)
+            tune_recipe.apply_recipe(
+                args, recipe_doc,
+                argv if argv is not None else sys.argv[1:])
+        except (OSError, ValueError) as e:
+            ap.error(f"--recipe: {e}")
     if args.dp_epsilon_budget < 0:
         ap.error(f"--dp_epsilon_budget must be >= 0 (got "
                  f"{args.dp_epsilon_budget})")
@@ -857,11 +883,8 @@ def main(argv=None) -> int:
         obs_trace.arm(args.trace_out,
                       tags={"role": args.role, "rank": args.rank})
     host_map = _parse_hosts(args.hosts)
-    if args.force_cpu:
-        from neuroimagedisttraining_tpu.parallel.mesh import (
-            provision_virtual_devices,
-        )
-        provision_virtual_devices(1)
+    # (--force_cpu provisioning happens right after parse_args: the
+    # --recipe auto resolution touches the backend)
 
     from neuroimagedisttraining_tpu.distributed.cross_silo import (
         FedAvgClientProc, FedAvgServer, SecureFedAvgClientProc,
@@ -1007,11 +1030,18 @@ def main(argv=None) -> int:
         from neuroimagedisttraining_tpu.obs import health as obs_health
         from neuroimagedisttraining_tpu.obs import rules as obs_rules
 
+        extra_rules = ()
+        if recipe_doc is not None:
+            from neuroimagedisttraining_tpu.tune import (
+                recipe as tune_recipe,
+            )
+            extra_rules = tune_recipe.drift_rules(recipe_doc)
         hrules = obs_rules.configure(
             manifest_path=args.health_rules,
             dp_epsilon_budget=args.dp_epsilon_budget,
             comm_round=args.comm_round,
-            max_staleness=args.max_staleness)
+            max_staleness=args.max_staleness,
+            extra_rules=extra_rules)
 
         def _health() -> dict:
             # scrape-thread probe with a BOUNDED lock wait: _rlock is

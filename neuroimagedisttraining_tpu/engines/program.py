@@ -129,6 +129,11 @@ REASONS: dict[str, tuple[str, str]] = {
         "the distributed transport has no in-process client axis to "
         "shard (each rank trains its own silo) — flag accepted for "
         "config parity with the main CLI only")),
+    # -- autotuner recipes (plane "recipe", tune/recipe.py) --
+    "recipe-override": ("recipe", (
+        "an explicit CLI flag overrides the loaded recipe's value for "
+        "this knob (--recipe applies as config DEFAULTS; flags the "
+        "operator spells win)")),
 }
 
 

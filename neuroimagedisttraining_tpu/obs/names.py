@@ -113,6 +113,11 @@ SERVE_WORKER_REQUESTS = "nidt_serve_worker_requests_total"
 # -- anomaly-rule engine (obs/rules.py) --
 ALERT = "nidt_alert"
 
+# -- autotuner recipes (tune/recipe.py): the loaded recipe's recorded
+#    score, published so the mfu-below-recipe drift rule's threshold is
+#    scrapeable next to the live nidt_mfu it is compared against --
+RECIPE_SCORE = "nidt_recipe_score"
+
 #: every declared metric name — the set obs/rules.py validates rule
 #: manifests against at startup (unknown names fail with this list)
 DECLARED: frozenset[str] = frozenset(

@@ -53,13 +53,59 @@ import time
 from typing import Any
 
 __all__ = ["Probe", "default_manifest", "load_manifest", "run_probe",
-           "run_session", "session_ok", "main"]
+           "run_session", "session_ok", "main", "validate_cell_value",
+           "remat_policy"]
 
 #: config-cell keys a probe may set; anything else in a manifest cell
 #: is a spelling error and fails loudly at load (declarative probes
 #: must not silently ignore a knob)
 CELL_KEYS = ("precision", "fused_update", "remat", "client_mesh",
-             "rounds_per_dispatch")
+             "rounds_per_dispatch", "batch")
+
+#: legal remat spellings in a cell: the CLI policy strings plus the
+#: historic manifest booleans (True -> full remat, False -> off)
+REMAT_CELL_VALUES = ("none", "stem", "all")
+
+
+def remat_policy(value) -> bool | str:
+    """Map a cell's remat value onto ``LocalTrainer(remat=...)``: bools
+    pass through, the CLI policy strings map {"none": off, "stem":
+    stem-only, "all": full}."""
+    if isinstance(value, bool):
+        return value
+    return {"none": False, "stem": "stem", "all": True}[value]
+
+
+def validate_cell_value(key: str, value) -> None:
+    """Per-axis domain check (ValueError on violation) — shared by the
+    manifest loader and the autotuner's space generator (tune/space.py)
+    so neither can propose a cell the driver would choke on."""
+    def die(expect: str) -> None:
+        raise ValueError(f"cell key {key}={value!r} out of domain: "
+                         f"expected {expect}")
+
+    if key == "precision":
+        from neuroimagedisttraining_tpu.core.optim import PRECISIONS
+        if value not in PRECISIONS:
+            die(f"one of {PRECISIONS}")
+    elif key == "fused_update":
+        if not isinstance(value, bool):
+            die("a bool")
+    elif key == "remat":
+        if not isinstance(value, bool) and value not in REMAT_CELL_VALUES:
+            die(f"a bool or one of {REMAT_CELL_VALUES}")
+    elif key == "client_mesh":
+        if isinstance(value, bool) or not isinstance(value, int) or value < 0:
+            die("an int >= 0")
+    elif key == "rounds_per_dispatch":
+        if isinstance(value, bool) or not isinstance(value, int) or value < 1:
+            die("an int >= 1")
+    elif key == "batch":
+        if isinstance(value, bool) or not isinstance(value, int) or value < 1:
+            die("an int >= 1")
+    else:
+        raise ValueError(f"unknown cell key {key!r}; declarable keys: "
+                         f"{CELL_KEYS}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +123,11 @@ class Probe:
             raise ValueError(
                 f"probe {self.name!r} names unknown cell keys "
                 f"{sorted(bad)}; declarable keys: {CELL_KEYS}")
+        for key, value in self.cell.items():
+            try:
+                validate_cell_value(key, value)
+            except ValueError as e:
+                raise ValueError(f"probe {self.name!r}: {e}") from None
 
 
 def default_manifest(n_devices: int = 1) -> tuple[Probe, ...]:
@@ -168,8 +219,9 @@ def run_probe(probe: Probe, meta: dict, fed, log) -> dict:
                                f"{len(jax.devices())} visible "
                                "(--virtual_devices provisions them)"}
     precision = cell.get("precision", "fp32")
-    optim = OptimConfig(lr=1e-3, batch_size=meta["batch"], epochs=1,
-                        precision=precision,
+    optim = OptimConfig(lr=1e-3,
+                        batch_size=int(cell.get("batch", meta["batch"])),
+                        epochs=1, precision=precision,
                         fused_update=bool(cell.get("fused_update",
                                                    False)))
     cfg = ExperimentConfig(
@@ -185,7 +237,7 @@ def run_probe(probe: Probe, meta: dict, fed, log) -> dict:
     trainer = LocalTrainer(
         create_model(meta["model"], num_classes=1,
                      dtype=compute_dtype(precision),
-                     remat=bool(cell.get("remat", False))),
+                     remat=remat_policy(cell.get("remat", False))),
         optim, num_classes=1)
     mesh = make_mesh(num_devices=cm) if cm > 1 else None
     engine = create_engine("fedavg", cfg, fed, trainer, logger=log,

@@ -95,6 +95,11 @@ class HealthRule:
     for_rounds: int = 1
     agg: str = "max"
     description: str = ""
+    #: optional flight-ring event kind recorded on every RISING edge in
+    #: addition to the standard ``alert`` event — how a rule names the
+    #: operator action it recommends (the autotuner's mfu-below-recipe
+    #: rule records ``retune_recommended``; tune/recipe.py)
+    on_fire_event: str = ""
 
     def validate(self, known: frozenset[str]) -> None:
         if self.metric not in known:
@@ -215,6 +220,7 @@ class RuleEngine:
                     "names are the alert label and must be unique")
             seen.add(r.name)
         self.rules = tuple(rules)
+        self._by_name = {r.name: r for r in self.rules}
         self._lock = threading.Lock()
         self._state = {r.name: _RuleState(r.n) for r in rules}
         self._rounds_evaluated = 0
@@ -269,6 +275,11 @@ class RuleEngine:
             obs_flight.record(e["kind"], rule=e["rule"],
                               severity=e["severity"], round=e["round"],
                               value=e.get("value"))
+            r = self._by_name.get(e["rule"])
+            if e["kind"] == "alert" and r is not None and r.on_fire_event:
+                obs_flight.record(r.on_fire_event, rule=e["rule"],
+                                  round=e["round"],
+                                  value=e.get("value"))
         return edges
 
     def _select(self, rule: HealthRule, snap: dict) -> float | None:
@@ -554,18 +565,24 @@ _ACTIVE_LOCK = threading.Lock()
 
 def configure(rules: Iterable[HealthRule] | None = None, *,
               manifest_path: str = "", dp_epsilon_budget: float = 0.0,
-              comm_round: int = 200,
-              max_staleness: int = 20) -> RuleEngine:
+              comm_round: int = 200, max_staleness: int = 20,
+              extra_rules: Iterable[HealthRule] | None = None
+              ) -> RuleEngine:
     """Arm the process-global rule engine: the built-in manifest
     (parameterized by the run's budget/schedule), plus — or replaced
     by — an explicit rule list / ``--health_rules`` JSON manifest
-    (manifest rules EXTEND the built-ins; same-named rules override)."""
+    (manifest rules EXTEND the built-ins; same-named rules override).
+    ``extra_rules`` are programmatic additions merged AFTER the
+    built-ins and BEFORE the manifest (a recipe's drift rule — the
+    operator's JSON still wins)."""
     global _ACTIVE
     base = {r.name: r for r in (rules if rules is not None
                                 else builtin_rules(
                                     dp_epsilon_budget=dp_epsilon_budget,
                                     comm_round=comm_round,
                                     max_staleness=max_staleness))}
+    for r in (extra_rules or ()):
+        base[r.name] = r
     if manifest_path:
         for r in load_rules(manifest_path):
             base[r.name] = r

@@ -1,0 +1,34 @@
+"""Closed-loop autotuner (ISSUE 19): search the declared probe space,
+emit per-hardware recipes, re-tune on MFU drift.
+
+- :mod:`tune.space` — the search space as DATA: the probe cell axes
+  (obs/probe.py CELL_KEYS, ``batch`` included) with per-axis validity
+  predicates reusing the compat-matrix rejection knowledge and
+  device-kind-aware HBM bounds. Never proposes a cell the CLIs would
+  reject at startup.
+- :mod:`tune.search` — seeded successive halving: cheap short-window
+  screens, survivors re-measured at the committed window; every
+  measurement keyed by cell fingerprint in a JSONL journal so a killed
+  run resumes without re-measuring.
+- :mod:`tune.recipe` — the winner serialized as
+  ``bench_matrix/recipes/<device_kind>.json`` (sha256-pinned, full
+  score trace retained), loadable via ``--recipe <path|auto>`` on both
+  CLIs; loading arms the ``mfu-below-recipe`` drift rule.
+
+Entry points::
+
+    scripts/run_autotune.sh                          # the push-button
+    python -m neuroimagedisttraining_tpu.tune --backend virtual
+    python -m neuroimagedisttraining_tpu ... --recipe auto
+"""
+
+from neuroimagedisttraining_tpu.tune.space import (  # noqa: F401
+    Space, build_space, cell_fingerprint,
+)
+from neuroimagedisttraining_tpu.tune.search import (  # noqa: F401
+    Journal, run_search, virtual_measure,
+)
+from neuroimagedisttraining_tpu.tune.recipe import (  # noqa: F401
+    RECIPE_KEYS, apply_recipe, drift_rules, load_recipe,
+    resolve_and_load,
+)

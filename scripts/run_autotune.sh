@@ -1,0 +1,123 @@
+#!/usr/bin/env bash
+# Push-button autotune session (ISSUE 19): screen -> refine -> emit ->
+# gate -> install. Runs the seeded successive-halving search over the
+# declared space (tune/space.py), emits the per-device-kind recipe +
+# the session artifact into a temp dir, gates BOTH against the
+# committed baselines (analysis/bench_gate.py — every cell exact: the
+# search is seeded and byte-deterministic), then installs
+# bench_matrix/recipes/<device_kind>.json and
+# bench_matrix/autotune_session.json.
+#
+# Order matters: fresh temp dir first, gate before install — gating
+# after overwriting the committed path would compare the fresh
+# artifact against itself and pass vacuously.
+#
+# Seed/space-change regenerations: every gated cell is exact AT the
+# committed seed + space; a run with a different seed, axes, or
+# backend legitimately differs, so when the fresh session meta block
+# != the committed one the gate verdict is reported but not fatal —
+# the operator is establishing a new baseline and reviews + commits it.
+#
+# Defaults are the CPU-harness configuration (virtual cost-model
+# backend over the small default axes, 2 virtual devices so the
+# client_mesh=2 cells stay in the space, winner validated through the
+# REAL engine.train() driver once). The flagship TPU session measures
+# every cell through the real driver instead — run on the pod:
+#
+#   TUNE_BACKEND=driver TUNE_DEVICES=0 \
+#   PROFILE_MODEL=3DCNN PROFILE_SHAPE=121,145,121 PROFILE_LOCAL=512 \
+#   PROFILE_CLIENTS=21 NIDT_PEAK_FLOPS=<chip bf16 peak * chips> \
+#   TUNE_SCREEN_ROUNDS=2 TUNE_COMMIT_ROUNDS=8 scripts/run_autotune.sh
+#
+# (driver cells score by nidt_mfu once the peak is armed; the journal
+# in TUNE_JOURNAL makes a killed pod session resumable.)
+#
+# Env:
+#   TUNE_BACKEND        virtual | driver       (default virtual)
+#   TUNE_SEED           search seed            (default 20)
+#   TUNE_DEVICES        virtual CPU devices    (default 2; 0 = none,
+#                       real backends)
+#   TUNE_SCREEN_ROUNDS  screen fidelity        (default 2)
+#   TUNE_COMMIT_ROUNDS  committed fidelity     (default 5)
+#   TUNE_SURVIVORS      refine pool size       (default 4)
+#   TUNE_JOURNAL        JSONL resume journal   (default: fresh temp)
+#   TUNE_OUT_DIR        install dir            (default bench_matrix)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PY="${PYTHON:-python}"
+BACKEND="${TUNE_BACKEND:-virtual}"
+SEED="${TUNE_SEED:-20}"
+DEVICES="${TUNE_DEVICES:-2}"
+SCREEN="${TUNE_SCREEN_ROUNDS:-2}"
+COMMIT="${TUNE_COMMIT_ROUNDS:-5}"
+SURVIVORS="${TUNE_SURVIVORS:-4}"
+OUT_DIR="${TUNE_OUT_DIR:-bench_matrix}"
+
+fresh_dir="$(mktemp -d)"
+trap 'rm -rf "$fresh_dir"' EXIT
+JOURNAL="${TUNE_JOURNAL:-$fresh_dir/journal.jsonl}"
+
+# the recipe file name follows the device kind the tuner resolves;
+# ask the CLI to write into the fresh dir and read the path back from
+# the session artifact
+echo "== autotune session (fresh; backend=$BACKEND seed=$SEED) =="
+args=(--backend "$BACKEND" --seed "$SEED"
+      --screen_rounds "$SCREEN" --commit_rounds "$COMMIT"
+      --survivors "$SURVIVORS" --journal "$JOURNAL"
+      --session_out "$fresh_dir/autotune_session.json"
+      --validate_winner)
+if [[ "$DEVICES" != "0" ]]; then
+    args+=(--virtual_devices "$DEVICES")
+fi
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" -m neuroimagedisttraining_tpu.tune "${args[@]}" \
+    --out "$fresh_dir/recipe.json"
+
+recipe_rel="recipes/$("$PY" - "$fresh_dir/autotune_session.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+print(doc["meta"]["device_kind"].strip().lower().replace(" ", "_")
+      + ".json")
+EOF
+)"
+mkdir -p "$fresh_dir/recipes"
+mv "$fresh_dir/recipe.json" "$fresh_dir/$recipe_rel"
+
+if [[ -f "$OUT_DIR/autotune_session.json" && -f "$OUT_DIR/$recipe_rel" ]]
+then
+    echo "== bench gate: fresh session vs committed baseline =="
+    same_config="$("$PY" - "$fresh_dir/autotune_session.json" \
+        "$OUT_DIR/autotune_session.json" <<'EOF'
+import json, sys
+fresh = json.load(open(sys.argv[1]))
+committed = json.load(open(sys.argv[2]))
+print("1" if fresh.get("meta") == committed.get("meta")
+      and fresh["space"]["fingerprint"]
+      == committed["space"]["fingerprint"] else "0")
+EOF
+)"
+    gate_rc=0
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        "$PY" -m neuroimagedisttraining_tpu.analysis.bench_gate \
+        --fresh "$fresh_dir" --committed "$OUT_DIR" \
+        --artifact autotune_session.json --artifact "$recipe_rel" \
+        --quiet || gate_rc=$?
+    if [[ "$same_config" == "1" && "$gate_rc" -ne 0 ]]; then
+        echo "autotune session DIVERGED from the committed baseline at" \
+             "the SAME seed/space — not installing" >&2
+        exit "$gate_rc"
+    elif [[ "$same_config" != "1" ]]; then
+        echo "NOTE: session seed/space differs from the committed" \
+             "baseline — gate verdict above is informational;" \
+             "installing as the NEW baseline. Review before committing."
+    fi
+else
+    echo "== no committed autotune baseline yet (first session) =="
+fi
+
+mkdir -p "$OUT_DIR/recipes"
+cp "$fresh_dir/autotune_session.json" "$OUT_DIR/autotune_session.json"
+cp "$fresh_dir/$recipe_rel" "$OUT_DIR/$recipe_rel"
+echo "autotune session complete: $OUT_DIR/autotune_session.json +" \
+     "$OUT_DIR/$recipe_rel"

@@ -144,10 +144,14 @@ def test_self_diff_of_committed_matrix_is_green():
     assert res["self_diff"] is True
     assert res["verdict"] == "green", [c for c in res["cells"]
                                        if not c["ok"]]
-    # every artifact named in SPECS is committed and fully resolved
-    assert res["skipped"] == 0, res["skips"]
-    assert res["checked"] == sum(len(v) for v in
-                                 bench_gate.SPECS.values())
+    # every artifact named in SPECS is committed, and every spec path
+    # resolves — the ONLY tolerated skips are the armed-but-waiting
+    # MFU ratio cells, null in the committed artifacts until the first
+    # TPU-session regeneration records a known device peak
+    assert all(s.get("path", "").endswith(".mfu")
+               for s in res["skips"]), res["skips"]
+    assert res["checked"] + res["skipped"] == sum(
+        len(v) for v in bench_gate.SPECS.values())
 
 
 # ------------------------------------------------ bench_diff wrapper
