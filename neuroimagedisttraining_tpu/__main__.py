@@ -404,6 +404,19 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "(obs/metrics.py dump_jsonl) — the sink "
                              "analysis/run_report.py joins with the "
                              "flight dump and health verdict")
+    parser.add_argument("--actions", type=str, default="dry_run",
+                        choices=("off", "dry_run", "on"),
+                        help="reflex plane (obs/actions.py, ISSUE 20): "
+                             "what a firing health rule's declared "
+                             "action DOES. off = rules only observe; "
+                             "dry_run (default) = every would-fire "
+                             "dispatch is logged and flight-recorded "
+                             "with its rule as provenance but nothing "
+                             "changes; on = actions apply (quarantine "
+                             "the diverging silo, escalate the "
+                             "defense ladder, adapt the async buffer, "
+                             "freeze-and-rollback to the last healthy "
+                             "state)")
     parser.add_argument("--dp_epsilon_budget", type=float, default=0.0,
                         help="epsilon budget the built-in DP health "
                              "rules judge against (obs/rules.py): "
@@ -536,7 +549,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         trace_out=args.trace_out, metrics_port=args.metrics_port,
         flight_events=args.flight_events,
         health_stats=args.health_stats, health_rules=args.health_rules,
-        health_gate=args.health_gate, metrics_out=args.metrics_out)
+        health_gate=args.health_gate, metrics_out=args.metrics_out,
+        actions=args.actions)
 
 
 def build_experiment(cfg: ExperimentConfig, streaming: bool = False,
@@ -929,6 +943,13 @@ def main(argv: list[str] | None = None) -> int:
         comm_round=cfg.fed.comm_round,
         max_staleness=cfg.fed.max_staleness,
         extra_rules=extra_rules)
+    # reflex plane (obs/actions.py, ISSUE 20): arm the action bus the
+    # firing rules dispatch into; the engine registers its handlers at
+    # train() start. LOCAL handle — disarm() precedes the verdict
+    # write, exactly like ``hrules``.
+    from neuroimagedisttraining_tpu.obs import actions as obs_actions
+
+    bus = obs_actions.configure(cfg.actions)
     msrv = start_metrics_server(
         cfg.metrics_port, host=args.metrics_host,
         health_probe=lambda: {
@@ -937,7 +958,9 @@ def main(argv: list[str] | None = None) -> int:
             # satellite): a run silently degraded to K=1 unsharded
             # reads differently from a healthy one at the probe
             "fallbacks": obs_health.fallback_block(),
-            "health": obs_rules.health_block()})
+            "health": obs_rules.health_block(),
+            # the last reflex dispatches, rule provenance included
+            "actions": bus.actions_block()})
     try:
         with failure_context(name=cfg.identity()), \
                 profile_trace(args.profile_dir,
@@ -950,6 +973,7 @@ def main(argv: list[str] | None = None) -> int:
         # against this run's state). The local ``hrules`` handle below
         # still reads the verdict after disarming.
         obs_rules.disarm()
+        obs_actions.disarm()  # local ``bus`` handle outlives disarm too
         if cfg.trace_out:
             out = obs_trace.dump()
             if out:
@@ -976,6 +1000,10 @@ def main(argv: list[str] | None = None) -> int:
     # status into a nonzero exit — a run that diverged and recovered
     # still failed its gate
     verdict = hrules.verdict()
+    # the reflex action log rides in the verdict (and from there into
+    # run_report): deliberately timestamp-free, so twin seeded chaos
+    # runs produce byte-identical blocks (the replayability contract)
+    verdict["actions"] = bus.actions_block()
     verdict_path = os.path.join(engine.log.dir,
                                 cfg.identity() + ".health.json")
     with open(verdict_path, "w") as f:

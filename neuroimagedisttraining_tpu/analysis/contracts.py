@@ -449,6 +449,95 @@ class RecipeKeyClosureRule(ProjectRule):
                         "startup")
 
 
+@register
+class ActionDisciplineRule(ProjectRule):
+    rule_ids = ("action-unknown", "action-orphan")
+    description = (
+        "reflex-plane closure (ISSUE 20): every literal action name — "
+        "a HealthRule action= binding, a JSON-manifest 'action' field, "
+        "a bus register()/on_alert()/record_action() literal — must "
+        "resolve in the obs/actions.py BUILTIN_ACTIONS registry; and "
+        "every registered action must be reachable from some rule or "
+        "dispatch site (or documented in ARCHITECTURE.md) — a reflex "
+        "nothing can ever fire is dead policy")
+
+    def project_check(self, model: ProjectModel) -> Iterator[Finding]:
+        from neuroimagedisttraining_tpu.analysis.project import (
+            action_uses,
+            actions_table,
+        )
+        actions_mod = model.find("obs/actions.py")
+        if actions_mod is None:
+            return
+        table = actions_table(model)
+        if not table:
+            yield Finding(
+                actions_mod.path, 1, "action-unknown",
+                "obs/actions.py has no statically-parseable "
+                "BUILTIN_ACTIONS dict literal — the rule->action "
+                "closure cannot be checked")
+            return
+        uses = action_uses(model)
+        for rel, name, lineno, kind in uses:
+            if rel.endswith("obs/actions.py"):
+                continue  # the registry's own docstrings/dispatch glue
+            if name not in table:
+                yield Finding(
+                    rel, lineno, "action-unknown",
+                    f"{kind} site names reflex action {name!r} which "
+                    "obs/actions.py BUILTIN_ACTIONS does not declare — "
+                    "the dispatch would die (register) or log an "
+                    "'unhandled' no-op forever (rule binding)")
+        # manifest 'action' fields resolve too (the example manifest is
+        # the one committed JSON surface binding rules to actions)
+        import json as _json
+        import os as _os
+        mpath = _os.path.join(model.root, "scripts",
+                              "health_rules.example.json")
+        manifest_names: set[str] = set()
+        if _os.path.exists(mpath):
+            try:
+                with open(mpath, encoding="utf-8") as fh:
+                    rows = _json.load(fh)
+            except (OSError, _json.JSONDecodeError):
+                rows = []
+            for i, row in enumerate(rows if isinstance(rows, list)
+                                    else []):
+                name = (row.get("action", "")
+                        if isinstance(row, dict) else "")
+                if name:
+                    manifest_names.add(name)
+                    if name not in table:
+                        yield Finding(
+                            actions_mod.path, min(table.values()),
+                            "action-unknown",
+                            f"scripts/health_rules.example.json rule "
+                            f"#{i} binds action {name!r} which "
+                            "BUILTIN_ACTIONS does not declare — "
+                            "loading the manifest would fail at "
+                            "startup validation")
+        # orphans: registered but unreachable and undocumented
+        reachable = {name for rel, name, _, kind in uses
+                     if not rel.endswith("obs/actions.py")
+                     and kind in ("rule", "dispatch")} | manifest_names
+        doc_path = _os.path.join(model.root, "ARCHITECTURE.md")
+        doc_text = ""
+        if _os.path.exists(doc_path):
+            try:
+                with open(doc_path, encoding="utf-8") as fh:
+                    doc_text = fh.read()
+            except OSError:
+                pass
+        for name, lineno in sorted(table.items()):
+            if name not in reachable and name not in doc_text:
+                yield Finding(
+                    actions_mod.path, lineno, "action-orphan",
+                    f"BUILTIN_ACTIONS declares {name!r} but no rule "
+                    "binds it, nothing dispatches it, and "
+                    "ARCHITECTURE.md does not document it — a reflex "
+                    "nothing can ever fire")
+
+
 # ---------------------------------------------------------------------------
 # family 3: compatibility matrix as data
 # ---------------------------------------------------------------------------

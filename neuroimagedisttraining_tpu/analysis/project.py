@@ -623,6 +623,72 @@ def committed_recipes(model: ProjectModel) -> dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
+# reflex-action registry (obs/actions.py BUILTIN_ACTIONS, ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def actions_table(model: ProjectModel) -> dict[str, int]:
+    """``action name -> lineno`` of the obs/actions.py
+    ``BUILTIN_ACTIONS`` literal — the declared registry every rule
+    ``action:`` binding must resolve into."""
+    mod = model.find("obs/actions.py")
+    if mod is None:
+        return {}
+    for stmt in mod.tree.body:
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target = stmt.targets[0]
+        elif isinstance(stmt, ast.AnnAssign):
+            target = stmt.target
+        if (isinstance(target, ast.Name)
+                and target.id == "BUILTIN_ACTIONS"
+                and isinstance(stmt.value, ast.Dict)):
+            return {k.value: k.lineno for k in stmt.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return {}
+
+
+def action_uses(model: ProjectModel) -> list[tuple[str, str, int, str]]:
+    """Every literal action-name use across the package:
+    ``(relpath, name, lineno, kind)`` with kind one of
+
+    - ``rule``     — ``action="..."`` keyword on a ``HealthRule(...)``
+                     call (the binding that makes a firing rule DO it)
+    - ``dispatch`` — literal first argument to ``record_action(...)`` /
+                     ``on_alert(...)`` (plane-initiated dispatches)
+    - ``register`` — literal first argument to ``register(...)`` on the
+                     bus (an engine/server realizing the action)
+
+    Keyword matching is restricted to ``HealthRule`` calls so argparse
+    ``action="store_true"`` keywords never read as reflex names."""
+    uses: list[tuple[str, str, int, str]] = []
+    for rel, mod in sorted(model.modules.items()):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else "")
+            if name == "HealthRule":
+                for kwarg in node.keywords:
+                    if (kwarg.arg == "action"
+                            and isinstance(kwarg.value, ast.Constant)
+                            and isinstance(kwarg.value.value, str)
+                            and kwarg.value.value):
+                        uses.append((rel, kwarg.value.value,
+                                     kwarg.value.lineno, "rule"))
+            elif name in ("record_action", "on_alert", "register"):
+                if (node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    kind = ("register" if name == "register"
+                            else "dispatch")
+                    uses.append((rel, node.args[0].value,
+                                 node.args[0].lineno, kind))
+    return uses
+
+
+# ---------------------------------------------------------------------------
 # startup-rejection sites -> compatibility-matrix rows
 # ---------------------------------------------------------------------------
 

@@ -214,6 +214,11 @@ def build_report(metrics_records: list[dict] | None,
         },
         "rounds": rounds,
         "alerts": timeline,
+        # reflex plane (ISSUE 20): the verdict carries the action log
+        # (timestamp-free, rule provenance on every entry) — lift it to
+        # a top-level block so the report answers "what did the run DO
+        # about its alerts" next to the alerts themselves
+        "actions": (verdict_doc or {}).get("actions"),
         "epsilon_ledger": ledger,
         "dispatch": {"fallbacks": fallbacks, "compiles": compiles,
                      "dispatches": dispatch_count},
@@ -278,6 +283,17 @@ def render_markdown(report: dict) -> str:
                 f"{_fmt(e['value'])})")
     else:
         lines.append("- none (a clean run)")
+    acts = report.get("actions")
+    if acts is not None and acts.get("mode", "unarmed") != "unarmed":
+        lines += ["", "## Reflex actions", "",
+                  f"- mode: `{acts['mode']}`; dispatches: "
+                  f"{_fmt(acts.get('total'))}"]
+        for e in acts.get("log", ()):
+            tag = " (dry_run)" if e.get("dry_run") else ""
+            lines.append(
+                f"- round {_fmt(e.get('round'))}: **{e['action']}** "
+                f"<- rule `{e['rule']}` [{e['status']}]{tag}"
+                + (f" {e['detail']}" if e.get("detail") else ""))
     ledger = report["epsilon_ledger"]
     if ledger["sources"] or ledger["per_silo"]:
         lines += ["", "## Epsilon ledger", ""]

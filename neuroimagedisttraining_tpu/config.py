@@ -170,9 +170,12 @@ class FedConfig:
     dp_epsilon_budget: float = 0.0
     # Deterministic fault injection + tolerance (faults/, ISSUE 2).
     # fault_spec grammar: "crash:RANK@ROUND,crash_prob:P,straggle:P:MAX_S,
-    # drop:P,dup:P,disconnect:P" (faults/schedule.parse_fault_spec); one
-    # config seed replays the identical fault trace in the simulated
-    # engines AND the multiprocess federation.
+    # drop:P,dup:P,disconnect:P,byz:RANK@ROUND:KIND,preempt:NDEV@ROUND"
+    # (faults/schedule.parse_fault_spec); one config seed replays the
+    # identical fault trace in the simulated engines AND the
+    # multiprocess federation. preempt: is the elastic-plane device loss
+    # (ISSUE 20): the engine shrinks client_mesh to NDEV survivors and
+    # resumes from the last checkpoint instead of dying.
     fault_spec: str = ""
     # Model-update wire codec (codec/, ISSUE 3): stages joined by '+'
     # from {delta, sparse, quant, quant16} or "none" (dense wire). In
@@ -285,6 +288,12 @@ class ExperimentConfig:
     health_rules: str = ""
     health_gate: bool = False
     metrics_out: str = ""
+    # Reflex plane (ISSUE 20, obs/actions.py): what a firing rule's
+    # declared action is allowed to DO — "off" (no dispatch), "dry_run"
+    # (log what WOULD fire; the default, so nothing changes behavior
+    # silently), "on" (registered handlers run: quarantine, defense
+    # escalation, buffer adaptation, freeze-and-rollback).
+    actions: str = "dry_run"
     # streaming mode: clients per host-fetched chunk for streamed eval /
     # phase-1 scoring / chunked DisPFL rounds; 0 = auto (mesh size or 4)
     stream_chunk_clients: int = 0

@@ -616,6 +616,18 @@ def main(argv=None) -> int:
                     help="epsilon budget the built-in DP health rules "
                          "judge against (dp-budget-exceeded / "
                          "dp-burn-rate); 0 = no budget rules")
+    ap.add_argument("--actions", type=str, default="dry_run",
+                    choices=("off", "dry_run", "on"),
+                    help="reflex plane (obs/actions.py, ISSUE 20) on "
+                         "the SERVER rank: what a firing health rule's "
+                         "declared action DOES. off = rules only "
+                         "observe; dry_run (default) = would-fire "
+                         "dispatches are logged/flight-recorded with "
+                         "rule provenance but nothing changes; on = "
+                         "actions apply (quarantine the struck silo "
+                         "through the strike machinery, escalate the "
+                         "defense ladder, halve the async buffer_k and "
+                         "raise staleness_alpha)")
     ap.add_argument("--client_mesh", type=int, default=0,
                     help="accepted for config parity with the main CLI; "
                          "each cross-silo rank trains only its own silo, "
@@ -1042,6 +1054,97 @@ def main(argv=None) -> int:
             comm_round=args.comm_round,
             max_staleness=args.max_staleness,
             extra_rules=extra_rules)
+        # reflex plane (obs/actions.py, ISSUE 20): the control plane's
+        # realizations of the reflex actions, registered on the LOCAL
+        # bus handle (disarm precedes the result-JSON write, exactly
+        # like ``hrules``). freeze_rollback/shrink_mesh have no
+        # control-plane realization — a rule binding them here logs an
+        # honest 'unhandled' dispatch instead of silently vanishing.
+        from neuroimagedisttraining_tpu.obs import (
+            actions as obs_actions,
+        )
+
+        bus = obs_actions.configure(args.actions)
+
+        # LOCKING: rules evaluate (and therefore dispatch actions)
+        # synchronously at the servers' own boundaries — cross_silo's
+        # round completion and asyncfl's version advance — which run
+        # UNDER ``server._rlock`` (a non-reentrant Lock). The handlers
+        # below therefore never acquire it: they execute on the thread
+        # that already holds it, so every mutation is serialized with
+        # the aggregation state they touch. (The end-of-run boundary
+        # evaluation happens after the control plane quiesced.)
+        def _act_quarantine(*, rule, round_idx, value=None):
+            # ride the PR 5 strike machinery's state: quarantine the
+            # most-struck non-quarantined silo, same byz_f budget and
+            # post-window ARG_EF_RESET debt the strike path keeps
+            cand = {c: n for c, n in server._strikes.items()
+                    if n > 0 and c not in server._quarantined_now()}
+            if not cand:
+                return {"status": "skipped",
+                        "reason": "no struck silo to attribute the "
+                                  "alert to"}
+            if len(server._quarantined_now()) >= max(1, server.byz_f):
+                return {"status": "skipped",
+                        "reason": f"quarantine budget (byz_f="
+                                  f"{server.byz_f}) spent"}
+            c = max(cand, key=lambda k: (cand[k], -k))
+            until = (server.round_idx + 1
+                     + max(1, server.quarantine_rounds))
+            server._quarantine_until[c] = until
+            server._strikes[c] = 0
+            server._ef_reset_pending.add(c)
+            server.byz_stats["quarantines"].append(
+                {"client": c, "from_round": server.round_idx + 1,
+                 "until_round": until})
+            return {"client": c, "from_round": server.round_idx + 1,
+                    "until": until, "strikes": cand[c]}
+
+        def _act_escalate(*, rule, round_idx, value=None):
+            from neuroimagedisttraining_tpu.core import robust
+            ladder = ("none", "norm_diff_clipping", "trimmed_mean")
+            if args.secure or args.secure_quant:
+                return {"status": "skipped",
+                        "reason": "secure planes clip client-side; no "
+                                  "server defend tail to escalate"}
+            cur = server.defense
+            if cur not in ladder:
+                return {"status": "skipped",
+                        "reason": f"operator defense {cur!r} is "
+                                  "outside the escalation ladder"}
+            if cur == ladder[-1]:
+                return {"status": "skipped",
+                        "reason": f"already at the top rung {cur!r}"}
+            nxt = ladder[ladder.index(cur) + 1]
+            if nxt in robust.ROBUST_AGGREGATORS:
+                try:
+                    robust._check_f(args.num_clients, server.byz_f,
+                                    nxt)
+                except ValueError as e:
+                    return {"status": "skipped", "reason": str(e)}
+            server.defense = nxt
+            return {"from": cur, "to": nxt}
+
+        bus.register("quarantine_silo", _act_quarantine)
+        bus.register("escalate_defense", _act_escalate)
+        if args.async_server:
+            def _act_adapt_buffer(*, rule, round_idx, value=None):
+                # staleness runaway => aggregate more eagerly (halve
+                # the trigger) and discount stale arrivals harder
+                old_k = server.buffer_k
+                old_a = server.staleness_alpha
+                new_k = max(1, (old_k + 1) // 2)
+                new_a = min(old_a + 0.25, 2.0)
+                if new_k == old_k and new_a == old_a:
+                    return {"status": "skipped",
+                            "reason": "buffer_k at its floor and "
+                                      "staleness_alpha at its cap"}
+                server.buffer_k = new_k
+                server.staleness_alpha = new_a
+                return {"buffer_k": [old_k, new_k],
+                        "staleness_alpha": [old_a, new_a]}
+
+            bus.register("adapt_buffer", _act_adapt_buffer)
 
         def _health() -> dict:
             # scrape-thread probe with a BOUNDED lock wait: _rlock is
@@ -1060,7 +1163,10 @@ def main(argv=None) -> int:
                 # wedged dispatch is exactly when the probe matters
                 return {"busy": True,
                         "compute": obs_compute.PROFILER.health(),
-                        "health": obs_rules.health_block()}
+                        "health": obs_rules.health_block(),
+                        # action log is bus-internal state, lock-free
+                        # w.r.t. _rlock — it rides the busy report too
+                        "actions": bus.actions_block()}
             try:
                 # rules evaluate once per completed round at the
                 # servers' own boundaries (cross_silo round completion /
@@ -1080,7 +1186,10 @@ def main(argv=None) -> int:
                      "fallbacks": obs_health.fallback_block(
                          server.fanin.merged_snapshot()
                          if args.ingest_workers else None),
-                     "health": obs_rules.health_block()}
+                     "health": obs_rules.health_block(),
+                     # the last reflex dispatches, rule provenance
+                     # included (ISSUE 20)
+                     "actions": bus.actions_block()}
                 if args.async_server:
                     h["buffered"] = (server._pending()
                                      if args.ingest_workers
@@ -1133,6 +1242,7 @@ def main(argv=None) -> int:
                 # (the success path disarms after the final boundary
                 # evaluation below)
                 obs_rules.disarm()
+                obs_actions.disarm()
         if broker is not None:
             broker.stop()
         norm = float(np.sqrt(sum(
@@ -1142,8 +1252,10 @@ def main(argv=None) -> int:
         extra = {}
         if args.async_server:
             extra = {"async_server": True,
+                     # live server values, not the flags: adapt_buffer
+                     # (ISSUE 20) may have changed them mid-run
                      "buffer_k": server.buffer_k,
-                     "staleness_alpha": args.staleness_alpha,
+                     "staleness_alpha": server.staleness_alpha,
                      "max_staleness": args.max_staleness,
                      "upload_audit": server.upload_audit(),
                      "staleness_taus": sorted({
@@ -1174,11 +1286,15 @@ def main(argv=None) -> int:
             obs_rules.observe_boundary(int(server.round_idx))
         health_verdict = hrules.verdict()
         obs_rules.disarm()
+        obs_actions.disarm()  # local ``bus`` handle still readable
         extra["health"] = {
             k: health_verdict[k]
             for k in ("status", "worst_status", "alerts_total",
                       "rounds_evaluated")}
         extra["health_timeline"] = health_verdict["timeline"]
+        # the reflex action log (timestamp-free: twin seeded chaos runs
+        # produce byte-identical blocks) rides the result JSON
+        extra["actions"] = bus.actions_block()
         print(json.dumps({"rounds_completed": len(server.history),
                           "clients": args.num_clients,
                           "secure": bool(args.secure),

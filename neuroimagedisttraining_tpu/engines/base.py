@@ -12,6 +12,7 @@ the ``stat_info`` accumulators (sailentgrads_api.py:334-346).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any
 
@@ -31,13 +32,18 @@ from neuroimagedisttraining_tpu.faults.schedule import (
     FaultSchedule, parse_fault_spec,
 )
 from neuroimagedisttraining_tpu.engines import program as round_program
+from neuroimagedisttraining_tpu.obs import actions as obs_actions
 from neuroimagedisttraining_tpu.obs import compute as obs_compute
+from neuroimagedisttraining_tpu.obs import flight as obs_flight
 from neuroimagedisttraining_tpu.obs import health as obs_health
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import names as obs_names
 from neuroimagedisttraining_tpu.obs import rules as obs_rules
 from neuroimagedisttraining_tpu.obs import trace as obs_trace
 from neuroimagedisttraining_tpu.parallel import cohort
+from neuroimagedisttraining_tpu.parallel.mesh import (
+    client_sharding, make_mesh, replicated_sharding,
+)
 from neuroimagedisttraining_tpu.utils import checkpoint as ckpt
 from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger, get_logger
 from neuroimagedisttraining_tpu.utils import pytree as pt
@@ -107,6 +113,22 @@ class FederatedEngine:
         self.log = logger or ExperimentLogger(cfg.log_dir, cfg.data.dataset,
                                               cfg.identity())
         self._console = get_logger()
+        # reflex plane (ISSUE 20, obs/actions.py): engine-side state the
+        # registered action handlers mutate. Initialized EARLY — the
+        # ctor below may build round programs, and the builder's
+        # aggregate tail reads ``active_defense()`` at trace time.
+        # Quarantine windows are (from_round, until_round) pairs keyed
+        # by client: a pure function of the round index, so
+        # ``record_privacy``'s cohort re-derivation replays exactly the
+        # cohorts training used (windows only ever start AFTER the
+        # round that fired them).
+        self._quarantine_windows: dict[int, list[tuple[int, int]]] = {}
+        self._sampled_by_round: dict[int, np.ndarray] = {}
+        self._last_health_rows: dict[int, dict] = {}
+        self._defense_override: str | None = None
+        self._healthy_pin: dict | None = None
+        self._pending_rollback: dict | None = None
+        self._preempts_done: set[int] = set()
         if stream is not None and not self.supports_streaming:
             from neuroimagedisttraining_tpu.engines import ENGINES
             ok = sorted({c.name for c in ENGINES.values()
@@ -399,6 +421,19 @@ class FederatedEngine:
             # aggregation over the survivor set re-weights by sample
             # count exactly as a frac-sampled round would
             sampled = self.fault_schedule.survivors(round_idx, sampled)
+        if self._quarantine_windows:
+            # reflex quarantine (ISSUE 20): clients inside an active
+            # window drop out of the cohort, same re-weighting as a
+            # crash. If every sampled client is quarantined the filter
+            # is skipped — an empty round has no reference semantics
+            # (the survivors() rule).
+            keep = np.asarray(
+                [not self._is_quarantined(int(c), round_idx)
+                 for c in np.asarray(sampled)], bool)
+            if keep.any():
+                sampled = np.asarray(sampled)[keep]
+        self._stash_bounded(self._sampled_by_round, int(round_idx),
+                            np.asarray(sampled))
         if len(sampled) == 0:
             # ADVICE r5: an empty cohort used to surface as a bare
             # IndexError from stream_sampling's ``sampled[-1]`` pad fill
@@ -860,6 +895,12 @@ class FederatedEngine:
                 else:
                     row = host
                 obs_health.publish_round_stats(self.name, r, row)
+                # stash the host row BEFORE the boundary evaluation:
+                # a divergence alert fired at this round must be able
+                # to attribute the offender from its h_cos vector
+                # (the reflex quarantine handler, ISSUE 20)
+                self._stash_bounded(self._last_health_rows, int(r),
+                                    dict(row))
                 if r < round_idx:
                     # the flush round itself dumps/evaluates in
                     # publish_stat_info, AFTER the stat/DP gauges of
@@ -976,6 +1017,290 @@ class FederatedEngine:
         # when the sink / rule engine is unarmed)
         self._dump_metrics_jsonl(round_idx)
         obs_rules.observe_boundary(round_idx)
+
+    # ---------- reflex plane (obs/actions.py, ISSUE 20) ----------
+
+    #: bound on the per-round host stashes the reflex handlers read
+    #: (sampled cohorts, drained health rows): old rounds evict oldest-
+    #: first — a handler only ever looks a few boundaries back
+    _REFLEX_STASH_CAP = 64
+
+    #: rounds an engine-side reflex quarantine lasts. The cross-silo
+    #: control plane has an operator knob (--quarantine_rounds); the
+    #: in-process reflex uses one fixed conservative window — the alert
+    #: that fired it re-fires if the divergence survives the window
+    _REFLEX_QUARANTINE_ROUNDS = 5
+
+    #: the escalation ladder (ISSUE 20): each rung strictly stronger.
+    #: Deliberately short — weak_dp and the order statistics beyond
+    #: trimmed_mean change the privacy/accuracy contract in ways a
+    #: reflex must not decide on its own
+    _DEFENSE_LADDER = ("none", "norm_diff_clipping", "trimmed_mean")
+
+    @staticmethod
+    def _stash_bounded(d: dict, key: int, value) -> None:
+        d[key] = value
+        while len(d) > FederatedEngine._REFLEX_STASH_CAP:
+            d.pop(min(d))
+
+    def _is_quarantined(self, client: int, round_idx: int) -> bool:
+        return any(a <= round_idx < b
+                   for a, b in self._quarantine_windows.get(client, ()))
+
+    def active_defense(self) -> str:
+        """The defense the round programs realize RIGHT NOW: the config
+        literal unless the reflex plane escalated it. The builder's
+        sanitize/defend/aggregate tail reads this at TRACE time
+        (engines/program.py), so escalation invalidates the compiled
+        programs and the next dispatch re-traces through here."""
+        return self._defense_override or self.cfg.fed.defense_type
+
+    def _invalidate_round_programs(self) -> None:
+        """Drop every compiled round program and plan cache so the next
+        dispatch re-traces/re-plans against the CURRENT engine state
+        (escalated defense, shrunken mesh). The caches are lazy
+        cached-properties / plan dicts in ``__dict__`` — popping them
+        is the whole invalidation."""
+        for name in ("program", "_round_jit", "_round_stream_jit",
+                     "_round_prog_cache", "_fused_round_jit_cache"):
+            self.__dict__.pop(name, None)
+
+    def _register_reflexes(self) -> None:
+        """Register this engine's realizations of the reflex actions on
+        the armed action bus — a no-op when none is armed (tests and
+        library callers run engines without the CLI). Called at
+        ``train()`` start; registration is latest-wins, so repeated
+        trains re-arm cleanly."""
+        bus = obs_actions.active()
+        if bus is None:
+            return
+        bus.register("quarantine_silo", self._act_quarantine)
+        bus.register("escalate_defense", self._act_escalate_defense)
+        bus.register("freeze_rollback", self._act_freeze_rollback)
+
+    def _act_quarantine(self, *, rule: str, round_idx: int | None,
+                        value=None) -> dict:
+        """quarantine_silo: attribute the divergence alert to the
+        sampled client with the most negative leave-one-out cosine
+        (the stashed ``h_cos`` row of the firing round) and open a
+        quarantine window starting NEXT round. Concurrency is capped at
+        the configured Byzantine budget — the same breakdown-point
+        honesty the cross-silo strike machinery keeps."""
+        r = -1 if round_idx is None else int(round_idx)
+        sampled = self._sampled_by_round.get(r)
+        row = self._last_health_rows.get(r)
+        cos = None if row is None else row.get("h_cos")
+        if sampled is None or cos is None:
+            return {"status": "skipped",
+                    "reason": "no per-client cosine row for the round "
+                              "(--health_stats off, or pre-health "
+                              "boundary)"}
+        cos = np.ravel(np.asarray(cos))
+        n = min(len(sampled), cos.size)
+        if n == 0:
+            return {"status": "skipped", "reason": "empty cohort"}
+        offender = int(np.asarray(sampled)[int(np.argmin(cos[:n]))])
+        if self._is_quarantined(offender, r + 1):
+            return {"status": "skipped", "client": offender,
+                    "reason": "offender already quarantined"}
+        cap = max(1, int(self.cfg.fed.byz_f))
+        active_now = sum(1 for c in self._quarantine_windows
+                        if self._is_quarantined(c, r + 1))
+        if active_now >= cap:
+            return {"status": "skipped",
+                    "reason": f"quarantine cap {cap} (byz_f) reached"}
+        until = r + 1 + self._REFLEX_QUARANTINE_ROUNDS
+        self._quarantine_windows.setdefault(offender, []).append(
+            (r + 1, until))
+        self.log.warning(
+            "reflex: client %d quarantined rounds [%d, %d) (rule %s, "
+            "min leave-one-out cosine %.3f)", offender, r + 1, until,
+            rule, float(cos[:n].min()))
+        return {"client": offender, "from_round": r + 1, "until": until,
+                "cos": float(cos[:n].min())}
+
+    def _act_escalate_defense(self, *, rule: str,
+                              round_idx: int | None,
+                              value=None) -> dict:
+        """escalate_defense: step the ladder one rung and re-plan the
+        round programs. Anything infeasible — an operator-chosen
+        defense outside the ladder, an engine without the rung, a
+        cohort below the rung's breakdown point, secure_quant's
+        no-plaintext tail — is a SKIPPED dispatch with the reason in
+        the action log, never an exception."""
+        cur = self.active_defense()
+        ladder = self._DEFENSE_LADDER
+        if self.cfg.fed.secure_quant:
+            return {"status": "skipped",
+                    "reason": "secure_quant rounds have no plaintext "
+                              "defend tail to escalate"}
+        if cur not in ladder:
+            return {"status": "skipped",
+                    "reason": f"operator defense {cur!r} is outside "
+                              "the escalation ladder"}
+        if cur == ladder[-1]:
+            return {"status": "skipped",
+                    "reason": f"already at the top rung {cur!r}"}
+        nxt = ladder[ladder.index(cur) + 1]
+        if nxt not in self.supported_defenses:
+            return {"status": "skipped",
+                    "reason": f"engine {self.name!r} does not support "
+                              f"{nxt!r}"}
+        if nxt in robust.ROBUST_AGGREGATORS:
+            try:
+                robust._check_f(self.cfg.fed.client_num_per_round,
+                                self.cfg.fed.byz_f, nxt)
+            except ValueError as e:
+                return {"status": "skipped", "reason": str(e)}
+        self._defense_override = nxt
+        self._invalidate_round_programs()
+        self.log.warning(
+            "reflex: defense escalated %s -> %s (rule %s); round "
+            "programs invalidated for re-trace", cur, nxt, rule)
+        return {"from": cur, "to": nxt}
+
+    def _act_freeze_rollback(self, *, rule: str,
+                             round_idx: int | None,
+                             value=None) -> dict:
+        """freeze_rollback: schedule a restore of the last healthy
+        pinned state; the driver consumes it at the NEXT host boundary
+        (``_reflex_boundary``) — never mid-dispatch, so the donation
+        contract is untouched."""
+        if self._healthy_pin is None:
+            return {"status": "skipped",
+                    "reason": "no healthy pinned state yet"}
+        self._pending_rollback = {
+            "rule": rule,
+            "round": -1 if round_idx is None else int(round_idx)}
+        return {"pin_round": int(self._healthy_pin["round"])}
+
+    def _reflex_boundary(self, round_idx: int, params, bstats):
+        """The drivers' per-boundary reflex hook, called right after
+        ``_flush_nonfinite`` (whose rule evaluation may have scheduled
+        a rollback): consume a pending freeze-and-rollback, else pin
+        the current state as 'last healthy' while the rule engine
+        reads ok. Pin and restore both take fresh ``jnp.array`` copies
+        — the round programs donate their state arguments, so the pin
+        must own buffers no dispatch can consume, and the restored
+        arrays must be consumable without killing the pin."""
+        pend = self._pending_rollback
+        if pend is not None:
+            self._pending_rollback = None
+            pin = self._healthy_pin
+            if pin is not None:
+                params = jax.tree.map(jnp.array, pin["params"])
+                bstats = jax.tree.map(jnp.array, pin["batch_stats"])
+                if getattr(self, "_wire_ef", None) is not None:
+                    # codec-EF reset invariant (ARCHITECTURE.md "Reflex
+                    # plane"): the accumulated error was measured
+                    # against states the rollback just discarded —
+                    # replaying it would re-inject the divergence the
+                    # rollback removed
+                    self._wire_ef = jax.tree.map(jnp.zeros_like,
+                                                 self._wire_ef)
+                obs_flight.record("rollback", rule=pend.get("rule"),
+                                  round=int(round_idx),
+                                  pin_round=int(pin["round"]))
+                self.log.warning(
+                    "reflex: rolled back to the healthy state of round "
+                    "%d at boundary %d (rule %s); codec EF reset",
+                    pin["round"], round_idx, pend.get("rule"))
+            return params, bstats
+        bus = obs_actions.active()
+        if bus is not None and bus.mode == "on":
+            rules_eng = obs_rules.active()
+            if rules_eng is None or rules_eng.status() == "ok":
+                self._healthy_pin = {
+                    "round": int(round_idx),
+                    "params": jax.tree.map(jnp.array, params),
+                    "batch_stats": jax.tree.map(jnp.array, bstats)}
+        return params, bstats
+
+    @staticmethod
+    def _regather_live(tree):
+        """Host-gather a live pytree off the pre-preemption devices and
+        re-place it as fresh uncommitted buffers. The no-checkpoint
+        resume path keeps training on the live state — but that state
+        is committed to the OLD mesh's devices, and the re-planned
+        programs shard over the survivors only."""
+        return jax.tree.map(lambda x: jnp.array(np.asarray(x)), tree)
+
+    def _maybe_preempt(self, round_idx: int):
+        """Elastic compute plane (ISSUE 20): consume any scheduled
+        ``preempt:NDEV@ROUND`` whose round has arrived (``<=`` — fused
+        windows skip indices), shrink the training mesh to the NDEV
+        survivors, re-plan every compiled program, and return
+        ``(resume_round, restored_state | None)`` from the last
+        donation-safe checkpoint. Returns None when nothing fired.
+        Deliberately NOT gated by ``--actions``: an explicitly injected
+        device loss is an event, not a reflex policy — the armed bus
+        records it with the device-loss event as provenance either
+        way."""
+        if self.fault_schedule is None:
+            return None
+        hits = [(at, nd) for (at, nd)
+                in self.fault_schedule.spec.preempts
+                if at <= round_idx and at not in self._preempts_done]
+        if not hits:
+            return None
+        at, ndev = hits[0]
+        self._preempts_done.add(at)
+        old = self.mesh.devices.size if self.mesh is not None else 0
+        if self.mesh is None or not 0 < ndev < old:
+            obs_actions.record_action(
+                "shrink_mesh", rule="device-loss",
+                round_idx=round_idx, status="skipped",
+                detail={"reason": ("no mesh to shrink"
+                                   if self.mesh is None else
+                                   f"{ndev} survivors do not shrink "
+                                   f"the {old}-device mesh"),
+                        "scheduled_round": int(at)})
+            return None
+        self.mesh = make_mesh(num_devices=ndev)
+        if int(self.cfg.fed.client_mesh) > 0:
+            # keep the client_mesh == mesh-size startup invariant so
+            # the re-planned programs shard over exactly the survivors
+            self.cfg = dataclasses.replace(
+                self.cfg, fed=dataclasses.replace(self.cfg.fed,
+                                                  client_mesh=ndev))
+        self._invalidate_round_programs()
+        if self.data is not None:
+            # the federation was device_put with the OLD mesh's client
+            # sharding at federate time (data/federate.py); arrays still
+            # committed to evicted devices would poison every re-planned
+            # dispatch ("incompatible devices"). Host-gather and re-place
+            # over the survivors — client-sharded while the padded client
+            # count still divides them, replicated otherwise (the round
+            # programs re-shard internally either way).
+            sh = (client_sharding(self.mesh)
+                  if self.data.num_clients % ndev == 0
+                  else replicated_sharding(self.mesh))
+            self.data = jax.tree.map(
+                lambda x: jax.device_put(np.asarray(x), sh), self.data)
+        if self._cohort_on:
+            # the shrunken mesh may or may not still shard (mode checks
+            # re-run against the new plan)
+            self._cohort_on = self.program.cohort_fallback_key() is None
+        start, restored = self.restore_checkpoint()
+        if restored is not None and getattr(self, "_wire_ef", None) is not None:
+            # match a fresh-process resume exactly: EF accumulators are
+            # not checkpointed, so a from-checkpoint replay starts them
+            # at zero — the elastic resume must too, or the pinned
+            # replay parity breaks
+            self._wire_ef = jax.tree.map(jnp.zeros_like, self._wire_ef)
+        self.log.warning(
+            "preemption at round %d (scheduled @%d): mesh shrunk "
+            "%d -> %d devices; resuming from %s", round_idx, at, old,
+            ndev, (f"checkpoint round {start}" if restored is not None
+                   else "live state (no checkpoint configured)"))
+        obs_actions.record_action(
+            "shrink_mesh", rule="device-loss", round_idx=round_idx,
+            detail={"devices_before": int(old),
+                    "devices_after": int(ndev),
+                    "scheduled_round": int(at),
+                    "resume_round": (int(start) if restored is not None
+                                     else int(round_idx))})
+        return start, restored
 
     # ---------- compute-plane profiler (obs/compute.py, ISSUE 14) ----------
 

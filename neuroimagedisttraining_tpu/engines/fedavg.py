@@ -265,6 +265,7 @@ class FedAvgEngine(FederatedEngine):
         if self.stream is not None:
             return self._train_streaming()
         cfg = self.cfg
+        self._register_reflexes()
         start, restored = self.restore_checkpoint()
         if restored is not None:
             params, bstats = restored["params"], restored["batch_stats"]
@@ -288,6 +289,22 @@ class FedAvgEngine(FederatedEngine):
                 and self.fused_fallback_reason() is None)
         round_idx = start
         while round_idx < cfg.fed.comm_round:
+            # elastic compute plane (ISSUE 20): a scheduled device loss
+            # shrinks the mesh mid-run; resume from the donation-safe
+            # checkpoint when one exists, else continue on the live
+            # state over the survivors
+            pre = self._maybe_preempt(round_idx)
+            if pre is not None:
+                if pre[1] is not None:
+                    round_idx, restored = pre
+                    params, bstats = (restored["params"],
+                                      restored["batch_stats"])
+                    history = restored["history"]
+                    continue
+                # no checkpoint: continue on the live state over the
+                # survivors — off the evicted devices first
+                params = self._regather_live(params)
+                bstats = self._regather_live(bstats)
             k = self._dispatch_window(round_idx) if fuse else 1
             if k > 1:
                 params, bstats, loss, k = self._run_fused_window(
@@ -350,6 +367,11 @@ class FedAvgEngine(FederatedEngine):
                     or round_idx == cfg.fed.comm_round - 1:
                 m = self.eval_global(params, bstats)
                 self._flush_nonfinite(round_idx)
+                # the rule evaluation inside the flush may have fired
+                # freeze_rollback; consume it (or pin healthy state) at
+                # this host boundary, never mid-dispatch
+                params, bstats = self._reflex_boundary(round_idx, params,
+                                                       bstats)
                 self.stat_info["global_test_acc"].append(m["acc"])
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx, "train_loss": float(loss),
@@ -380,6 +402,7 @@ class FedAvgEngine(FederatedEngine):
         device each round (double-buffered host reads), and evaluation +
         the final fine-tune pass stream the cohort in client chunks."""
         cfg = self.cfg
+        self._register_reflexes()
         start, restored = self.restore_checkpoint()
         if restored is not None:
             params, bstats = restored["params"], restored["batch_stats"]
@@ -398,6 +421,21 @@ class FedAvgEngine(FederatedEngine):
         self._stream_prefetch_for(start)
         round_idx = start
         while round_idx < cfg.fed.comm_round:
+            pre = self._maybe_preempt(round_idx)
+            if pre is not None:
+                if pre[1] is not None:
+                    round_idx, restored = pre
+                    params, bstats = (restored["params"],
+                                      restored["batch_stats"])
+                    history = restored["history"]
+                    # the prefetched shards targeted the pre-preemption
+                    # round; re-key the feed to the resume point (a key
+                    # mismatch would degrade to a fresh fetch anyway)
+                    self._stream_prefetch_for(round_idx)
+                    continue
+                params = self._regather_live(params)
+                bstats = self._regather_live(bstats)
+                self._stream_prefetch_for(round_idx)
             k = self._dispatch_window(round_idx) if fuse else 1
             if k > 1:
                 params, bstats, loss, k = self._run_fused_stream_window(
@@ -427,6 +465,8 @@ class FedAvgEngine(FederatedEngine):
                     or round_idx == cfg.fed.comm_round - 1:
                 m = self.eval_global_stream(params, bstats)
                 self._flush_nonfinite(round_idx)
+                params, bstats = self._reflex_boundary(round_idx, params,
+                                                       bstats)
                 self.stat_info["global_test_acc"].append(m["acc"])
                 self.log.metrics(round_idx, train_loss=loss, **m)
                 history.append({"round": round_idx,

@@ -412,7 +412,10 @@ def sanitize_defend_aggregate(eng, upload, ref, w, losses, rngs=None):
     n_bad = jnp.sum(~finite).astype(jnp.int32)
     w = w * finite.astype(jnp.float32)
     C = int(jax.tree.leaves(upload)[0].shape[0])
-    defense = robust.effective_defense(f.defense_type, C, f.byz_f,
+    # the engine's ACTIVE defense, not the config literal: the reflex
+    # plane's escalate_defense handler can raise it mid-run (ISSUE 20),
+    # after which the invalidated round programs re-trace through here
+    defense = robust.effective_defense(eng.active_defense(), C, f.byz_f,
                                        warn=eng.log.warning)
     if defense in robust.ROBUST_AGGREGATORS:
         agg = robust.robust_aggregate(
@@ -581,6 +584,11 @@ def health_update_stats(upload, ref, new_params, w) -> dict:
         "h_disp": jnp.max(norms) / jnp.maximum(med, jnp.float32(1e-12)),
         "h_gnorm": jnp.sqrt(gsq),
         "h_agg_up": agg_norm,
+        # the full [C] leave-one-out cosine vector rides out too (no
+        # gauge — the reflex plane's quarantine handler attributes a
+        # divergence alert to the offending SAMPLED client with it;
+        # engines/base.py _register_reflexes, ISSUE 20)
+        "h_cos": cos,
     }
 
 

@@ -102,6 +102,13 @@ class FaultSpec:
     byz: tuple[tuple[int, int, str], ...] = ()
     byz_prob: float = 0.0
     byz_kind: str = "sign_flip"
+    # device preemption (ISSUE 20, elastic compute plane): (round, ndev)
+    # — at ROUND the training mesh loses devices down to NDEV survivors;
+    # the engine re-plans client_mesh over them and resumes from the
+    # last checkpoint (engines/base.py _maybe_preempt). A COMPUTE-plane
+    # fault: it never corrupts upload values (any_value_faults excludes
+    # it) and never touches the client-liveness streams.
+    preempts: tuple[tuple[int, int], ...] = ()
 
     def __post_init__(self) -> None:
         # a rejoin without an earlier deterministic crash for the same
@@ -122,7 +129,8 @@ class FaultSpec:
 
     @property
     def any_faults(self) -> bool:
-        return bool(self.crashes) or bool(self.byz) or any(
+        return bool(self.crashes) or bool(self.byz) \
+            or bool(self.preempts) or any(
             p > 0 for p in (self.crash_prob, self.straggle_prob,
                             self.drop_prob, self.dup_prob,
                             self.disconnect_prob, self.byz_prob))
@@ -154,12 +162,18 @@ def parse_fault_spec(text: str) -> FaultSpec:
                                 gauss:STD | nonfinite
         byz_prob:P[:KIND]       per-(round, rank) transient value fault
                                 of KIND (default sign_flip)
+        preempt:NDEV@ROUND      device preemption: at ROUND the training
+                                mesh loses devices down to NDEV
+                                survivors; the engine shrinks
+                                client_mesh and resumes from the last
+                                checkpoint (elastic plane, ISSUE 20)
 
     e.g. ``"crash:3@1,rejoin:3@4,drop:0.1,byz:1@0:sign_flip"``. Empty
     string => no faults."""
     crashes: list[tuple[int, int]] = []
     rejoins: list[tuple[int, int]] = []
     byz: list[tuple[int, int, str]] = []
+    preempts: list[tuple[int, int]] = []
     kw: dict = {}
     for part in text.replace(";", ",").split(","):
         part = part.strip()
@@ -189,6 +203,14 @@ def parse_fault_spec(text: str) -> FaultSpec:
                 p_s, _, d_s = rest.partition(":")
                 kw["straggle_prob"] = float(p_s)
                 kw["straggle_delay"] = float(d_s)
+            elif key == "preempt":
+                ndev_s, _, round_s = rest.partition("@")
+                ndev, at = int(ndev_s), int(round_s)
+                if ndev < 1:
+                    raise ValueError(
+                        "preempt needs NDEV >= 1 survivors "
+                        "(preempt:NDEV@ROUND)")
+                preempts.append((at, ndev))
             elif key == "crash_prob":
                 kw["crash_prob"] = float(rest)
             elif key in ("drop", "dup", "disconnect"):
@@ -205,7 +227,8 @@ def parse_fault_spec(text: str) -> FaultSpec:
             raise ValueError(f"--fault_spec {name}={p} not in [0, 1]")
     try:
         return FaultSpec(crashes=tuple(crashes), rejoins=tuple(rejoins),
-                         byz=tuple(byz), **kw)
+                         byz=tuple(byz),
+                         preempts=tuple(sorted(preempts)), **kw)
     except ValueError as e:  # rejoin-without-crash cross-validation
         raise ValueError(f"bad --fault_spec: {e}") from None
 

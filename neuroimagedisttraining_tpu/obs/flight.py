@@ -3,7 +3,10 @@
 A chaos run that goes wrong leaves logs measured in megabytes and a
 stack trace measured in one frame. This recorder keeps the LAST N
 control-plane decisions — accept / drop / strike / quarantine /
-deadline / rejoin / EF-reset / superseded-in-buffer — as structured
+deadline / rejoin / EF-reset / superseded-in-buffer / action /
+action_dry_run (the reflex plane's rule->action dispatches,
+obs/actions.py, each carrying its firing rule as provenance) — as
+structured
 records in a bounded ring (``collections.deque(maxlen=N)``), so the
 post-mortem question "what did the server decide in the 30 seconds
 before it died?" has a machine-readable answer.

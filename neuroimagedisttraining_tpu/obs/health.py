@@ -60,6 +60,12 @@ UPDATE_STAT_NAMES: tuple[str, ...] = (
     "h_agg_up",      # L2 norm of the aggregated update (the round's
                      # pseudo-gradient — "global grad norm" at the
                      # server, where per-example grads never exist)
+    "h_cos",         # [C] per-client leave-one-out cosine vector —
+                     # no gauge of its own (publish_round_stats skips
+                     # unknown keys); the reflex plane's quarantine
+                     # handler reads it host-side to ATTRIBUTE a
+                     # client-divergence alert to the offending
+                     # sampled client (engines/base.py, ISSUE 20)
 )
 
 #: stats a masked engine's ``RoundStages.health`` hook emits

@@ -113,6 +113,11 @@ SERVE_WORKER_REQUESTS = "nidt_serve_worker_requests_total"
 # -- anomaly-rule engine (obs/rules.py) --
 ALERT = "nidt_alert"
 
+# -- reflex plane (obs/actions.py, ISSUE 20): rule->action dispatches
+#    by action name and outcome status (applied / dry_run / unhandled /
+#    skipped / error) --
+ACTIONS_TOTAL = "nidt_actions_total"
+
 # -- autotuner recipes (tune/recipe.py): the loaded recipe's recorded
 #    score, published so the mfu-below-recipe drift rule's threshold is
 #    scrapeable next to the live nidt_mfu it is compared against --

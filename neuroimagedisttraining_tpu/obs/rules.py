@@ -20,7 +20,11 @@ Outcomes of one evaluation:
 - a ``health`` block for ``/healthz``: ``ok`` / ``degraded`` (a warn
   rule firing) / ``critical``;
 - a machine-readable end-of-run ``verdict()`` — what ``--health_gate``
-  exits nonzero on and ``analysis/run_report.py`` joins.
+  exits nonzero on and ``analysis/run_report.py`` joins;
+- a REFLEX dispatch (ISSUE 20) on every rising edge of a rule that
+  declares an ``action``: the name resolves against the registry in
+  ``obs/actions.py`` at startup and dispatches through the armed
+  action bus — gated by ``--actions {off,dry_run,on}``.
 
 Validation is a STARTUP contract (the health-rule-discipline
 satellite): every rule's metric must be in the declared-name set
@@ -54,6 +58,7 @@ import threading
 from collections import deque
 from typing import Any, Iterable, Mapping
 
+from neuroimagedisttraining_tpu.obs import actions as obs_actions
 from neuroimagedisttraining_tpu.obs import flight as obs_flight
 from neuroimagedisttraining_tpu.obs import metrics as obs_metrics
 from neuroimagedisttraining_tpu.obs import names as N
@@ -100,6 +105,11 @@ class HealthRule:
     #: operator action it recommends (the autotuner's mfu-below-recipe
     #: rule records ``retune_recommended``; tune/recipe.py)
     on_fire_event: str = ""
+    #: optional REFLEX action (obs/actions.py BUILTIN_ACTIONS)
+    #: dispatched through the armed action bus on every rising edge —
+    #: how a rule DOES something instead of only alerting (ISSUE 20);
+    #: gated by ``--actions {off,dry_run,on}``, validated at startup
+    action: str = ""
 
     def validate(self, known: frozenset[str]) -> None:
         if self.metric not in known:
@@ -135,6 +145,12 @@ class HealthRule:
         if not math.isfinite(float(self.threshold)):
             raise ValueError(
                 f"health rule {self.name!r}: threshold must be finite")
+        if self.action and self.action not in obs_actions.BUILTIN_ACTIONS:
+            raise ValueError(
+                f"health rule {self.name!r}: unknown action "
+                f"{self.action!r}; registered actions "
+                f"(obs/actions.py BUILTIN_ACTIONS): "
+                f"{sorted(obs_actions.BUILTIN_ACTIONS)}")
 
 
 def _hist_p99(cell: Mapping[str, Any]) -> float | None:
@@ -280,6 +296,15 @@ class RuleEngine:
                 obs_flight.record(r.on_fire_event, rule=e["rule"],
                                   round=e["round"],
                                   value=e.get("value"))
+            if e["kind"] == "alert" and r is not None and r.action:
+                # reflex dispatch (ISSUE 20): the rising edge DOES
+                # something through the armed action bus (a no-op when
+                # none is armed; dry_run only logs). Outside the lock —
+                # handlers may re-enter observability paths.
+                obs_actions.on_alert(r.action, rule=r.name,
+                                     severity=r.severity,
+                                     round_idx=e["round"],
+                                     value=e.get("value"))
         return edges
 
     def _select(self, rule: HealthRule, snap: dict) -> float | None:
@@ -385,6 +410,7 @@ class RuleEngine:
                     "last_value": st.last_value,
                     "last_round": st.last_round,
                     "description": r.description,
+                    "action": r.action,
                 })
             return obs_metrics._json_safe({
                 "status": self._status_locked(),
@@ -412,10 +438,20 @@ def builtin_rules(dp_epsilon_budget: float = 0.0, comm_round: int = 200,
         HealthRule(
             name="client-divergence", metric=N.HEALTH_COSINE_MIN,
             op="<", threshold=-0.2, severity="critical",
+            action="quarantine_silo",
             description=(
                 "a client update points AGAINST the aggregated update "
                 "(sign-flip Byzantine, or non-IID divergence past what "
                 "FedProx-style proximal terms absorb)")),
+        HealthRule(
+            name="defense-escalation", metric=N.HEALTH_COSINE_MIN,
+            op="<", threshold=-0.5, severity="warn",
+            action="escalate_defense",
+            description=(
+                "a strongly anti-aligned client update (cosine < -0.5) "
+                "is an attack signature, not non-IID drift — escalate "
+                "the robust-aggregation ladder one rung (none -> "
+                "norm_diff_clipping -> trimmed_mean)")),
         HealthRule(
             name="update-norm-collapse",
             metric=N.HEALTH_UPDATE_NORM_MED, op="<", threshold=1e-7,
@@ -427,6 +463,7 @@ def builtin_rules(dp_epsilon_budget: float = 0.0, comm_round: int = 200,
         HealthRule(
             name="update-norm-blowup", metric=N.HEALTH_DIVERGENCE,
             op=">", threshold=50.0, for_rounds=2, severity="warn",
+            action="freeze_rollback",
             description=(
                 "max/median client update-norm dispersion: one silo's "
                 "update dwarfs the cohort (diverging optimizer or "
@@ -455,14 +492,14 @@ def builtin_rules(dp_epsilon_budget: float = 0.0, comm_round: int = 200,
         HealthRule(
             name="staleness-runaway", metric=N.ASYNC_STALENESS, op=">",
             threshold=max(1.0, 0.8 * float(max_staleness)),
-            for_rounds=2, severity="warn",
+            for_rounds=2, severity="warn", action="adapt_buffer",
             description=(
                 "p99 accepted-upload staleness near the admission "
                 "bound: the buffered server is aggregating history")),
         HealthRule(
             name="region-staleness-runaway", metric=N.REGION_STALENESS,
             op=">", threshold=max(1.0, 0.8 * float(max_staleness)),
-            for_rounds=2, severity="warn",
+            for_rounds=2, severity="warn", action="adapt_buffer",
             description=(
                 "a regional sub-aggregator's batch staleness near the "
                 "admission bound for 2 boundaries: that region is "
@@ -473,6 +510,7 @@ def builtin_rules(dp_epsilon_budget: float = 0.0, comm_round: int = 200,
         HealthRule(
             name="quarantine-burst", metric=N.BYZ_QUARANTINES,
             op=">=", threshold=2, window="delta", n=5, severity="warn",
+            action="escalate_defense",
             description=(
                 "2+ quarantines entered within 5 boundaries — a "
                 "coordinated anomaly, not one flaky silo")),

@@ -24,7 +24,11 @@
 #     under secure QUANTIZED aggregation (privacy/secure_quant.py) —
 #     the two-phase Bonawitz discard drops the corpse's frame whole,
 #     the survivor re-weighting keeps the aggregate a true weighted
-#     mean, and every round still completes over field-element frames.
+#     mean, and every round still completes over field-element frames;
+#   - reflex actions (ISSUE 20): a sign-flip silo under --actions on
+#     with the defense starting at NONE — the firing health rules must
+#     ACT (quarantine the silo, escalate the defense ladder) with rule
+#     provenance on every dispatch, and the federation finish finite.
 #
 # Heavier than the tier-1 suite (each run trains the tiny 3D CNN in 5
 # real OS processes), so it lives here as a CI smoke, not a pytest.
@@ -642,6 +646,59 @@ print(f"OK(region/obs): MERGED /metrics scraped mid-chaos "
 EOF
 }
 
+run_actions() {
+    # reflex plane (ISSUE 20, obs/actions.py): a 1-of-4 sign-flip silo
+    # under --actions on, starting from defense NONE — the health rules
+    # must ACT, not just alert: client-divergence quarantines the
+    # offending silo (next cohort excludes it) and defense-escalation
+    # steps the robust-aggregation ladder none -> norm_diff_clipping,
+    # every dispatch flight-recorded with the firing rule as
+    # provenance in the verdict's actions block; the federation still
+    # finishes with finite metrics.
+    local out="/tmp/chaos_smoke_actions"
+    rm -rf "$out"; mkdir -p "$out"
+    echo "== chaos smoke (reflex-actions cell): sign-flip silo," \
+         "--actions on, defense starts at none =="
+    if ! $PY -m neuroimagedisttraining_tpu \
+            --algorithm fedavg --dataset synthetic --model 3dcnn_tiny \
+            --synthetic_num_subjects 64 --synthetic_shape 12 14 12 \
+            --client_num_in_total 4 --comm_round 2 --batch_size 8 \
+            --epochs 2 --lr 3e-3 --seed 1024 --log_dir "$out" \
+            --tag actions --health_stats --actions on --defense none \
+            --fault_spec "byz:1@0:sign_flip,byz:1@1:sign_flip" \
+            > "$out/run.log" 2>&1; then
+        echo "FAIL(actions): reflex run exited non-zero"
+        tail -30 "$out/run.log"; return 1
+    fi
+    $PY - "$out" <<'EOF'
+import glob, json, math, sys
+(vp,) = glob.glob(sys.argv[1] + "/synthetic/*.health.json")
+doc = json.load(open(vp))
+acts = doc["actions"]
+assert acts["mode"] == "on", acts
+by = {e["action"]: e for e in acts["log"] if e["status"] == "applied"}
+q = by.get("quarantine_silo")
+assert q is not None, f"no applied quarantine in {acts['log']}"
+assert q["rule"] == "client-divergence", q
+assert q["detail"]["client"] == 0, q     # byz rank 1 == client 0
+e = by.get("escalate_defense")
+assert e is not None, f"no applied escalation in {acts['log']}"
+assert e["rule"] == "defense-escalation", e
+assert e["detail"] == {"from": "none", "to": "norm_diff_clipping"}, e
+assert all(not x["dry_run"] for x in acts["log"]), acts["log"]
+assert doc["rounds_evaluated"] == 2, doc
+# the run's summary JSON (last {...} line of the log) must be finite
+(summary,) = [l for l in open(sys.argv[1] + "/run.log")
+              if l.startswith("{")][-1:]
+fin = json.loads(summary)["final_global"]
+assert all(math.isfinite(v) for v in fin.values()), fin
+print(f"OK(actions): quarantined client {q['detail']['client']} "
+      f"(cos {q['detail']['cos']:.3f}) and escalated "
+      f"{e['detail']['from']} -> {e['detail']['to']}, rule provenance "
+      "on every dispatch, federation finished")
+EOF
+}
+
 rc=0
 run_one socket crash || rc=1
 run_one broker crash || rc=1
@@ -652,4 +709,5 @@ run_secure_quant     || rc=1
 run_ingest           || rc=1
 run_region           || rc=1
 run_serve            || rc=1
+run_actions          || rc=1
 exit $rc

@@ -11,10 +11,15 @@
 #      client-divergence rule must fire (nidt_alert sample, flight
 #      `alert` event, degraded worst status) and --health_gate must
 #      exit NONZERO;
-#   3. analysis/run_report.py joins each run's metrics JSONL + health
+#   3. seeded actions-replay twins (ISSUE 20): the same chaos scenario
+#      twice under --actions on — the reflex dispatches (quarantine,
+#      defense escalation) must be BYTE-identical across the twins,
+#      the replay-determinism contract of the timestamp-free action
+#      log;
+#   4. analysis/run_report.py joins each run's metrics JSONL + health
 #      verdict into run_report.json/md; the two reports must visibly
 #      differ in the alert timeline;
-#   4. the combined exemplar lands in bench_matrix/health_report.json,
+#   5. the combined exemplar lands in bench_matrix/health_report.json,
 #      regression-gated by analysis/bench_gate.py (the health_report
 #      SPEC) like every other committed artifact.
 #
@@ -71,6 +76,46 @@ if [ $rc_byz -eq 0 ]; then
          "client-divergence rule must fire and fail the gate)" >&2
     exit 1
 fi
+
+echo "== seeded actions-replay twins (reflex plane, ISSUE 20) =="
+# two IDENTICAL seeded chaos runs under --actions on: the reflex
+# dispatches (quarantine + escalation, rule provenance on each) must
+# come out BYTE-IDENTICAL — the action log is deliberately
+# timestamp-free so seeded chaos replays deterministically
+for twin in twin_a twin_b; do
+    $PY -m neuroimagedisttraining_tpu "${COMMON[@]}" --tag "act_$twin" \
+        --comm_round 2 --epochs 2 --lr 3e-3 --actions on \
+        --defense none --metrics_out "$WORK/$twin.metrics.jsonl" \
+        --fault_spec "byz:1@0:sign_flip,byz:1@1:sign_flip" \
+        > "$WORK/$twin.log" 2>&1
+    rc_twin=$?
+    # the gate exits nonzero BY DESIGN here (the divergence rules fire
+    # before the reflex contains them); the verdict must still land
+    if ! ls "$WORK"/LOG/synthetic/*act_$twin*.health.json >/dev/null; then
+        echo "FAIL: actions twin $twin left no verdict (rc=$rc_twin)" >&2
+        tail -20 "$WORK/$twin.log" >&2
+        exit 1
+    fi
+done
+$PY - "$WORK" <<'EOF'
+import glob, json, sys
+blocks = []
+for twin in ("act_twin_a", "act_twin_b"):
+    (vp,) = glob.glob(sys.argv[1] + f"/LOG/synthetic/*{twin}*.health.json")
+    blocks.append(json.load(open(vp))["actions"])
+a, b = blocks
+assert a["mode"] == "on", a
+applied = {e["action"] for e in a["log"] if e["status"] == "applied"}
+assert {"quarantine_silo", "escalate_defense"} <= applied, a["log"]
+assert all(e["rule"] for e in a["log"]), a["log"]
+ja = json.dumps(a, sort_keys=True)
+jb = json.dumps(b, sort_keys=True)
+assert ja == jb, ("seeded actions replay diverged:\n"
+                  f"A: {ja}\nB: {jb}")
+print(f"OK(actions-replay): {len(a['log'])} dispatches byte-identical "
+      f"across twins; applied={sorted(applied)}")
+EOF
+[ $? -ne 0 ] && exit 1
 
 clean_verdict=$(ls "$WORK"/LOG/synthetic/*health_clean*.health.json)
 byz_verdict=$(ls "$WORK"/LOG/synthetic/*health_byz*.health.json)

@@ -1,0 +1,464 @@
+"""Reflex-plane tests (ISSUE 20).
+
+Five contracts:
+
+(a) The :class:`ActionBus` matrix: ``off`` dispatches nothing;
+    ``dry_run`` logs what WOULD fire without running a handler; ``on``
+    runs the registered handler and contains every failure mode
+    (unhandled / handler-reported skip / raised exception) as a log
+    status — never an exception into the host boundary. Unknown names
+    fail loudly at registration, the log ring evicts with a counted
+    eviction, the module-level conveniences no-op unarmed.
+(b) Rule -> action provenance: a firing rule that declares an
+    ``action`` dispatches on its RISING edge with the rule name,
+    severity, round, and value carried into the action log and the
+    flight ring; rules without an action dispatch nothing; an unknown
+    action name fails rule validation at startup.
+(c) The seeded chaos scenario ACTS deterministically: under
+    ``--actions on`` a 1-of-4 sign-flip silo gets quarantined with the
+    firing rule as provenance (the next cohort excludes it), and two
+    identical seeded runs produce byte-identical action logs; the
+    ``dry_run`` twin records the same would-fire dispatch while
+    changing NOTHING (no quarantine window, full cohort, config
+    defense).
+(d) Freeze-and-rollback restores the pinned healthy state bitwise at
+    a host boundary and zeroes the codec error-feedback accumulators;
+    the healthy pin is only taken under ``--actions on`` while the
+    rule engine reads ok.
+(e) The elastic compute plane: a ``preempt:NDEV@ROUND`` fault shrinks
+    the mesh to the survivors mid-run, resumes from the last
+    donation-safe checkpoint, and the post-resume trajectory is
+    BITWISE-identical to a fresh-process resume of the same checkpoint
+    on a mesh of that size (the replay-parity pin ISSUE 20's
+    acceptance asks for).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuroimagedisttraining_tpu.config import (
+    DataConfig, ExperimentConfig, FedConfig, OptimConfig,
+)
+from neuroimagedisttraining_tpu.core.trainer import LocalTrainer
+from neuroimagedisttraining_tpu.data.federate import federate_cohort
+from neuroimagedisttraining_tpu.data.synthetic import (
+    generate_synthetic_abcd,
+)
+from neuroimagedisttraining_tpu.engines import create_engine
+from neuroimagedisttraining_tpu.models import create_model
+from neuroimagedisttraining_tpu.obs import actions as obs_actions
+from neuroimagedisttraining_tpu.obs import flight as obs_flight
+from neuroimagedisttraining_tpu.obs import names as N
+from neuroimagedisttraining_tpu.obs import rules as obs_rules
+from neuroimagedisttraining_tpu.obs.actions import ActionBus
+from neuroimagedisttraining_tpu.obs.rules import HealthRule, RuleEngine
+from neuroimagedisttraining_tpu.parallel.mesh import make_mesh
+from neuroimagedisttraining_tpu.utils.logging import ExperimentLogger
+
+
+@pytest.fixture(scope="module")
+def cohort64():
+    """Same cohort as tests/test_health.py: enough shared signal that
+    honest site updates cohere, so a sign-flip silo separates from
+    non-IID noise."""
+    return generate_synthetic_abcd(num_subjects=64, shape=(12, 14, 12),
+                                   num_sites=4, seed=0)
+
+
+def _engine(tmp_path, cohort, n_dev=None, algorithm="fedavg",
+            health=True, comm_round=2, freq=1, client_mesh=0, tag="a",
+            seed=1024, checkpoint_dir="", checkpoint_every=0, **fed_kw):
+    """test_health's engine builder plus the reflex knobs: mesh width
+    (client_mesh must equal it when sharding) and checkpointing."""
+    cfg = ExperimentConfig(
+        model="3dcnn_tiny", num_classes=1, algorithm=algorithm,
+        seed=seed,
+        data=DataConfig(dataset="synthetic", partition_method="site"),
+        optim=OptimConfig(lr=1e-3, batch_size=8, epochs=1),
+        fed=FedConfig(client_num_in_total=4, comm_round=comm_round,
+                      frequency_of_the_test=freq,
+                      client_mesh=client_mesh, **fed_kw),
+        log_dir=str(tmp_path), tag=tag, health_stats=health,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every)
+    mesh = make_mesh(num_devices=n_dev)
+    trainer = LocalTrainer(create_model(cfg.model, num_classes=1),
+                           cfg.optim, num_classes=1)
+    log = ExperimentLogger(str(tmp_path), "synthetic",
+                           cfg.identity() + tag, console=False)
+    fed, _ = federate_cohort(cohort, partition_method="site", mesh=mesh)
+    return create_engine(algorithm, cfg, fed, trainer, mesh=mesh,
+                         logger=log)
+
+
+def _bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _snap(value, metric=N.HEALTH_COSINE_MIN):
+    return {metric: {"kind": "gauge", "help": "",
+                     "values": [{"labels": {}, "value": value}]}}
+
+
+_BYZ = "byz:1@0:sign_flip,byz:1@1:sign_flip"
+
+
+# ---------------------------------------------------------------------------
+# (a) the ActionBus matrix
+# ---------------------------------------------------------------------------
+
+
+def test_bus_off_dispatches_nothing():
+    bus = ActionBus("off")
+    calls = []
+    bus.register("quarantine_silo", lambda **kw: calls.append(kw))
+    assert bus.on_alert("quarantine_silo", rule="r") is None
+    assert calls == []
+    blk = bus.actions_block()
+    assert blk["mode"] == "off" and blk["total"] == 0 and blk["log"] == []
+
+
+def test_bus_dry_run_logs_without_running_handler():
+    obs_flight.clear()
+    bus = ActionBus("dry_run")
+    calls = []
+    bus.register("quarantine_silo", lambda **kw: calls.append(kw))
+    e = bus.on_alert("quarantine_silo", rule="client-divergence",
+                     severity="critical", round_idx=3, value=-0.4)
+    assert calls == []
+    assert e["status"] == "dry_run" and e["dry_run"] is True
+    assert e["rule"] == "client-divergence" and e["round"] == 3
+    assert e["value"] == pytest.approx(-0.4)
+    kinds = [ev["kind"] for ev in obs_flight.events()]
+    assert "action_dry_run" in kinds and "action" not in kinds
+
+
+def test_bus_on_applies_handler_detail():
+    bus = ActionBus("on")
+    bus.register("escalate_defense",
+                 lambda **kw: {"from": "none", "to": "trimmed_mean"})
+    e = bus.on_alert("escalate_defense", rule="r", round_idx=1)
+    assert e["status"] == "applied" and e["dry_run"] is False
+    assert e["detail"] == {"from": "none", "to": "trimmed_mean"}
+
+
+def test_bus_on_contains_every_failure_mode():
+    bus = ActionBus("on")
+    # no handler on this plane -> unhandled, not an error
+    assert bus.on_alert("adapt_buffer", rule="r")["status"] == "unhandled"
+    # handler-reported skip rides the status channel with its reason
+    bus.register("freeze_rollback",
+                 lambda **kw: {"status": "skipped", "reason": "no pin"})
+    e = bus.on_alert("freeze_rollback", rule="r")
+    assert e["status"] == "skipped" and e["detail"] == {"reason": "no pin"}
+
+    def _boom(**kw):
+        raise RuntimeError("handler exploded")
+
+    bus.register("quarantine_silo", _boom)
+    e = bus.on_alert("quarantine_silo", rule="r")
+    assert e["status"] == "error"
+    assert "handler exploded" in e["detail"]["error"]
+
+
+def test_bus_unknown_names():
+    bus = ActionBus("on")
+    with pytest.raises(ValueError, match="unknown action"):
+        bus.register("reboot_universe", lambda **kw: None)
+    # a hand-built RuleEngine cannot crash a boundary through the bus
+    e = bus.on_alert("reboot_universe", rule="r")
+    assert e["status"] == "error"
+    with pytest.raises(ValueError, match="--actions"):
+        ActionBus("sometimes")
+
+
+def test_bus_log_ring_evicts_counted():
+    bus = ActionBus("dry_run", log_cap=4)
+    for i in range(6):
+        bus.on_alert("quarantine_silo", rule=f"r{i}")
+    blk = bus.actions_block()
+    assert blk["total"] == 6 and blk["evicted"] == 2
+    assert [e["rule"] for e in blk["log"]] == ["r2", "r3", "r4", "r5"]
+
+
+def test_module_level_unarmed_noops():
+    assert obs_actions.active() is None
+    obs_actions.register("quarantine_silo", lambda **kw: None)
+    assert obs_actions.on_alert("quarantine_silo", rule="r") is None
+    assert obs_actions.record_action("shrink_mesh", rule="r") is None
+    assert obs_actions.actions_block() == {"mode": "unarmed"}
+
+
+# ---------------------------------------------------------------------------
+# (b) rule -> action provenance
+# ---------------------------------------------------------------------------
+
+
+def test_rule_action_dispatches_on_rising_edge():
+    obs_flight.clear()
+    try:
+        bus = obs_actions.configure("dry_run")
+        eng = RuleEngine([HealthRule(
+            name="div", metric=N.HEALTH_COSINE_MIN, op="<",
+            threshold=-0.2, severity="critical",
+            action="quarantine_silo")])
+        eng.observe(0, _snap(0.3))      # healthy: no edge
+        eng.observe(1, _snap(-0.9))     # rising edge -> dispatch
+        eng.observe(2, _snap(-0.9))     # still firing: no NEW edge
+        blk = bus.actions_block()
+        assert blk["total"] == 1
+        (e,) = blk["log"]
+        assert e["action"] == "quarantine_silo" and e["rule"] == "div"
+        assert e["severity"] == "critical" and e["round"] == 1
+        assert e["value"] == pytest.approx(-0.9)
+        flights = [ev for ev in obs_flight.events()
+                   if ev["kind"] == "action_dry_run"]
+        assert [(f["rule"], f["round"]) for f in flights] == [("div", 1)]
+        # the verdict rows carry the binding for run_report provenance
+        (row,) = eng.verdict()["rules"]
+        assert row["action"] == "quarantine_silo"
+    finally:
+        obs_actions.disarm()
+
+
+def test_rule_without_action_dispatches_nothing():
+    try:
+        bus = obs_actions.configure("dry_run")
+        eng = RuleEngine([HealthRule(
+            name="div", metric=N.HEALTH_COSINE_MIN, op="<",
+            threshold=-0.2, severity="critical")])
+        eng.observe(0, _snap(-0.9))
+        assert bus.actions_block()["total"] == 0
+    finally:
+        obs_actions.disarm()
+
+
+def test_rule_unknown_action_fails_validation():
+    with pytest.raises(ValueError, match="unknown action"):
+        RuleEngine([HealthRule(
+            name="div", metric=N.HEALTH_COSINE_MIN, op="<",
+            threshold=-0.2, action="reboot_universe")])
+
+
+# ---------------------------------------------------------------------------
+# (c) the seeded chaos scenario acts deterministically
+# ---------------------------------------------------------------------------
+
+
+def _chaos_log(tmp_path, cohort, mode, tag, comm_round=2):
+    """One seeded sign-flip run with the builtin rules and the action
+    bus at ``mode``; returns (engine, actions block)."""
+    obs_flight.clear()
+    try:
+        obs_rules.configure()
+        bus = obs_actions.configure(mode)
+        eng = _engine(tmp_path, cohort, tag=tag, comm_round=comm_round,
+                      fault_spec=_BYZ, defense_type="none")
+        res = eng.train()
+        for leaf in jax.tree.leaves(res["params"]):
+            assert np.isfinite(np.asarray(leaf)).all()
+        return eng, bus.actions_block()
+    finally:
+        obs_actions.disarm()
+        obs_rules.disarm()
+
+
+def test_chaos_quarantine_applied_with_provenance(tmp_path, cohort64):
+    eng, blk = _chaos_log(tmp_path, cohort64, "on", "on1")
+    q = [e for e in blk["log"] if e["action"] == "quarantine_silo"
+         and e["status"] == "applied"]
+    assert q, f"no applied quarantine in {blk['log']}"
+    assert q[0]["rule"] == "client-divergence"
+    offender = q[0]["detail"]["client"]
+    assert eng._is_quarantined(offender, q[0]["detail"]["from_round"])
+    # the NEXT round's cohort excluded the quarantined silo
+    sampled_next = eng._sampled_by_round.get(
+        q[0]["detail"]["from_round"])
+    assert sampled_next is not None and offender not in list(sampled_next)
+    # replay determinism: an identical seeded run acts byte-identically
+    _, blk2 = _chaos_log(tmp_path, cohort64, "on", "on2")
+    assert (json.dumps(blk["log"], sort_keys=True)
+            == json.dumps(blk2["log"], sort_keys=True))
+
+
+def test_chaos_dry_run_observes_without_acting(tmp_path, cohort64):
+    eng, blk = _chaos_log(tmp_path, cohort64, "dry_run", "dry",
+                          comm_round=1)
+    q = [e for e in blk["log"] if e["action"] == "quarantine_silo"]
+    assert q and all(e["status"] == "dry_run" for e in q)
+    assert eng._quarantine_windows == {}
+    assert eng.active_defense() == "none"
+    # the cohort never shrank: every sampled round saw all 4 clients
+    assert all(len(s) == 4 for s in eng._sampled_by_round.values())
+
+
+# ---------------------------------------------------------------------------
+# (d) escalation + freeze-and-rollback handlers
+# ---------------------------------------------------------------------------
+
+
+def test_escalate_defense_walks_the_ladder(tmp_path, cohort64):
+    try:
+        bus = obs_actions.configure("on")
+        eng = _engine(tmp_path, cohort64, tag="esc",
+                      defense_type="none")
+        eng._register_reflexes()
+        eng.program  # build the plan the escalation must invalidate
+        e = bus.on_alert("escalate_defense", rule="defense-escalation",
+                         round_idx=0)
+        assert e["status"] == "applied"
+        assert e["detail"] == {"from": "none",
+                               "to": "norm_diff_clipping"}
+        assert eng.active_defense() == "norm_diff_clipping"
+        assert "program" not in eng.__dict__  # re-plan forced
+        e = bus.on_alert("escalate_defense", rule="defense-escalation",
+                         round_idx=1)
+        assert e["detail"] == {"from": "norm_diff_clipping",
+                               "to": "trimmed_mean"}
+        # the config literal is never touched — only the override moves
+        assert eng.cfg.fed.defense_type == "none"
+        e = bus.on_alert("escalate_defense", rule="defense-escalation",
+                         round_idx=2)
+        assert e["status"] == "skipped"
+        assert "top rung" in e["detail"]["reason"]
+    finally:
+        obs_actions.disarm()
+
+
+def test_escalate_skips_outside_the_ladder(tmp_path, cohort64):
+    try:
+        bus = obs_actions.configure("on")
+        eng = _engine(tmp_path, cohort64, tag="lad",
+                      defense_type="weak_dp")
+        eng._register_reflexes()
+        e = bus.on_alert("escalate_defense", rule="r", round_idx=0)
+        assert e["status"] == "skipped"
+        assert "outside the escalation ladder" in e["detail"]["reason"]
+        assert eng.active_defense() == "weak_dp"
+    finally:
+        obs_actions.disarm()
+
+
+def test_freeze_rollback_restores_pin_bitwise(tmp_path, cohort64):
+    obs_flight.clear()
+    try:
+        bus = obs_actions.configure("on")
+        eng = _engine(tmp_path, cohort64, tag="rb")
+        eng._register_reflexes()
+        # no pin yet -> the handler reports the skip, nothing pends
+        e = bus.on_alert("freeze_rollback", rule="update-norm-blowup",
+                         round_idx=0)
+        assert e["status"] == "skipped" and eng._pending_rollback is None
+        # a healthy boundary pins (mode on, no rule engine -> healthy)
+        good_p = {"w": jnp.arange(4.0)}
+        good_b = {"m": jnp.ones(3)}
+        p, b = eng._reflex_boundary(3, good_p, good_b)
+        assert eng._healthy_pin is not None
+        assert eng._healthy_pin["round"] == 3
+        # the pin owns copies: consuming the originals cannot kill it
+        _bitwise(eng._healthy_pin["params"], good_p)
+        e = bus.on_alert("freeze_rollback", rule="update-norm-blowup",
+                         round_idx=5, value=80.0)
+        assert e["status"] == "applied" and e["detail"]["pin_round"] == 3
+        eng._wire_ef = {"e": jnp.full(3, 7.0)}
+        bad_p = {"w": jnp.full(4, jnp.nan)}
+        p, b = eng._reflex_boundary(5, bad_p, {"m": jnp.zeros(3)})
+        _bitwise(p, good_p)
+        _bitwise(b, good_b)
+        # codec-EF reset invariant: stale error must not be replayed
+        _bitwise(eng._wire_ef, {"e": jnp.zeros(3)})
+        rb = [ev for ev in obs_flight.events()
+              if ev["kind"] == "rollback"]
+        assert [(r["rule"], r["pin_round"]) for r in rb] \
+            == [("update-norm-blowup", 3)]
+    finally:
+        obs_actions.disarm()
+
+
+def test_no_pin_outside_actions_on(tmp_path, cohort64):
+    """dry_run must not even pin: pinning is reflex machinery, and the
+    dry_run contract is 'behavior never changes silently'."""
+    try:
+        obs_actions.configure("dry_run")
+        eng = _engine(tmp_path, cohort64, tag="np")
+        eng._reflex_boundary(0, {"w": jnp.zeros(2)}, {})
+        assert eng._healthy_pin is None
+    finally:
+        obs_actions.disarm()
+
+
+# ---------------------------------------------------------------------------
+# (e) the elastic compute plane
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_shrinks_mesh_and_resumes_bitwise(tmp_path, cohort64):
+    """``preempt:2@2`` on a 4-device/4-way-sharded run: the mesh
+    shrinks to the 2 survivors, cfg.fed.client_mesh follows (the
+    startup invariant), the shrink is flight-recorded with device-loss
+    provenance, and rounds 2..3 after the in-process resume are
+    BITWISE what a fresh process restoring the same checkpoint on a
+    2-device mesh computes."""
+    ckA, ckB = str(tmp_path / "ckA"), str(tmp_path / "ckB")
+    try:
+        bus = obs_actions.configure("dry_run")
+        a = _engine(tmp_path, cohort64, n_dev=4, client_mesh=4,
+                    health=False, comm_round=4, tag="elA",
+                    checkpoint_dir=ckA, checkpoint_every=1,
+                    fault_spec="preempt:2@2")
+        res_a = a.train()
+        assert a.mesh.devices.size == 2
+        assert a.cfg.fed.client_mesh == 2
+        shrinks = [e for e in bus.actions_block()["log"]
+                   if e["action"] == "shrink_mesh"]
+        assert [e["status"] for e in shrinks] == ["applied"]
+        assert shrinks[0]["rule"] == "device-loss"
+        assert shrinks[0]["detail"] == {
+            "devices_before": 4, "devices_after": 2,
+            "scheduled_round": 2, "resume_round": 2}
+    finally:
+        obs_actions.disarm()
+    # prefix twin: same seeded run, stopped where the preemption hit —
+    # its checkpoint is the state the elastic resume restored
+    pre = _engine(tmp_path, cohort64, n_dev=4, client_mesh=4,
+                  health=False, comm_round=2, tag="elP",
+                  checkpoint_dir=ckB, checkpoint_every=1)
+    pre.train()
+    # fresh-process resume of that checkpoint on a 2-device mesh
+    b = _engine(tmp_path, cohort64, n_dev=2, client_mesh=2,
+                health=False, comm_round=4, tag="elB",
+                checkpoint_dir=ckB, checkpoint_every=1)
+    res_b = b.train()
+    _bitwise(res_a["params"], res_b["params"])
+    _bitwise(res_a["batch_stats"], res_b["batch_stats"])
+    # the post-resume metric trajectory is pinned too
+    tail_a = [h for h in res_a["history"] if h["round"] >= 2]
+    tail_b = [h for h in res_b["history"] if h["round"] >= 2]
+    assert tail_a == tail_b
+
+
+def test_preempt_without_checkpoint_continues_live(tmp_path, cohort64):
+    """No checkpoint configured: the shrink still happens, training
+    continues on the live state over the survivors (the record carries
+    the live resume round)."""
+    try:
+        bus = obs_actions.configure("dry_run")
+        eng = _engine(tmp_path, cohort64, n_dev=4, health=False,
+                      comm_round=2, tag="elL", fault_spec="preempt:2@1")
+        res = eng.train()
+        assert eng.mesh.devices.size == 2
+        for leaf in jax.tree.leaves(res["params"]):
+            assert np.isfinite(np.asarray(leaf)).all()
+        (e,) = [x for x in bus.actions_block()["log"]
+                if x["action"] == "shrink_mesh"]
+        assert e["status"] == "applied"
+        assert e["detail"]["resume_round"] == 1
+    finally:
+        obs_actions.disarm()

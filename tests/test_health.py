@@ -454,6 +454,25 @@ def test_builtin_manifest_validates_and_budget_rules():
     assert burn.threshold == pytest.approx(2.0 * 4.0 / 100)
 
 
+def test_example_manifest_action_bindings():
+    """The shipped example manifest (scripts/health_rules.example.json)
+    must load, validate, and carry reflex-action bindings whose names
+    resolve in obs/actions.py BUILTIN_ACTIONS (ISSUE 20): the manifest
+    is both operator documentation and the action-discipline lint's
+    cross-file fixture."""
+    from neuroimagedisttraining_tpu.obs import actions as obs_actions
+
+    rules = obs_rules.load_rules(
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                     "health_rules.example.json"))
+    RuleEngine(rules)  # metrics declared, actions resolve, no dupes
+    bound = {r.name: r.action for r in rules if r.action}
+    assert bound == {
+        "update-blowup-rollback-example": "freeze_rollback",
+        "divergence-quarantine-example": "quarantine_silo"}
+    assert set(bound.values()) <= set(obs_actions.BUILTIN_ACTIONS)
+
+
 def test_configure_manifest_overrides_builtin(tmp_path):
     p = tmp_path / "rules.json"
     p.write_text(json.dumps([
